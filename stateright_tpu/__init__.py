@@ -1,0 +1,54 @@
+"""stateright_tpu: a TPU-native model-checking framework for distributed systems.
+
+Brand-new implementation of the capabilities of the Rust `stateright` crate
+(reference at /root/reference, surveyed in SURVEY.md): an explicit-state
+model checker (always/sometimes/eventually properties, BFS/DFS host engines,
+symmetry reduction, interactive Explorer), an actor framework whose models
+can be both exhaustively checked and executed over real UDP, and
+linearizability/sequential-consistency testers that run inside the checker.
+
+The TPU-first core: `CheckerBuilder.spawn_tpu()` lifts the frontier-expansion
+loop to JAX — the BFS frontier is batched and vmapped, fingerprints are
+computed by a device hash kernel, the visited set is an HBM-resident
+open-addressed hash table, property evaluation is fused into the step, and
+multi-chip runs shard the frontier by fingerprint prefix with all-to-all
+exchanges over ICI.
+"""
+
+from .core import Expectation, Model, Property, fingerprint
+from .checker import (
+    Checker,
+    CheckerBuilder,
+    CheckerVisitor,
+    NondeterministicModelError,
+    Path,
+    PathRecorder,
+    Representative,
+    RewritePlan,
+    StateRecorder,
+    rewrite_value,
+)
+from .fingerprint import fp64_words, stable_fingerprint, stable_words
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "Expectation",
+    "Model",
+    "NondeterministicModelError",
+    "Path",
+    "PathRecorder",
+    "Property",
+    "Representative",
+    "RewritePlan",
+    "StateRecorder",
+    "fingerprint",
+    "fp64_words",
+    "rewrite_value",
+    "stable_fingerprint",
+    "stable_words",
+    "__version__",
+]

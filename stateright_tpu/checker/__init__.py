@@ -1,0 +1,25 @@
+"""Checker engines and results interface.
+
+Mirrors the reference's re-export surface (`/root/reference/src/checker.rs`):
+``CheckerBuilder``, ``Checker``, ``Path``, visitors, and symmetry-reduction
+helpers — plus the TPU-native engine entry point.
+"""
+
+from .builder import Checker, CheckerBuilder
+from .path import NondeterministicModelError, Path
+from .representative import Representative, RewritePlan, rewrite_value
+from .visitor import CheckerVisitor, PathRecorder, StateRecorder, as_visitor
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "CheckerVisitor",
+    "NondeterministicModelError",
+    "Path",
+    "PathRecorder",
+    "Representative",
+    "RewritePlan",
+    "StateRecorder",
+    "as_visitor",
+    "rewrite_value",
+]

@@ -1,0 +1,123 @@
+"""Host breadth-first search engine.
+
+Replicates the reference BFS semantics (`/root/reference/src/checker/bfs.rs`):
+FIFO frontier of ``(state, fingerprint, ebits)``; a ``generated`` map of
+fingerprint -> parent fingerprint used both for dedup and for trace
+reconstruction by replay (`bfs.rs:314-342`); property evaluation on pop with
+early exit once every property has a discovery; ``eventually`` bits flushed
+as counterexamples at terminal states. The two documented soundness caveats
+for ``eventually`` (ebits not part of the fingerprint, and cycle-vs-DAG-join
+ambiguity — `bfs.rs:239-244`, `:249-256`) are replicated, not fixed, so
+behavior matches the reference's pinned tests.
+
+Symmetry reduction is intentionally *not* applied here: as in the reference,
+only the DFS engine honors it.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..core import Expectation
+from .builder import CheckerBuilder
+from .host import HostChecker
+from .path import Path
+
+
+class BfsChecker(HostChecker):
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        # fingerprint -> parent fingerprint (None for init states).
+        self._generated: Dict[int, Optional[int]] = {}
+        model = self._model
+        init_states = [s for s in model.init_states()
+                       if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        for s in init_states:
+            self._generated.setdefault(model.fingerprint(s), None)
+        self._unique_state_count = len(self._generated)
+        ebits = self._init_ebits()
+        self._pending = deque(
+            (s, model.fingerprint(s), ebits) for s in init_states)
+
+    def _run(self) -> None:
+        model = self._model
+        properties = self._properties
+        generated = self._generated
+        pending = self._pending
+        discoveries = self._discovery_fps
+        visitor = self._visitor
+        target = self._target_state_count
+
+        while pending:
+            state, state_fp, ebits = pending.popleft()
+            if visitor is not None:
+                visitor.visit(model, self._reconstruct_path(state_fp))
+
+            # Property evaluation (bfs.rs:192-226).
+            is_awaiting_discoveries = False
+            for i, prop in enumerate(properties):
+                if prop.name in discoveries:
+                    continue
+                if prop.expectation == Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        discoveries[prop.name] = state_fp
+                    else:
+                        is_awaiting_discoveries = True
+                elif prop.expectation == Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        discoveries[prop.name] = state_fp
+                    else:
+                        is_awaiting_discoveries = True
+                else:  # EVENTUALLY: discoveries only surface at terminals.
+                    is_awaiting_discoveries = True
+                    if prop.condition(model, state):
+                        ebits = ebits - {i}
+            if not is_awaiting_discoveries:
+                return
+
+            # Expansion (bfs.rs:229-264).
+            actions: List = []
+            is_terminal = True
+            model.actions(state, actions)
+            for action in actions:
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                if not model.within_boundary(next_state):
+                    continue
+                self._state_count += 1
+                next_fp = model.fingerprint(next_state)
+                if next_fp in generated:
+                    is_terminal = False
+                    continue
+                generated[next_fp] = state_fp
+                self._unique_state_count = len(generated)
+                is_terminal = False
+                pending.append((next_state, next_fp, ebits))
+            if is_terminal:
+                for i, prop in enumerate(properties):
+                    if i in ebits:
+                        discoveries[prop.name] = state_fp
+            if target is not None and self._state_count >= target:
+                return
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        """Walk parent pointers to an init state, then replay forward
+        (`bfs.rs:314-342`)."""
+        fingerprints: deque = deque()
+        next_fp = fp
+        while next_fp in self._generated:
+            parent = self._generated[next_fp]
+            fingerprints.appendleft(next_fp)
+            if parent is None:
+                break
+            next_fp = parent
+        return Path.from_fingerprints(self._model, fingerprints)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct_path(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }

@@ -1,0 +1,210 @@
+"""Checker builder and results interface.
+
+Reference: ``CheckerBuilder`` (`/root/reference/src/checker.rs:35-179`) and the
+``Checker`` trait (`src/checker.rs:185-338`). ``spawn_tpu`` is the new
+TPU-native strategy added alongside the reference's ``spawn_bfs``/``spawn_dfs``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..core import Expectation, Model
+from .path import Path
+from .visitor import as_visitor
+
+
+class CheckerBuilder:
+    """Builder for checking runs (`src/checker.rs:35-179`)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.symmetry_fn_: Optional[Callable[[Any], Any]] = None
+        self.target_state_count_: Optional[int] = None
+        self.thread_count_: int = 1
+        self.visitor_ = None
+        self.tpu_options_: dict = {}
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enable symmetry reduction via ``state.representative()``
+        (`src/checker.rs:150-154`)."""
+        return self.symmetry_fn(lambda state: state.representative())
+
+    def symmetry_fn(self, representative: Callable[[Any], Any]) -> "CheckerBuilder":
+        self.symmetry_fn_ = representative
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        """The checker may exceed this count but never stops short of it
+        while more states exist (`src/checker.rs:163-167`)."""
+        self.target_state_count_ = count if count > 0 else None
+        return self
+
+    def threads(self, thread_count: int) -> "CheckerBuilder":
+        """Host-engine thread count. The pure-Python engines execute on one
+        worker (the GIL serializes model code); parallelism comes from the
+        native host engine and the TPU engine."""
+        self.thread_count_ = thread_count
+        return self
+
+    def visitor(self, visitor) -> "CheckerBuilder":
+        self.visitor_ = as_visitor(visitor)
+        return self
+
+    def tpu_options(self, **options) -> "CheckerBuilder":
+        """Tuning knobs for ``spawn_tpu`` (table capacity, batch caps, ...)."""
+        self.tpu_options_.update(options)
+        return self
+
+    def spawn_bfs(self) -> "Checker":
+        """Breadth-first host engine (`src/checker.rs:116-130`)."""
+        from .bfs import BfsChecker
+        return BfsChecker(self)
+
+    def spawn_dfs(self) -> "Checker":
+        """Depth-first host engine (`src/checker.rs:132-145`). The only host
+        engine supporting symmetry reduction, as in the reference."""
+        from .dfs import DfsChecker
+        return DfsChecker(self)
+
+    def spawn_tpu(self) -> "Checker":
+        """TPU-native engine: vmapped frontier expansion with device-resident
+        fingerprint dedup. Requires the model to implement the
+        :class:`~stateright_tpu.models.packed.PackedModel` protocol."""
+        from .tpu import TpuChecker
+        return TpuChecker(self)
+
+    def serve(self, address) -> "Checker":
+        """Start the Explorer web service (`src/checker.rs:99-114`)."""
+        from .explorer import serve as explorer_serve
+        return explorer_serve(self, address)
+
+
+class Checker:
+    """Results interface shared by all engines (`src/checker.rs:185-338`)."""
+
+    # --- engine-provided -------------------------------------------------
+    def model(self) -> Model:
+        raise NotImplementedError
+
+    def state_count(self) -> int:
+        """Total states generated including repeats (>= unique)."""
+        raise NotImplementedError
+
+    def unique_state_count(self) -> int:
+        raise NotImplementedError
+
+    def discoveries(self) -> Dict[str, Path]:
+        raise NotImplementedError
+
+    def join(self) -> "Checker":
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    # --- shared helpers --------------------------------------------------
+    def discovery(self, name: str) -> Optional[Path]:
+        return self.discoveries().get(name)
+
+    def report(self, w) -> "Checker":
+        """Periodic status lines + discovery summary (`src/checker.rs:217-242`).
+
+        Emits ``Checking. states=N, unique=N`` once per second while running,
+        then ``Done. states=N, unique=N, sec=S`` and one block per discovery.
+        """
+        start = time.monotonic()
+        if not self.is_done():
+            w.write(f"Checking. states={self.state_count()}, "
+                    f"unique={self.unique_state_count()}\n")
+            self._start_background()
+            last_print = time.monotonic()
+            while not self.is_done():
+                time.sleep(0.01)
+                now = time.monotonic()
+                if now - last_print >= 1.0:
+                    w.write(f"Checking. states={self.state_count()}, "
+                            f"unique={self.unique_state_count()}\n")
+                    last_print = now
+        w.write(f"Done. states={self.state_count()}, "
+                f"unique={self.unique_state_count()}, "
+                f"sec={int(time.monotonic() - start)}\n")
+        for name, path in self.discoveries().items():
+            w.write(f'Discovered "{name}" '
+                    f"{self.discovery_classification(name)} {path}")
+        return self
+
+    def _start_background(self) -> None:
+        """Hook for engines that can make progress concurrently."""
+        pass
+
+    def discovery_classification(self, name: str) -> str:
+        prop = self.model().property(name)
+        if prop.expectation in (Expectation.ALWAYS, Expectation.EVENTUALLY):
+            return "counterexample"
+        return "example"
+
+    def assert_properties(self) -> None:
+        """Examples exist for every ``sometimes``; no counterexamples for any
+        ``always``/``eventually`` (`src/checker.rs:256-267`)."""
+        for p in self.model().properties():
+            if p.expectation == Expectation.SOMETIMES:
+                self.assert_any_discovery(p.name)
+            else:
+                self.assert_no_discovery(p.name)
+
+    def assert_any_discovery(self, name: str) -> Path:
+        found = self.discovery(name)
+        if found is not None:
+            return found
+        assert self.is_done(), (
+            f'Discovery for "{name}" not found, but model checking is '
+            "incomplete.")
+        raise AssertionError(f'Discovery for "{name}" not found.')
+
+    def assert_no_discovery(self, name: str) -> None:
+        found = self.discovery(name)
+        if found is not None:
+            raise AssertionError(
+                f'Unexpected "{name}" {self.discovery_classification(name)} '
+                f"{found}Last state: {found.last_state()!r}\n")
+        assert self.is_done(), (
+            f'Discovery for "{name}" not found, but model checking is '
+            "incomplete.")
+
+    def assert_discovery(self, name: str, actions: Sequence[Any]) -> None:
+        """Panics unless ``actions`` also witness the property
+        (`src/checker.rs:291-338`)."""
+        additional_info: List[str] = []
+        found = self.assert_any_discovery(name)
+        model = self.model()
+        for init_state in model.init_states():
+            path = Path.from_actions(model, init_state, actions)
+            if path is None:
+                continue
+            prop = model.property(name)
+            if prop.expectation == Expectation.ALWAYS:
+                if not prop.condition(model, path.last_state()):
+                    return
+            elif prop.expectation == Expectation.EVENTUALLY:
+                states = path.into_states()
+                is_satisfied = any(prop.condition(model, s) for s in states)
+                acts: List[Any] = []
+                model.actions(states[-1], acts)
+                is_terminal = not acts
+                if not is_satisfied and is_terminal:
+                    return
+                if is_satisfied:
+                    additional_info.append(
+                        "incorrect counterexample satisfies eventually property")
+                if not is_terminal:
+                    additional_info.append(
+                        "incorrect counterexample is nonterminal")
+            else:
+                if prop.condition(model, path.last_state()):
+                    return
+        info = f" ({'; '.join(additional_info)})" if additional_info else ""
+        raise AssertionError(
+            f'Invalid discovery for "{name}"{info}, but a valid one was '
+            f"found. found={found.into_actions()!r}")

@@ -1,0 +1,123 @@
+"""Host depth-first search engine.
+
+Replicates the reference DFS semantics (`/root/reference/src/checker/dfs.rs`):
+LIFO stack of ``(state, fingerprint-path, ebits)`` with the full path carried
+on the stack (memory-light, no parent map); discoveries store whole
+fingerprint paths. This is the only host engine honoring symmetry reduction,
+with the reference's load-bearing subtlety (`dfs.rs:260-285`): dedup inserts
+``fingerprint(representative(next_state))`` but the enqueued path continues
+with the *original* state's fingerprint — jumping to the canonical member
+could leave the collected path without a valid extension (regression-tested,
+`dfs.rs:394-483`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..core import Expectation
+from .builder import CheckerBuilder
+from .host import HostChecker
+from .path import Path
+
+
+class DfsChecker(HostChecker):
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        self._generated: Set[int] = set()
+        model = self._model
+        symmetry = self._symmetry
+        init_states = [s for s in model.init_states()
+                       if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        for s in init_states:
+            if symmetry is not None:
+                self._generated.add(model.fingerprint(symmetry(s)))
+            else:
+                self._generated.add(model.fingerprint(s))
+        self._unique_state_count = len(self._generated)
+        ebits = self._init_ebits()
+        self._pending: List = [
+            (s, [model.fingerprint(s)], ebits) for s in init_states]
+        # name -> full fingerprint path (dfs.rs:26).
+        self._discovery_fps: Dict[str, List[int]] = {}
+
+    def _run(self) -> None:
+        model = self._model
+        properties = self._properties
+        generated = self._generated
+        pending = self._pending
+        discoveries = self._discovery_fps
+        visitor = self._visitor
+        symmetry = self._symmetry
+        target = self._target_state_count
+
+        while pending:
+            state, fingerprints, ebits = pending.pop()
+            if visitor is not None:
+                visitor.visit(model,
+                              Path.from_fingerprints(model, fingerprints))
+
+            # Property evaluation (dfs.rs:204-237).
+            is_awaiting_discoveries = False
+            for i, prop in enumerate(properties):
+                if prop.name in discoveries:
+                    continue
+                if prop.expectation == Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        discoveries[prop.name] = list(fingerprints)
+                    else:
+                        is_awaiting_discoveries = True
+                elif prop.expectation == Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        discoveries[prop.name] = list(fingerprints)
+                    else:
+                        is_awaiting_discoveries = True
+                else:  # EVENTUALLY
+                    is_awaiting_discoveries = True
+                    if prop.condition(model, state):
+                        ebits = ebits - {i}
+            if not is_awaiting_discoveries:
+                return
+
+            # Expansion (dfs.rs:239-301).
+            actions: List = []
+            is_terminal = True
+            model.actions(state, actions)
+            for action in actions:
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                if not model.within_boundary(next_state):
+                    continue
+                self._state_count += 1
+                if symmetry is not None:
+                    rep_fp = model.fingerprint(symmetry(next_state))
+                    if rep_fp in generated:
+                        is_terminal = False
+                        continue
+                    generated.add(rep_fp)
+                    # Continue the path with the pre-canonicalized state's
+                    # fingerprint (dfs.rs:266-269).
+                    next_fp = model.fingerprint(next_state)
+                else:
+                    next_fp = model.fingerprint(next_state)
+                    if next_fp in generated:
+                        is_terminal = False
+                        continue
+                    generated.add(next_fp)
+                self._unique_state_count = len(generated)
+                is_terminal = False
+                pending.append((next_state, fingerprints + [next_fp], ebits))
+            if is_terminal:
+                for i, prop in enumerate(properties):
+                    if i in ebits:
+                        discoveries[prop.name] = list(fingerprints)
+            if target is not None and self._state_count >= target:
+                return
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self._model, fps)
+            for name, fps in list(self._discovery_fps.items())
+        }

@@ -1,0 +1,78 @@
+"""Shared scaffolding for the host (CPU reference) engines.
+
+The reference spawns worker OS threads sharing a job market
+(`/root/reference/src/checker/bfs.rs:70-152`). A pure-Python translation of
+that would serialize on the GIL, so the host engines here run the search on a
+single worker thread started lazily — checking begins at the first
+observation (``join``/``report``/``is_done``/``serve``), which keeps the
+golden report output deterministic. These engines are the correctness oracle
+the TPU engine is differentially tested against.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..core import Expectation, Model
+from .builder import Checker, CheckerBuilder
+
+
+class HostChecker(Checker):
+    """Base for BfsChecker/DfsChecker: lazy single-worker execution."""
+
+    def __init__(self, builder: CheckerBuilder):
+        self._model = builder.model
+        self._symmetry = builder.symmetry_fn_
+        self._target_state_count = builder.target_state_count_
+        self._visitor = builder.visitor_
+        self._properties = self._model.properties()
+        self._state_count = 0
+        self._unique_state_count = 0
+        self._discovery_fps: Dict[str, object] = {}
+        self._done = False
+        self._thread: Optional[threading.Thread] = None
+        self._start_lock = threading.Lock()
+
+    # --- execution -------------------------------------------------------
+    def _run(self) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def _start_background(self) -> None:
+        with self._start_lock:
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._run_wrapper,
+                                                daemon=True)
+                self._thread.start()
+
+    def _run_wrapper(self) -> None:
+        try:
+            self._run()
+        finally:
+            self._done = True
+
+    def _init_ebits(self) -> frozenset:
+        """Bit per not-yet-satisfied ``eventually`` property
+        (`src/checker.rs:341-348`)."""
+        return frozenset(
+            i for i, p in enumerate(self._properties)
+            if p.expectation == Expectation.EVENTUALLY)
+
+    # --- Checker interface ----------------------------------------------
+    def model(self) -> Model:
+        return self._model
+
+    def state_count(self) -> int:
+        return self._state_count
+
+    def unique_state_count(self) -> int:
+        return self._unique_state_count
+
+    def join(self) -> "HostChecker":
+        self._start_background()
+        self._thread.join()
+        return self
+
+    def is_done(self) -> bool:
+        return self._done or (
+            len(self._discovery_fps) == len(self._properties))

@@ -1,0 +1,151 @@
+"""Trace reconstruction by model replay.
+
+Reference: ``Path`` (`/root/reference/src/checker/path.rs`). Engines store
+only 64-bit fingerprints; counterexample traces are materialized by replaying
+the model forward and matching fingerprints at every step (the TLC
+fingerprint technique).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+
+class NondeterministicModelError(RuntimeError):
+    """Raised when replay cannot re-derive a state recorded earlier.
+
+    Mirrors the diagnostic panics at `src/checker/path.rs:35-49` and
+    `:62-79`: this usually means ``init_states``/``actions``/``next_state``
+    vary across calls with the same inputs (hidden external state,
+    unordered-container iteration, randomness).
+    """
+
+
+class Path:
+    """A trace ``state --action--> state ... --action--> state``."""
+
+    def __init__(self, steps: List[Tuple[Any, Optional[Any]]]):
+        self._steps = steps
+
+    @staticmethod
+    def from_fingerprints(model, fingerprints: Sequence[int]) -> "Path":
+        """Reconstruct a path by replaying ``model`` along ``fingerprints``.
+
+        Reference: `src/checker/path.rs:20-86`.
+        """
+        fps = list(fingerprints)
+        if not fps:
+            raise NondeterministicModelError("empty path is invalid")
+        init_fp = fps[0]
+        last_state = None
+        for s in model.init_states():
+            if model.fingerprint(s) == init_fp:
+                last_state = s
+                break
+        if last_state is None:
+            raise NondeterministicModelError(
+                "Unable to reconstruct a Path from fingerprints: no init state "
+                f"has the expected fingerprint ({init_fp}). This usually means "
+                "Model.init_states varies across calls (hidden external state, "
+                "unordered iteration, or randomness). Available init "
+                f"fingerprints: {[model.fingerprint(s) for s in model.init_states()]}")
+        steps: List[Tuple[Any, Optional[Any]]] = []
+        for next_fp in fps[1:]:
+            found = None
+            for action, state in model.next_steps(last_state):
+                if model.fingerprint(state) == next_fp:
+                    found = (action, state)
+                    break
+            if found is None:
+                raise NondeterministicModelError(
+                    f"Unable to reconstruct a Path: {1 + len(steps)} previous "
+                    "state(s) were reconstructed, but no successor has the "
+                    f"next fingerprint ({next_fp}). This usually means "
+                    "Model.actions or Model.next_state vary across calls. "
+                    "Available next fingerprints: "
+                    f"{[model.fingerprint(s) for s in model.next_states(last_state)]}")
+            steps.append((last_state, found[0]))
+            last_state = found[1]
+        steps.append((last_state, None))
+        return Path(steps)
+
+    @staticmethod
+    def from_actions(model, init_state: Any,
+                     actions: Sequence[Any]) -> Optional["Path"]:
+        """Build a path from an init state and action list (`path.rs:90-112`)."""
+        if init_state not in model.init_states():
+            return None
+        steps: List[Tuple[Any, Optional[Any]]] = []
+        prev_state = init_state
+        for action in actions:
+            found = None
+            for a, s in model.next_steps(prev_state):
+                if a == action:
+                    found = (a, s)
+                    break
+            if found is None:
+                return None
+            steps.append((prev_state, found[0]))
+            prev_state = found[1]
+        steps.append((prev_state, None))
+        return Path(steps)
+
+    @staticmethod
+    def final_state(model, fingerprints: Sequence[int]) -> Optional[Any]:
+        """Final state of a fingerprint path, or None (`path.rs:115-136`)."""
+        fps = list(fingerprints)
+        if not fps:
+            return None
+        state = None
+        for s in model.init_states():
+            if model.fingerprint(s) == fps[0]:
+                state = s
+                break
+        if state is None:
+            return None
+        for next_fp in fps[1:]:
+            nxt = None
+            for s in model.next_states(state):
+                if model.fingerprint(s) == next_fp:
+                    nxt = s
+                    break
+            if nxt is None:
+                return None
+            state = nxt
+        return state
+
+    def last_state(self) -> Any:
+        return self._steps[-1][0]
+
+    def into_states(self) -> List[Any]:
+        return [s for s, _a in self._steps]
+
+    def into_actions(self) -> List[Any]:
+        return [a for _s, a in self._steps if a is not None]
+
+    def into_vec(self) -> List[Tuple[Any, Optional[Any]]]:
+        return list(self._steps)
+
+    def encode(self, model) -> str:
+        """Path as `/`-joined fingerprints — the Explorer address scheme."""
+        return "/".join(str(model.fingerprint(s)) for s, _a in self._steps)
+
+    def __len__(self) -> int:
+        return len(self._steps) - 1
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Path) and self._steps == other._steps
+
+    def __hash__(self) -> int:
+        return hash(tuple((repr(s), repr(a)) for s, a in self._steps))
+
+    def __repr__(self) -> str:
+        return f"Path({self._steps!r})"
+
+    def __str__(self) -> str:
+        """Reference display format (`path.rs:174-187`)."""
+        lines = [f"Path[{len(self)}]:"]
+        for _state, action in self._steps:
+            if action is not None:
+                lines.append(f"- {action!r}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,84 @@
+"""Symmetry reduction: representatives, rewrites, and rewrite plans.
+
+Reference: `/root/reference/src/checker/{representative,rewrite,rewrite_plan}.rs`.
+A ``RewritePlan`` is a permutation of dense ids (e.g. actor ``Id``s) derived
+by stably sorting a per-id value vector; recursively applying it to a state
+yields a behaviorally equivalent canonical member of the state's symmetry
+equivalence class. Only the DFS host engine applies symmetry (as in the
+reference); the TPU engine canonicalizes with the same plan semantics via
+argsort before hashing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+
+class Representative:
+    """Mixin marking states able to produce a canonical class member
+    (`representative.rs:65-68`)."""
+
+    def representative(self):
+        raise NotImplementedError
+
+
+def rewrite_value(value: Any, plan: "RewritePlan") -> Any:
+    """Recursively rewrite a value under ``plan`` (`rewrite.rs`).
+
+    Objects exposing ``rewrite(plan)`` delegate to it; containers recurse;
+    scalars are returned unchanged.
+    """
+    rw = getattr(value, "rewrite", None)
+    if rw is not None:
+        return rw(plan)
+    if isinstance(value, tuple):
+        return tuple(rewrite_value(v, plan) for v in value)
+    if isinstance(value, list):
+        return [rewrite_value(v, plan) for v in value]
+    if isinstance(value, frozenset):
+        return frozenset(rewrite_value(v, plan) for v in value)
+    if isinstance(value, set):
+        return {rewrite_value(v, plan) for v in value}
+    if isinstance(value, dict):
+        return {rewrite_value(k, plan): rewrite_value(v, plan)
+                for k, v in value.items()}
+    return value
+
+
+class RewritePlan:
+    """A dense-id permutation: ``plan[old_id] -> new_id``
+    (`rewrite_plan.rs:19-96`)."""
+
+    def __init__(self, mapping: Sequence[int]):
+        self.mapping = list(mapping)
+        # order[new_id] = old_id
+        self.order = [0] * len(self.mapping)
+        for old, new in enumerate(self.mapping):
+            self.order[new] = old
+
+    @staticmethod
+    def from_values_to_sort(values: Sequence[Any]) -> "RewritePlan":
+        """Plan that stably sorts ``values`` (`rewrite_plan.rs:74-96`).
+
+        The double-argsort: ``order`` = argsort(values) gives old index per
+        sorted position; inverting yields old->new. On TPU the same plan is
+        one ``jnp.argsort`` + scatter.
+        """
+        order = sorted(range(len(values)), key=lambda i: values[i])
+        mapping = [0] * len(values)
+        for new, old in enumerate(order):
+            mapping[old] = new
+        return RewritePlan(mapping)
+
+    def rewrite(self, x: int) -> int:
+        """Map an old id to its new id."""
+        return self.mapping[int(x)]
+
+    def reindex(self, indexed: Sequence[Any]) -> List[Any]:
+        """Permute a per-id collection into plan order, rewriting elements
+        (`rewrite_plan.rs:100-112`): ``result[new] = rewrite(indexed[old])``.
+        """
+        out = [rewrite_value(indexed[old], self) for old in self.order]
+        if isinstance(indexed, tuple):
+            return tuple(out)
+        return out

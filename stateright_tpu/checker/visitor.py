@@ -1,0 +1,79 @@
+"""Per-state visitation hooks.
+
+Reference: ``CheckerVisitor``/``PathRecorder``/``StateRecorder``
+(`/root/reference/src/checker/visitor.rs`). Visitors receive the full
+:class:`Path` to each evaluated state; ``PathRecorder`` doubles as a validity
+oracle because reconstructing an invalid path raises.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Set
+
+from .path import Path
+
+
+class CheckerVisitor:
+    """Applied to every evaluated state's path. Callables also qualify."""
+
+    def visit(self, model, path: Path) -> None:
+        raise NotImplementedError
+
+
+class _FnVisitor(CheckerVisitor):
+    def __init__(self, fn: Callable[[Path], None]):
+        self._fn = fn
+
+    def visit(self, model, path: Path) -> None:
+        self._fn(path)
+
+
+def as_visitor(v) -> CheckerVisitor:
+    if isinstance(v, CheckerVisitor):
+        return v
+    if callable(v):
+        return _FnVisitor(v)
+    raise TypeError(f"not a visitor: {v!r}")
+
+
+class PathRecorder(CheckerVisitor):
+    """Records the set of visited paths (`visitor.rs:46-67`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._paths: Set[Path] = set()
+
+    @classmethod
+    def new_with_accessor(cls):
+        recorder = cls()
+
+        def accessor() -> Set[Path]:
+            with recorder._lock:
+                return set(recorder._paths)
+        return recorder, accessor
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            self._paths.add(path)
+
+
+class StateRecorder(CheckerVisitor):
+    """Records evaluated states in visitation order (`visitor.rs:81-100`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: List = []
+
+    @classmethod
+    def new_with_accessor(cls):
+        recorder = cls()
+
+        def accessor() -> List:
+            with recorder._lock:
+                return list(recorder._states)
+        return recorder, accessor
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            self._states.append(path.last_state())

@@ -1,0 +1,141 @@
+"""Core model abstraction: the narrow waist everything funnels through.
+
+Mirrors the reference's 5-method ``Model`` trait (`/root/reference/src/lib.rs:155-237`)
+and ``Property``/``Expectation`` (`src/lib.rs:244-300`): anything expressible
+as ``state x action -> Optional[state]`` plus stable fingerprints is
+checkable — by the host engines or, via :class:`stateright_tpu.models.packed.PackedModel`,
+by the vmapped TPU engine.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+from .fingerprint import stable_fingerprint
+
+
+class Expectation(enum.Enum):
+    """Whether a property is always, eventually, or sometimes true.
+
+    Reference: ``Expectation`` (`src/lib.rs:290-300`).
+    """
+    ALWAYS = "always"
+    EVENTUALLY = "eventually"
+    SOMETIMES = "sometimes"
+
+
+@dataclass(frozen=True)
+class Property:
+    """A named predicate over (model, state).
+
+    Reference: ``Property`` (`src/lib.rs:244-288`). The condition signature is
+    ``condition(model, state) -> bool``.
+    """
+    expectation: Expectation
+    name: str
+    condition: Callable[[Any, Any], bool]
+
+    @staticmethod
+    def always(name: str, condition: Callable[[Any, Any], bool]) -> "Property":
+        """A safety property; the checker searches for a counterexample."""
+        return Property(Expectation.ALWAYS, name, condition)
+
+    @staticmethod
+    def eventually(name: str, condition: Callable[[Any, Any], bool]) -> "Property":
+        """A liveness property checked on terminal paths.
+
+        Caveat replicated from the reference (`src/lib.rs:263-267`): only
+        correct on acyclic paths; a path ending in a cycle is not treated as
+        terminating, yielding possible false negatives.
+        """
+        return Property(Expectation.EVENTUALLY, name, condition)
+
+    @staticmethod
+    def sometimes(name: str, condition: Callable[[Any, Any], bool]) -> "Property":
+        """A reachability property; the checker searches for an example."""
+        return Property(Expectation.SOMETIMES, name, condition)
+
+
+class Model:
+    """A nondeterministic transition system.
+
+    Subclasses implement ``init_states``, ``actions``, ``next_state`` and
+    optionally ``properties``/``within_boundary``. Reference: ``Model``
+    (`src/lib.rs:155-237`).
+    """
+
+    def init_states(self) -> List[Any]:
+        raise NotImplementedError
+
+    def actions(self, state: Any, actions: List[Any]) -> None:
+        """Append the possible actions from ``state`` onto ``actions``."""
+        raise NotImplementedError
+
+    def next_state(self, last_state: Any, action: Any) -> Optional[Any]:
+        """Apply ``action``; ``None`` indicates the action has no effect."""
+        raise NotImplementedError
+
+    def properties(self) -> List[Property]:
+        return []
+
+    def property(self, name: str) -> Property:
+        """Look up a property by name; raises if absent (`src/lib.rs:218-225`)."""
+        for p in self.properties():
+            if p.name == name:
+                return p
+        available = [p.name for p in self.properties()]
+        raise KeyError(
+            f"Unknown property. requested={name}, available={available}")
+
+    def within_boundary(self, state: Any) -> bool:
+        return True
+
+    def format_action(self, action: Any) -> str:
+        return repr(action)
+
+    def format_step(self, last_state: Any, action: Any) -> Optional[str]:
+        next_state = self.next_state(last_state, action)
+        return None if next_state is None else repr(next_state)
+
+    def as_svg(self, path) -> Optional[str]:
+        return None
+
+    def next_steps(self, last_state: Any) -> List[Tuple[Any, Any]]:
+        """(action, state) pairs reachable in one step (`src/lib.rs:192-202`)."""
+        actions: List[Any] = []
+        self.actions(last_state, actions)
+        steps = []
+        for action in actions:
+            state = self.next_state(last_state, action)
+            if state is not None:
+                steps.append((action, state))
+        return steps
+
+    def next_states(self, last_state: Any) -> List[Any]:
+        actions: List[Any] = []
+        self.actions(last_state, actions)
+        out = []
+        for action in actions:
+            state = self.next_state(last_state, action)
+            if state is not None:
+                out.append(state)
+        return out
+
+    def fingerprint(self, state: Any) -> int:
+        """Stable non-zero 64-bit fingerprint of ``state``.
+
+        Packed (TPU-checkable) models override this to hash their canonical
+        word encoding so host and device fingerprints agree.
+        """
+        return stable_fingerprint(state)
+
+    def checker(self) -> "CheckerBuilder":
+        from .checker import CheckerBuilder
+        return CheckerBuilder(self)
+
+
+def fingerprint(value: Any) -> int:
+    """Module-level fingerprint helper mirroring `src/lib.rs:306-311`."""
+    return stable_fingerprint(value)

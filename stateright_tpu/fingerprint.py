@@ -1,0 +1,200 @@
+"""Stable 64-bit state fingerprints.
+
+The reference derives fingerprints by feeding Rust's ``Hash`` into a
+fixed-key aHash (``/root/reference/src/lib.rs:303-344``). Build-stable
+fingerprints are load-bearing: path reconstruction replays the model and
+matches fingerprints (``src/checker/path.rs:20-86``) and the Explorer
+addresses states by fingerprint paths.
+
+We need the additional property that the *same* hash is computable both on
+host (Python) and on device (JAX/TPU, see ``stateright_tpu.ops.hash_kernel``)
+over a canonical ``uint32``-word encoding of a state. aHash is not
+TPU-friendly (it leans on AES rounds / 128-bit folded multiplies), so we
+instead use two independent murmur3-style 32-bit lanes combined into one
+64-bit digest. All arithmetic is 32-bit — exactly what the TPU VPU gives us.
+
+Layout contract (shared with the device kernel):
+  fp64(words) = (fmix32(h1 ^ n) << 32) | fmix32(h2 ^ n)
+  where h1/h2 are murmur3 accumulators over the words with distinct
+  constants, n = len(words). A zero digest is mapped to 1 (fingerprints are
+  non-zero, mirroring ``NonZeroU64`` in the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import Any, Iterable, List
+
+M32 = 0xFFFFFFFF
+
+# Lane 1: murmur3_x86_32 constants. Lane 2: first constant pair from
+# murmur3_x86_128. Both lanes use the standard murmur3 rotation schedule.
+C1_1, C2_1 = 0xCC9E2D51, 0x1B873593
+C1_2, C2_2 = 0x239B961B, 0xAB0E9789
+SEED1 = 0x9747B28C
+SEED2 = 0x85EBCA6B
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & M32
+
+
+def _fmix32(h: int) -> int:
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & M32
+    h ^= h >> 16
+    return h
+
+
+def fp64_words(words: Iterable[int]) -> int:
+    """Hash a sequence of uint32 words into a non-zero 64-bit fingerprint.
+
+    This is the host reference implementation; the device implementation in
+    ``ops/hash_kernel.py`` must match it bit-for-bit (differential-tested).
+    """
+    h1 = SEED1
+    h2 = SEED2
+    n = 0
+    for w in words:
+        w &= M32
+        k = (w * C1_1) & M32
+        k = _rotl32(k, 15)
+        k = (k * C2_1) & M32
+        h1 ^= k
+        h1 = _rotl32(h1, 13)
+        h1 = (h1 * 5 + 0xE6546B64) & M32
+
+        k = (w * C1_2) & M32
+        k = _rotl32(k, 16)
+        k = (k * C2_2) & M32
+        h2 ^= k
+        h2 = _rotl32(h2, 13)
+        h2 = (h2 * 5 + 0x561CCD1B) & M32
+        n += 1
+
+    h1 = _fmix32(h1 ^ n)
+    h2 = _fmix32(h2 ^ n)
+    fp = (h1 << 32) | h2
+    return fp if fp != 0 else 1
+
+
+# ---------------------------------------------------------------------------
+# Canonical word encoding of Python state values.
+#
+# Mirrors the reference's reliance on Rust ``#[derive(Hash)]`` plus the
+# order-insensitive containers in ``src/util.rs`` (``HashableHashSet`` hashes
+# each element, sorts the 64-bit hashes, and feeds them to the outer hasher —
+# ``util.rs:124-145``; ``HashableHashMap`` does the same per (k, v) entry —
+# ``util.rs:321-343``).
+# ---------------------------------------------------------------------------
+
+_TAG_NONE = 0
+_TAG_BOOL = 1
+_TAG_INT = 2
+_TAG_STR = 3
+_TAG_BYTES = 4
+_TAG_SEQ = 5
+_TAG_SET = 6
+_TAG_MAP = 7
+_TAG_OBJ = 8
+_TAG_ENUM = 9
+_TAG_FLOAT = 10
+
+
+def _emit_packed_bytes(data: bytes, out: List[int]) -> None:
+    out.append(len(data))
+    for i in range(0, len(data), 4):
+        out.append(int.from_bytes(data[i:i + 4], "little"))
+
+
+_CLASS_FP_CACHE: dict = {}
+
+
+def _class_fp(cls: type) -> int:
+    fp = _CLASS_FP_CACHE.get(cls)
+    if fp is None:
+        words: List[int] = []
+        _emit_packed_bytes(cls.__qualname__.encode(), words)
+        fp = fp64_words(words)
+        _CLASS_FP_CACHE[cls] = fp
+    return fp
+
+
+def stable_words(value: Any, out: List[int]) -> None:
+    """Append the canonical uint32-word encoding of ``value`` to ``out``."""
+    if value is None:
+        out.append(_TAG_NONE)
+    elif value is True or value is False:
+        out.append(_TAG_BOOL)
+        out.append(1 if value else 0)
+    elif isinstance(value, int) and not isinstance(value, enum.Enum):
+        out.append(_TAG_INT)
+        sign = 1 if value < 0 else 0
+        mag = -value if sign else value
+        mag_words: List[int] = []
+        while mag:
+            mag_words.append(mag & M32)
+            mag >>= 32
+        out.append(sign)
+        out.append(len(mag_words))
+        out.extend(mag_words)
+    elif isinstance(value, str):
+        out.append(_TAG_STR)
+        _emit_packed_bytes(value.encode(), out)
+    elif isinstance(value, (bytes, bytearray)):
+        out.append(_TAG_BYTES)
+        _emit_packed_bytes(bytes(value), out)
+    elif isinstance(value, enum.Enum):
+        out.append(_TAG_ENUM)
+        cfp = _class_fp(type(value))
+        out.append(cfp & M32)
+        out.append((cfp >> 32) & M32)
+        _emit_packed_bytes(value.name.encode(), out)
+    elif isinstance(value, (tuple, list)):
+        out.append(_TAG_SEQ)
+        out.append(len(value))
+        for item in value:
+            stable_words(item, out)
+    elif isinstance(value, (set, frozenset)):
+        # Order-insensitive: sorted element fingerprints (util.rs:124-145).
+        out.append(_TAG_SET)
+        out.append(len(value))
+        for fp in sorted(stable_fingerprint(item) for item in value):
+            out.append(fp & M32)
+            out.append((fp >> 32) & M32)
+    elif isinstance(value, dict):
+        # Order-insensitive: sorted entry fingerprints (util.rs:321-343).
+        out.append(_TAG_MAP)
+        out.append(len(value))
+        for fp in sorted(stable_fingerprint((k, v)) for k, v in value.items()):
+            out.append(fp & M32)
+            out.append((fp >> 32) & M32)
+    elif isinstance(value, float):
+        out.append(_TAG_FLOAT)
+        bits = struct.unpack("<Q", struct.pack("<d", value))[0]
+        out.append(bits & M32)
+        out.append((bits >> 32) & M32)
+    elif hasattr(value, "__stable_words__"):
+        value.__stable_words__(out)
+    elif dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out.append(_TAG_OBJ)
+        cfp = _class_fp(type(value))
+        out.append(cfp & M32)
+        out.append((cfp >> 32) & M32)
+        for f in dataclasses.fields(value):
+            stable_words(getattr(value, f.name), out)
+    else:
+        raise TypeError(
+            f"cannot stably fingerprint value of type {type(value)!r}; "
+            f"implement __stable_words__(out) or use a supported type")
+
+
+def stable_fingerprint(value: Any) -> int:
+    """Non-zero 64-bit stable fingerprint of an arbitrary state value."""
+    words: List[int] = []
+    stable_words(value, words)
+    return fp64_words(words)

@@ -1,0 +1,19 @@
+"""Model library: test fixtures and packed (TPU-checkable) models."""
+
+from .fixtures import (
+    BinaryClock,
+    BinaryClockAction,
+    DGraph,
+    FnModel,
+    Guess,
+    LinearEquation,
+)
+
+__all__ = [
+    "BinaryClock",
+    "BinaryClockAction",
+    "DGraph",
+    "FnModel",
+    "Guess",
+    "LinearEquation",
+]

@@ -1,0 +1,138 @@
+"""Deterministic fixture models for engine tests.
+
+Ports of the reference's test fixtures (`/root/reference/src/test_util.rs`):
+``BinaryClock`` (2-state toggle), ``DGraph`` (digraph from path lists; pins
+the exact — including knowingly unsound — ``eventually`` semantics),
+``FnModel`` (lambda models), and ``LinearEquation`` (2^16-state Diophantine
+search, the engine-test workhorse). Their exact state counts anchor many
+tests and are the correctness oracle for the TPU engine.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, List, Optional, Set
+
+from ..core import Model, Property
+
+
+class BinaryClockAction(enum.Enum):
+    GO_LOW = "GoLow"
+    GO_HIGH = "GoHigh"
+
+
+class BinaryClock(Model):
+    """Cycles between two states (`test_util.rs:4-46`)."""
+
+    def init_states(self):
+        return [0, 1]
+
+    def actions(self, state, actions):
+        if state == 0:
+            actions.append(BinaryClockAction.GO_HIGH)
+        else:
+            actions.append(BinaryClockAction.GO_LOW)
+
+    def next_state(self, state, action):
+        return 1 if action == BinaryClockAction.GO_HIGH else 0
+
+    def properties(self):
+        return [Property.always("in [0, 1]", lambda _, s: 0 <= s <= 1)]
+
+
+class DGraph(Model):
+    """A digraph specified via paths from initial states
+    (`test_util.rs:49-117`)."""
+
+    def __init__(self, prop: Property):
+        self.inits: Set[int] = set()
+        self.edges: Dict[int, Set[int]] = {}
+        self.prop = prop
+
+    @staticmethod
+    def with_property(prop: Property) -> "DGraph":
+        return DGraph(prop)
+
+    def with_path(self, path: List[int]) -> "DGraph":
+        g = DGraph(self.prop)
+        g.inits = set(self.inits)
+        g.edges = {k: set(v) for k, v in self.edges.items()}
+        src = path[0]
+        g.inits.add(src)
+        for dst in path[1:]:
+            g.edges.setdefault(src, set()).add(dst)
+            src = dst
+        return g
+
+    def check(self):
+        return self.checker().spawn_bfs().join()
+
+    def init_states(self):
+        return sorted(self.inits)
+
+    def actions(self, state, actions):
+        actions.extend(sorted(self.edges.get(state, ())))
+
+    def next_state(self, state, action):
+        return action
+
+    def properties(self):
+        return [self.prop]
+
+
+class FnModel(Model):
+    """A model defined by one function, mirroring the reference's
+    ``fn(Option<&T>, &mut Vec<T>)`` models (`test_util.rs:120-138`).
+
+    ``fn(prev_state_or_None, out_list)`` appends init states when given
+    ``None`` and successor "actions" (which are the states) otherwise.
+    """
+
+    def __init__(self, fn: Callable[[Optional[object], List], None]):
+        self.fn = fn
+
+    def init_states(self):
+        out: List = []
+        self.fn(None, out)
+        return out
+
+    def actions(self, state, actions):
+        self.fn(state, actions)
+
+    def next_state(self, state, action):
+        return action
+
+
+class Guess(enum.Enum):
+    INCREASE_X = "IncreaseX"
+    INCREASE_Y = "IncreaseY"
+
+    def __repr__(self):
+        return self.value
+
+
+class LinearEquation(Model):
+    """Finds x, y in u8 with a*x + b*y == c (mod 256)
+    (`test_util.rs:141-188`)."""
+
+    def __init__(self, a: int, b: int, c: int):
+        self.a, self.b, self.c = a, b, c
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state, actions):
+        actions.append(Guess.INCREASE_X)
+        actions.append(Guess.INCREASE_Y)
+
+    def next_state(self, state, action):
+        x, y = state
+        if action == Guess.INCREASE_X:
+            return ((x + 1) & 0xFF, y)
+        return (x, (y + 1) & 0xFF)
+
+    def properties(self):
+        def solvable(model, solution):
+            x, y = solution
+            return (model.a * x + model.b * y) & 0xFF == model.c
+        return [Property.sometimes("solvable", solvable)]
