@@ -1,0 +1,291 @@
+"""The TPU-native checking engine: ``CheckerBuilder.spawn_tpu()``.
+
+Re-design of the reference's BFS hot loop (`/root/reference/src/checker/bfs.rs:165-274`)
+for the XLA compilation model. Instead of threads popping one state at a time
+from a shared deque with DashMap dedup, the frontier is a device-resident
+batch of packed states and one jitted *level step* fuses everything the
+reference does per state:
+
+  * property evaluation (always/sometimes masks + eventually-bit clearing)
+    via the model's vmapped ``packed_properties`` — fused into the step, no
+    host round-trip per state;
+  * expansion via vmapped ``packed_step`` (the action axis is the
+    nondeterminism axis; disabled actions, no-op transitions and boundary
+    violations are mask bits, mirroring ``next_state -> None`` pruning);
+  * fingerprinting via the device hash kernel (`ops/hash_kernel.py`);
+  * visited-set dedup via batched parallel insert into an HBM-resident
+    open-addressed table (`ops/hashtable.py`).
+
+The host orchestrates: it pulls per-level masks/fingerprints (small), keeps
+the (fingerprint -> parent-fingerprint) mirror used for trace reconstruction
+by replay (the TLC technique, `bfs.rs:314-342`), records discoveries, and
+builds the next frontier by index-gather on device — packed states never
+round-trip to the host.
+
+Semantic differences vs the host engines (both documented and benign):
+  * work granularity is a frontier segment, not a single state, so
+    ``state_count``/``unique_state_count`` may exceed the host engines'
+    values on early-exit runs (the reference's own multithreaded runs are
+    similarly nondeterministic); full-enumeration unique counts match
+    exactly;
+  * which duplicate within a batch wins a slot (and thus which parent a
+    state records) is unspecified — the reference tolerates the same benign
+    DashMap race (`bfs.rs:198,206,268`).
+
+The ``eventually`` semantics replicate the reference's documented caveats
+(`bfs.rs:239-256`): ebits ride per-frontier-row (bit i = property i not yet
+satisfied on this path), are not part of the fingerprint, and joins/cycles
+are not treated as terminal.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import Expectation
+from .builder import CheckerBuilder
+from .host import HostChecker
+from .path import Path
+
+_MIN_BUCKET = 16
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max((n - 1).bit_length(), 0)
+
+
+def _bucket(n: int) -> int:
+    return max(_MIN_BUCKET, _next_pow2(n))
+
+
+class TpuChecker(HostChecker):
+    """Level-synchronous device BFS over a packed model."""
+
+    def __init__(self, builder: CheckerBuilder):
+        model = builder.model
+        for attr in ("packed_width", "max_actions", "encode", "packed_step",
+                     "packed_properties"):
+            if not hasattr(model, attr):
+                raise TypeError(
+                    f"spawn_tpu() requires a PackedModel (missing {attr!r}); "
+                    "see stateright_tpu.models.packed.PackedModel. Host-only "
+                    "models can use spawn_bfs()/spawn_dfs().")
+        super().__init__(builder)
+        opts = builder.tpu_options_
+        self._capacity = int(opts.get("capacity", 1 << 20))
+        assert self._capacity & (self._capacity - 1) == 0, \
+            "capacity must be a power of two"
+        self._max_segment = int(opts.get("max_segment", 1 << 15))
+        self._grow_at = float(opts.get("grow_at", 0.55))
+        # fingerprint -> parent fingerprint mirror (host side; the
+        # checkpointable search record, also used for path reconstruction).
+        self._generated: Dict[int, Optional[int]] = {}
+        if builder.symmetry_fn_ is not None:
+            raise NotImplementedError(
+                "symmetry reduction on the TPU engine requires a packed "
+                "canonicalization; use spawn_dfs() for symmetry or provide "
+                "packed_representative (planned).")
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.hash_kernel import fp64_device
+        from ..ops.hashtable import make_table, table_insert
+
+        model = self._model
+        properties = self._properties
+        prop_count = len(properties)
+        width = model.packed_width
+        n_actions = model.max_actions
+        eventually_idx = [i for i, p in enumerate(properties)
+                         if p.expectation == Expectation.EVENTUALLY]
+        full_ebits = np.uint32(sum(1 << i for i in eventually_idx))
+        generated = self._generated
+        discoveries = self._discovery_fps
+        target = self._target_state_count
+        visitor = self._visitor
+
+        # --- jitted level step -----------------------------------------
+        def level_fn(frontier, fvalid, ebits, key_hi, key_lo):
+            pbits = jax.vmap(model.packed_properties)(frontier)  # [F, P]
+            if eventually_idx:
+                sat_bits = jnp.zeros(
+                    (frontier.shape[0],), dtype=jnp.uint32)
+                for i in eventually_idx:
+                    sat_bits = sat_bits | jnp.where(
+                        pbits[:, i], jnp.uint32(1 << i), jnp.uint32(0))
+                ebits = ebits & ~sat_bits
+            succ, avalid = jax.vmap(model.packed_step)(frontier)
+            avalid = avalid & fvalid[:, None]
+            flat = succ.reshape((-1, width))
+            fhi, flo = fp64_device(flat)
+            phi, plo = fp64_device(frontier)
+            inserted, key_hi, key_lo, overflow = table_insert(
+                key_hi, key_lo, fhi, flo, avalid.reshape(-1))
+            terminal = fvalid & ~avalid.any(axis=1)
+            gen_count = avalid.sum(dtype=jnp.int32)
+            return (key_hi, key_lo, flat, inserted, fhi, flo, phi, plo,
+                    pbits, ebits, terminal, gen_count, overflow)
+
+        level_fn = jax.jit(level_fn)
+
+        def gather_fn(flat, ebits_new, idx):
+            return flat[idx], ebits_new[idx // n_actions]
+
+        gather_fn = jax.jit(gather_fn)
+
+        insert_fn = jax.jit(table_insert)
+
+        # --- init -------------------------------------------------------
+        init_states = [s for s in model.init_states()
+                       if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        init_rows: List[np.ndarray] = []
+        for s in init_states:
+            fp = model.fingerprint(s)
+            if fp not in generated:
+                generated[fp] = None
+                init_rows.append(model.encode(s))
+        self._unique_state_count = len(generated)
+
+        key_hi, key_lo = make_table(self._capacity)
+        key_hi, key_lo = self._bulk_insert(
+            insert_fn, key_hi, key_lo, list(generated.keys()))
+
+        segments: deque = deque()
+        for start in range(0, len(init_rows), self._max_segment):
+            chunk = init_rows[start:start + self._max_segment]
+            fcount = len(chunk)
+            bucket = _bucket(fcount)
+            rows = np.zeros((bucket, width), dtype=np.uint32)
+            rows[:fcount] = np.stack(chunk)
+            fvalid = np.arange(bucket) < fcount
+            ebits = np.full((bucket,), full_ebits, dtype=np.uint32)
+            segments.append((jnp.asarray(rows), jnp.asarray(fvalid),
+                             jnp.asarray(ebits)))
+
+        # --- search loop ------------------------------------------------
+        while segments:
+            if len(discoveries) == prop_count:
+                return
+            frontier, fvalid, ebits = segments.popleft()
+            (key_hi, key_lo, flat, inserted_d, fhi_d, flo_d, phi_d, plo_d,
+             pbits_d, ebits_d, terminal_d, gen_count_d, overflow_d) = \
+                level_fn(frontier, fvalid, ebits, key_hi, key_lo)
+            (inserted, fhi, flo, phi, plo, pbits, ebits_np, terminal,
+             gen_count, overflow, fvalid_np) = jax.device_get(
+                (inserted_d, fhi_d, flo_d, phi_d, plo_d, pbits_d, ebits_d,
+                 terminal_d, gen_count_d, overflow_d, fvalid))
+            if overflow:
+                raise RuntimeError(
+                    "device hash table overflow (capacity "
+                    f"{self._capacity}); raise via "
+                    "checker_builder.tpu_options(capacity=...)")
+
+            self._state_count += int(gen_count)
+            frontier_fps = (phi.astype(np.uint64) << np.uint64(32)) \
+                | plo.astype(np.uint64)
+            child_fps = (fhi.astype(np.uint64) << np.uint64(32)) \
+                | flo.astype(np.uint64)
+
+            if visitor is not None:
+                for k in np.nonzero(fvalid_np)[0]:
+                    visitor.visit(
+                        model, self._reconstruct_path(int(frontier_fps[k])))
+
+            # discoveries: always/sometimes on the evaluated frontier rows
+            for i, prop in enumerate(properties):
+                if prop.name in discoveries:
+                    continue
+                if prop.expectation == Expectation.ALWAYS:
+                    mask = fvalid_np & ~pbits[:, i]
+                elif prop.expectation == Expectation.SOMETIMES:
+                    mask = fvalid_np & pbits[:, i]
+                else:
+                    continue
+                hits = np.nonzero(mask)[0]
+                if hits.size:
+                    discoveries[prop.name] = int(frontier_fps[hits[0]])
+            # eventually: flushed at terminal rows with bits remaining
+            if eventually_idx:
+                term_hits = np.nonzero(
+                    fvalid_np & terminal & (ebits_np != 0))[0]
+                for k in term_hits:
+                    bits = int(ebits_np[k])
+                    for i in eventually_idx:
+                        if bits & (1 << i) and \
+                                properties[i].name not in discoveries:
+                            discoveries[properties[i].name] = \
+                                int(frontier_fps[k])
+
+            # mirror the newly inserted (fingerprint, parent) pairs
+            new_idx = np.nonzero(inserted)[0]
+            for k in new_idx:
+                generated[int(child_fps[k])] = \
+                    int(frontier_fps[k // n_actions])
+            self._unique_state_count = len(generated)
+
+            if len(discoveries) == prop_count:
+                return
+            if target is not None and self._state_count >= target:
+                return
+
+            # grow the table before it saturates
+            if len(generated) > self._grow_at * self._capacity:
+                self._capacity *= 4
+                key_hi, key_lo = make_table(self._capacity)
+                key_hi, key_lo = self._bulk_insert(
+                    insert_fn, key_hi, key_lo, list(generated.keys()))
+
+            # next frontier segments: device gather of winner rows
+            for start in range(0, len(new_idx), self._max_segment):
+                group = new_idx[start:start + self._max_segment]
+                bucket = _bucket(len(group))
+                idx = np.zeros((bucket,), dtype=np.int32)
+                idx[:len(group)] = group
+                new_fvalid = np.arange(bucket) < len(group)
+                rows, eb = gather_fn(flat, ebits_d, jnp.asarray(idx))
+                segments.append((rows, jnp.asarray(new_fvalid), eb))
+
+    # ------------------------------------------------------------------
+    def _bulk_insert(self, insert_fn, key_hi, key_lo, fps: List[int]):
+        """(Re)insert known fingerprints, e.g. at init or after growth."""
+        import jax.numpy as jnp
+        chunk_size = 1 << 16
+        for start in range(0, len(fps), chunk_size):
+            chunk = fps[start:start + chunk_size]
+            n = _bucket(len(chunk))
+            arr = np.zeros((n,), dtype=np.uint64)
+            arr[:len(chunk)] = np.asarray(chunk, dtype=np.uint64)
+            valid = np.arange(n) < len(chunk)
+            _, key_hi, key_lo, overflow = insert_fn(
+                key_hi, key_lo,
+                jnp.asarray((arr >> np.uint64(32)).astype(np.uint32)),
+                jnp.asarray(arr.astype(np.uint32)),
+                jnp.asarray(valid))
+            if bool(overflow):
+                raise RuntimeError(
+                    "device hash table overflow during bulk insert")
+        return key_hi, key_lo
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        fingerprints: deque = deque()
+        next_fp = fp
+        while next_fp in self._generated:
+            parent = self._generated[next_fp]
+            fingerprints.appendleft(next_fp)
+            if parent is None:
+                break
+            next_fp = parent
+        return Path.from_fingerprints(self._model, fingerprints)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct_path(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }

@@ -1,0 +1,190 @@
+"""The packed-model protocol: the keystone of the TPU engine.
+
+A :class:`PackedModel` is an ordinary :class:`~stateright_tpu.core.Model`
+that additionally defines a canonical fixed-width ``uint32``-word encoding of
+its states and batched (vmappable) JAX implementations of its transition
+relation and properties. The TPU engine (`checker/tpu.py`) runs entirely on
+the packed representation; the inherited host methods remain the oracle for
+differential testing and for trace replay of device-discovered
+counterexamples.
+
+The host/device contract (checked by :func:`validate_packed_model`):
+  * ``fingerprint(state) == fp64_words(encode(state))`` — host and device
+    fingerprints agree bit-for-bit;
+  * the multiset of valid successors of ``packed_step(encode(s))`` equals
+    ``{encode(t) for t in next_states(s)}``;
+  * ``packed_properties(encode(s))[i] == properties()[i].condition(self, s)``;
+  * states outside ``within_boundary`` are masked invalid by ``packed_step``.
+
+This plays the role of the reference's ``Hash``-derived state encoding
+(`/root/reference/src/lib.rs:303-311`) — but as an explicit, device-resident
+struct-of-words layout rather than a hasher side effect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from ..core import Model
+from ..fingerprint import fp64_words
+
+
+class PackedModel(Model):
+    """Mixin adding the packed/TPU interface to a model."""
+
+    #: number of uint32 words per packed state
+    packed_width: int
+    #: static upper bound on actions per state
+    max_actions: int
+
+    def encode(self, state: Any) -> np.ndarray:
+        """Canonical ``uint32[packed_width]`` encoding of ``state``."""
+        raise NotImplementedError
+
+    def decode(self, words) -> Any:
+        """Inverse of :meth:`encode` (used for debugging/witness dumps)."""
+        raise NotImplementedError
+
+    def packed_step(self, words):
+        """JAX transition relation for one packed state.
+
+        Args:
+          words: uint32[packed_width] traced array.
+        Returns:
+          (successors uint32[max_actions, packed_width],
+           valid bool[max_actions]) — row ``a`` is the result of action
+          ``a``; invalid rows cover disabled actions, no-op transitions
+          (the reference's ``next_state -> None``), and out-of-boundary
+          successors.
+        """
+        raise NotImplementedError
+
+    def packed_properties(self, words):
+        """JAX evaluation of all properties for one packed state.
+
+        Returns bool[P] in ``self.properties()`` order.
+        """
+        raise NotImplementedError
+
+    def fingerprint(self, state: Any) -> int:
+        return fp64_words(self.encode(state).tolist())
+
+
+def validate_packed_model(model: PackedModel, max_states: int = 2000) -> int:
+    """BFS-walk the host model, checking the host/device contract state by
+    state. Returns the number of states validated. Test helper."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.hash_kernel import fp64_device
+
+    step = jax.jit(model.packed_step)
+    props = jax.jit(model.packed_properties)
+    properties = model.properties()
+
+    seen = set()
+    queue = list(model.init_states())
+    checked = 0
+    while queue and checked < max_states:
+        state = queue.pop()
+        fp = model.fingerprint(state)
+        if fp in seen:
+            continue
+        seen.add(fp)
+        checked += 1
+
+        enc = model.encode(state)
+        assert enc.dtype == np.uint32 and enc.shape == (model.packed_width,), \
+            f"encode() must return uint32[{model.packed_width}], got " \
+            f"{enc.dtype}[{enc.shape}]"
+        # decode round-trips through encode
+        redec = model.decode(enc)
+        assert np.array_equal(model.encode(redec), enc), \
+            f"decode(encode(s)) != s for {state!r}"
+        # device fingerprint matches host fingerprint
+        dhi, dlo = fp64_device(jnp.asarray(enc)[None, :])
+        dev_fp = (int(dhi[0]) << 32) | int(dlo[0])
+        assert dev_fp == fp, \
+            f"device fp {dev_fp:#x} != host fp {fp:#x} for {state!r}"
+        # packed successors match host successors (as multisets of encodings)
+        succ, valid = step(jnp.asarray(enc))
+        succ = np.asarray(succ)
+        valid = np.asarray(valid)
+        packed_succ = sorted(tuple(succ[a].tolist())
+                             for a in range(model.max_actions) if valid[a])
+        host_succ = sorted(tuple(model.encode(t).tolist())
+                           for t in model.next_states(state)
+                           if model.within_boundary(t))
+        assert packed_succ == host_succ, \
+            f"packed successors disagree with host successors for {state!r}:" \
+            f"\n packed={packed_succ}\n host={host_succ}"
+        # packed properties match host property conditions
+        pb = np.asarray(props(jnp.asarray(enc)))
+        for i, prop in enumerate(properties):
+            want = bool(prop.condition(model, state))
+            assert bool(pb[i]) == want, \
+                f"packed property {prop.name!r} = {bool(pb[i])} != host " \
+                f"{want} for {state!r}"
+        for t in model.next_states(state):
+            if model.within_boundary(t):
+                queue.append(t)
+    return checked
+
+
+class PackedLinearEquation(PackedModel):
+    """Packed version of the LinearEquation fixture
+    (`/root/reference/src/test_util.rs:141-188`): state (x, y) in u8 x u8,
+    two increment actions. The minimal differential workload for the TPU
+    engine (full enumeration = 65,536 unique states, `bfs.rs:371`)."""
+
+    packed_width = 2
+    max_actions = 2
+
+    def __init__(self, a: int, b: int, c: int):
+        self.a, self.b, self.c = a, b, c
+
+    # --- host side (mirrors models.fixtures.LinearEquation) -------------
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state, actions):
+        actions.extend(["IncreaseX", "IncreaseY"])
+
+    def next_state(self, state, action):
+        x, y = state
+        return ((x + 1) & 0xFF, y) if action == "IncreaseX" \
+            else (x, (y + 1) & 0xFF)
+
+    def properties(self):
+        from ..core import Property
+
+        def solvable(model, solution):
+            x, y = solution
+            return (model.a * x + model.b * y) & 0xFF == model.c
+        return [Property.sometimes("solvable", solvable)]
+
+    # --- packed side -----------------------------------------------------
+    def encode(self, state):
+        return np.array(state, dtype=np.uint32)
+
+    def decode(self, words):
+        return (int(words[0]), int(words[1]))
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+        x, y = words[0], words[1]
+        succ = jnp.stack([
+            jnp.stack([(x + 1) & 0xFF, y]),
+            jnp.stack([x, (y + 1) & 0xFF]),
+        ]).astype(jnp.uint32)
+        valid = jnp.ones((2,), dtype=bool)
+        return succ, valid
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        x, y = words[0], words[1]
+        sat = ((jnp.uint32(self.a) * x + jnp.uint32(self.b) * y) & 0xFF) \
+            == self.c
+        return jnp.stack([sat])

@@ -1,0 +1,219 @@
+"""Two-phase commit, from Gray & Lamport's "Consensus on Transaction Commit".
+
+Same protocol as the reference example (`/root/reference/examples/2pc.rs`):
+N resource managers (RMs) and one transaction manager (TM) exchange messages
+through a persistent message set. Deterministic oracle counts: 3 RMs -> 288
+unique states, 5 RMs -> 8,832, 5 RMs + symmetry -> 665 (`2pc.rs:125-139`).
+
+This is the TPU engine's minimum end-to-end model: the whole state packs
+into 4 uint32 words (RM states as 2-bit fields, TM state, a prepared bitmask
+and a message bitset), so expansion, hashing and property evaluation all run
+as pure uint32 bit-ops on device.
+
+State (host view): ``(rm_state, tm_state, tm_prepared, msgs)`` where
+``rm_state`` is a tuple of per-RM codes, ``tm_prepared`` a tuple of 0/1 and
+``msgs`` a frozenset of message codes. Message codes: ``rm`` for
+Prepared{rm}, 16 for Commit, 17 for Abort (N <= 16).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..core import Property
+from ..checker.representative import RewritePlan
+from .packed import PackedModel
+
+# RM state codes, in the reference's Ord order (RmState in 2pc.rs:27).
+WORKING, PREPARED, COMMITTED, ABORTED = 0, 1, 2, 3
+# TM state codes (TmState in 2pc.rs:30).
+TM_INIT, TM_COMMITTED, TM_ABORTED = 0, 1, 2
+MSG_COMMIT = 16
+MSG_ABORT = 17
+
+State = Tuple[Tuple[int, ...], int, Tuple[int, ...], frozenset]
+
+
+class TwoPhaseSys(PackedModel):
+    packed_width = 4
+
+    def __init__(self, n: int):
+        assert 1 <= n <= 16, "packed 2pc supports up to 16 RMs"
+        self.n = n
+        self.max_actions = 2 + 5 * n
+
+    # ------------------------------------------------------------------
+    # Host side (2pc.rs:43-121)
+    # ------------------------------------------------------------------
+    def init_states(self) -> List[State]:
+        return [((WORKING,) * self.n, TM_INIT, (0,) * self.n, frozenset())]
+
+    def actions(self, state: State, actions: List) -> None:
+        rm_state, tm_state, tm_prepared, msgs = state
+        if tm_state == TM_INIT and all(tm_prepared):
+            actions.append(("TmCommit",))
+        if tm_state == TM_INIT:
+            actions.append(("TmAbort",))
+        for rm in range(self.n):
+            if tm_state == TM_INIT and rm in msgs:
+                actions.append(("TmRcvPrepared", rm))
+            if rm_state[rm] == WORKING:
+                actions.append(("RmPrepare", rm))
+            if rm_state[rm] == WORKING:
+                actions.append(("RmChooseToAbort", rm))
+            if MSG_COMMIT in msgs:
+                actions.append(("RmRcvCommitMsg", rm))
+            if MSG_ABORT in msgs:
+                actions.append(("RmRcvAbortMsg", rm))
+
+    def next_state(self, state: State, action) -> State:
+        rm_state, tm_state, tm_prepared, msgs = state
+        kind = action[0]
+        if kind == "TmRcvPrepared":
+            rm = action[1]
+            tm_prepared = tm_prepared[:rm] + (1,) + tm_prepared[rm + 1:]
+        elif kind == "TmCommit":
+            tm_state = TM_COMMITTED
+            msgs = msgs | {MSG_COMMIT}
+        elif kind == "TmAbort":
+            tm_state = TM_ABORTED
+            msgs = msgs | {MSG_ABORT}
+        elif kind == "RmPrepare":
+            rm = action[1]
+            rm_state = rm_state[:rm] + (PREPARED,) + rm_state[rm + 1:]
+            msgs = msgs | {rm}
+        elif kind == "RmChooseToAbort":
+            rm = action[1]
+            rm_state = rm_state[:rm] + (ABORTED,) + rm_state[rm + 1:]
+        elif kind == "RmRcvCommitMsg":
+            rm = action[1]
+            rm_state = rm_state[:rm] + (COMMITTED,) + rm_state[rm + 1:]
+        elif kind == "RmRcvAbortMsg":
+            rm = action[1]
+            rm_state = rm_state[:rm] + (ABORTED,) + rm_state[rm + 1:]
+        else:
+            raise ValueError(f"unknown action {action!r}")
+        return (rm_state, tm_state, tm_prepared, msgs)
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.sometimes(
+                "abort agreement",
+                lambda _, s: all(r == ABORTED for r in s[0])),
+            Property.sometimes(
+                "commit agreement",
+                lambda _, s: all(r == COMMITTED for r in s[0])),
+            Property.always(
+                "consistent",
+                lambda _, s: not (any(r == ABORTED for r in s[0])
+                                  and any(r == COMMITTED for r in s[0]))),
+        ]
+
+    def representative(self, state: State) -> State:
+        """Canonical member under RM-permutation symmetry (2pc.rs:165-182)."""
+        rm_state, tm_state, tm_prepared, msgs = state
+        plan = RewritePlan.from_values_to_sort(rm_state)
+        return (
+            tuple(plan.reindex(rm_state)),
+            tm_state,
+            tuple(plan.reindex(tm_prepared)),
+            frozenset(plan.rewrite(m) if m < 16 else m for m in msgs),
+        )
+
+    def format_action(self, action) -> str:
+        return action[0] + (f"({action[1]})" if len(action) > 1 else "")
+
+    # ------------------------------------------------------------------
+    # Packed side: words = [rm_fields, tm_state, prepared_bits, msg_bits]
+    # ------------------------------------------------------------------
+    def encode(self, state: State) -> np.ndarray:
+        rm_state, tm_state, tm_prepared, msgs = state
+        rmw = 0
+        for i, r in enumerate(rm_state):
+            rmw |= r << (2 * i)
+        prep = 0
+        for i, p in enumerate(tm_prepared):
+            prep |= int(bool(p)) << i
+        msgw = 0
+        for m in msgs:
+            msgw |= 1 << m
+        return np.array([rmw, tm_state, prep, msgw], dtype=np.uint32)
+
+    def decode(self, words) -> State:
+        rmw, tm_state, prep, msgw = (int(w) for w in words)
+        rm_state = tuple((rmw >> (2 * i)) & 3 for i in range(self.n))
+        tm_prepared = tuple((prep >> i) & 1 for i in range(self.n))
+        msgs = frozenset(m for m in range(18) if msgw & (1 << m))
+        return (rm_state, tm_state, tm_prepared, msgs)
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+        n = self.n
+        rmw, tm, prep, msgs = words[0], words[1], words[2], words[3]
+        all_mask = (1 << n) - 1
+        tm_init = tm == TM_INIT
+        commit_bit = jnp.uint32(1 << MSG_COMMIT)
+        abort_bit = jnp.uint32(1 << MSG_ABORT)
+        has_commit = (msgs & commit_bit) != 0
+        has_abort = (msgs & abort_bit) != 0
+
+        succs = []
+        valids = []
+
+        def emit(valid, w0=None, w1=None, w2=None, w3=None):
+            succs.append(jnp.stack([
+                rmw if w0 is None else w0,
+                tm if w1 is None else w1,
+                prep if w2 is None else w2,
+                msgs if w3 is None else w3,
+            ]).astype(jnp.uint32))
+            valids.append(valid)
+
+        # TmCommit / TmAbort
+        emit(tm_init & ((prep & all_mask) == all_mask),
+             w1=jnp.uint32(TM_COMMITTED), w3=msgs | commit_bit)
+        emit(tm_init, w1=jnp.uint32(TM_ABORTED), w3=msgs | abort_bit)
+
+        for rm in range(n):
+            shift = 2 * rm
+            field = (rmw >> shift) & 3
+            is_working = field == WORKING
+            cleared = rmw & jnp.uint32(~(3 << shift) & 0xFFFFFFFF)
+            rm_bit = jnp.uint32(1 << rm)
+            # TmRcvPrepared(rm)
+            emit(tm_init & ((msgs & rm_bit) != 0), w2=prep | rm_bit)
+            # RmPrepare(rm)
+            emit(is_working,
+                 w0=cleared | jnp.uint32(PREPARED << shift),
+                 w3=msgs | rm_bit)
+            # RmChooseToAbort(rm)
+            emit(is_working, w0=cleared | jnp.uint32(ABORTED << shift))
+            # RmRcvCommitMsg(rm)
+            emit(has_commit, w0=cleared | jnp.uint32(COMMITTED << shift))
+            # RmRcvAbortMsg(rm)
+            emit(has_abort, w0=cleared | jnp.uint32(ABORTED << shift))
+
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        n = self.n
+        rmw = words[0]
+        pat_aborted = 0
+        pat_committed = 0
+        for i in range(n):
+            pat_aborted |= ABORTED << (2 * i)
+            pat_committed |= COMMITTED << (2 * i)
+        any_aborted = jnp.bool_(False)
+        any_committed = jnp.bool_(False)
+        for i in range(n):
+            field = (rmw >> (2 * i)) & 3
+            any_aborted = any_aborted | (field == ABORTED)
+            any_committed = any_committed | (field == COMMITTED)
+        return jnp.stack([
+            rmw == pat_aborted,
+            rmw == pat_committed,
+            ~(any_aborted & any_committed),
+        ])

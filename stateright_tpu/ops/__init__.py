@@ -1,0 +1,1 @@
+"""Device ops: fingerprint hash kernel and HBM-resident hash table."""

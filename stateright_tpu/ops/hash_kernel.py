@@ -1,0 +1,77 @@
+"""Device fingerprint kernel.
+
+Computes the same 64-bit fingerprint as the host implementation in
+``stateright_tpu.fingerprint`` (two murmur3-style uint32 lanes), bit-for-bit,
+over batches of packed state words. All arithmetic is uint32 — TPU VPU
+native; no 64-bit emulation needed. The fingerprint is returned as an
+``(hi, lo)`` uint32 pair (JAX's default x64-disabled mode has no uint64).
+
+This replaces the reference's fixed-key aHash (`/root/reference/src/lib.rs:331-344`)
+as the stable state digest; stability across runs is load-bearing for path
+reconstruction and Explorer URLs, and host/device agreement is load-bearing
+for differential testing and host replay of device-discovered traces.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..fingerprint import (
+    C1_1, C1_2, C2_1, C2_2, SEED1, SEED2,
+)
+
+
+def _rotl(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _fmix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def fp64_device(words: jax.Array):
+    """Fingerprint a batch of packed states.
+
+    Args:
+      words: uint32[N, W] — one packed state per row.
+
+    Returns:
+      (hi, lo): uint32[N] pair; ``(hi << 32) | lo`` equals
+      ``fingerprint.fp64_words(row)`` for every row. ``(0, 0)`` never occurs
+      (remapped to ``(0, 1)``, mirroring the host's non-zero contract).
+    """
+    words = words.astype(jnp.uint32)
+    n, w = words.shape
+    h1 = jnp.full((n,), SEED1, dtype=jnp.uint32)
+    h2 = jnp.full((n,), SEED2, dtype=jnp.uint32)
+
+    def mix(carry, col):
+        h1, h2 = carry
+        k = col * jnp.uint32(C1_1)
+        k = _rotl(k, 15)
+        k = k * jnp.uint32(C2_1)
+        h1 = h1 ^ k
+        h1 = _rotl(h1, 13)
+        h1 = h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+        k = col * jnp.uint32(C1_2)
+        k = _rotl(k, 16)
+        k = k * jnp.uint32(C2_2)
+        h2 = h2 ^ k
+        h2 = _rotl(h2, 13)
+        h2 = h2 * jnp.uint32(5) + jnp.uint32(0x561CCD1B)
+        return (h1, h2), None
+
+    (h1, h2), _ = lax.scan(mix, (h1, h2), jnp.transpose(words))
+    h1 = _fmix32(h1 ^ jnp.uint32(w))
+    h2 = _fmix32(h2 ^ jnp.uint32(w))
+    zero = (h1 == 0) & (h2 == 0)
+    h2 = jnp.where(zero, jnp.uint32(1), h2)
+    return h1, h2

@@ -1,0 +1,105 @@
+"""HBM-resident open-addressed fingerprint set with batched parallel insert.
+
+TPU-native replacement for the reference's concurrent visited set
+(``DashMap<Fingerprint, Option<Fingerprint>>`` in
+`/root/reference/src/checker/bfs.rs:26`). Keys are 64-bit fingerprints stored
+as uint32 (hi, lo) pairs; the empty slot marker is ``(0, 0)``, which the hash
+kernel guarantees is never a real fingerprint.
+
+Insertion is a lock-free-style parallel linear probe built from
+scatter/gather rounds inside one ``lax.while_loop``:
+
+  1. gather each item's current slot; a key match resolves the item as
+     "already present";
+  2. items at empty slots race to claim them by scattering a unique token
+     and gathering it back (XLA scatter picks one winner per slot — the
+     moral equivalent of a CAS);
+  3. claim winners scatter their key (race-free: one winner per slot) and
+     resolve as "inserted"; claim losers retry the same slot next round
+     (they will observe the winner's key: a match if it was a same-
+     fingerprint duplicate inside the batch, a collision otherwise);
+  4. items that observed a foreign occupant advance to the next slot.
+
+Which duplicate wins a slot within a batch is unspecified — the same benign
+race the reference tolerates on ``DashMap`` inserts ("Races other threads,
+but that's fine", `bfs.rs:198,206,268`).
+
+Parent fingerprints are not stored on device: the host mirrors (fingerprint
+-> parent) incrementally from each level's inserted set, which is also the
+checkpointable search record (TLC-style, `bfs.rs:314-342`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_PHI = 0x9E3779B9  # 2^32 / golden ratio; scrambles hi into the probe start.
+
+
+def make_table(capacity: int):
+    """Allocate an empty table. ``capacity`` must be a power of two."""
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    return (jnp.zeros((capacity,), dtype=jnp.uint32),
+            jnp.zeros((capacity,), dtype=jnp.uint32))
+
+
+def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
+    """Insert a batch of fingerprints.
+
+    Args:
+      key_hi, key_lo: uint32[C] table halves (C a power of two).
+      fhi, flo: uint32[N] fingerprints to insert.
+      valid: bool[N]; invalid rows are ignored.
+      max_rounds: probe-round bound; hitting it reports overflow.
+
+    Returns:
+      (inserted bool[N], key_hi, key_lo, overflowed bool[]) — ``inserted``
+      marks rows that claimed a fresh slot (first occurrence of a fingerprint
+      across the table's lifetime *and* within this batch).
+    """
+    capacity = key_hi.shape[0]
+    n = fhi.shape[0]
+    mask = jnp.uint32(capacity - 1)
+    token = jnp.arange(1, n + 1, dtype=jnp.uint32)
+    slot = (flo ^ (fhi * jnp.uint32(_PHI))) & mask
+
+    def cond(carry):
+        unresolved, _inserted, _slot, _khi, _klo, rounds = carry
+        return unresolved.any() & (rounds < max_rounds)
+
+    def body(carry):
+        unresolved, inserted, slot, khi, klo, rounds = carry
+        cur_hi = khi[slot]
+        cur_lo = klo[slot]
+        is_empty = (cur_hi == 0) & (cur_lo == 0)
+        is_match = (cur_hi == fhi) & (cur_lo == flo)
+        unresolved = unresolved & ~is_match
+
+        attempt = unresolved & is_empty
+        oob = jnp.uint32(capacity)
+        claim_idx = jnp.where(attempt, slot, oob)
+        claim = jnp.zeros((capacity,), dtype=jnp.uint32)
+        claim = claim.at[claim_idx].set(token, mode="drop")
+        won = attempt & (claim[slot] == token)
+
+        write_idx = jnp.where(won, slot, oob)
+        khi = khi.at[write_idx].set(fhi, mode="drop")
+        klo = klo.at[write_idx].set(flo, mode="drop")
+        inserted = inserted | won
+        unresolved = unresolved & ~won
+
+        # Foreign occupant: linear-probe forward. Claim losers retry in
+        # place — next round they see the winner's key.
+        advance = unresolved & ~is_empty & ~is_match
+        slot = jnp.where(advance, (slot + jnp.uint32(1)) & mask, slot)
+        return unresolved, inserted, slot, khi, klo, rounds + 1
+
+    unresolved = valid
+    inserted = jnp.zeros((n,), dtype=bool)
+    carry = (unresolved, inserted, slot, key_hi, key_lo,
+             jnp.int32(0))
+    unresolved, inserted, _slot, key_hi, key_lo, _rounds = lax.while_loop(
+        cond, body, carry)
+    return inserted, key_hi, key_lo, unresolved.any()

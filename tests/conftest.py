@@ -10,10 +10,19 @@ Must run before any ``import jax`` — pytest imports conftest first.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's sitecustomize force-registers an `axon` TPU PJRT plugin
+# and overrides the jax_platforms *config* (not just the env var) to
+# "axon,cpu"; initializing it opens a tunnel to the real chip, which tests
+# must never depend on. Re-override the config back to cpu before any
+# backend initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,188 @@
+"""TPU engine tests (run on the virtual CPU mesh; same code path as TPU).
+
+Strategy per SURVEY.md §4: the TPU engine cannot promise visitation order,
+so tests assert (a) bit-identical host/device fingerprints, (b) device hash
+table behavior against a host set simulation, (c) set-equality of visited
+fingerprints and exact unique counts on full-enumeration workloads, and
+(d) validity of discovered witnesses via replay (differential vs the host
+BFS oracle).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.fingerprint import fp64_words  # noqa: E402
+from stateright_tpu.models.packed import (  # noqa: E402
+    PackedLinearEquation,
+    validate_packed_model,
+)
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+from stateright_tpu.ops.hash_kernel import fp64_device  # noqa: E402
+from stateright_tpu.ops.hashtable import make_table, table_insert  # noqa: E402
+
+
+# --- device hash kernel ----------------------------------------------------
+
+def test_fp64_device_matches_host():
+    rng = np.random.default_rng(0)
+    for w in (1, 2, 4, 7, 16):
+        words = rng.integers(0, 2**32, size=(64, w), dtype=np.uint32)
+        hi, lo = fp64_device(jnp.asarray(words))
+        hi, lo = np.asarray(hi), np.asarray(lo)
+        for r in range(words.shape[0]):
+            expect = fp64_words(words[r].tolist())
+            got = (int(hi[r]) << 32) | int(lo[r])
+            assert got == expect, f"row {r} width {w}"
+
+
+# --- device hash table -----------------------------------------------------
+
+def _fps(n, seed=0):
+    rng = np.random.default_rng(seed)
+    hi = rng.integers(0, 2**32, size=n, dtype=np.uint32)
+    lo = rng.integers(1, 2**32, size=n, dtype=np.uint32)
+    return hi, lo
+
+
+def test_table_insert_basic():
+    key_hi, key_lo = make_table(256)
+    hi, lo = _fps(100)
+    valid = np.ones(100, dtype=bool)
+    inserted, key_hi, key_lo, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert not bool(overflow)
+    assert np.asarray(inserted).sum() == 100  # all unique fps inserted
+
+    # Re-inserting the same batch: nothing is new.
+    inserted2, key_hi, key_lo, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert not bool(overflow)
+    assert np.asarray(inserted2).sum() == 0
+
+
+def test_table_insert_batch_duplicates():
+    # Duplicates *within* a batch: exactly one insertion per distinct fp.
+    key_hi, key_lo = make_table(256)
+    hi = np.array([7, 7, 7, 9, 9], dtype=np.uint32)
+    lo = np.array([1, 1, 1, 2, 2], dtype=np.uint32)
+    valid = np.ones(5, dtype=bool)
+    inserted, key_hi, key_lo, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    inserted = np.asarray(inserted)
+    assert not bool(overflow)
+    assert inserted[:3].sum() == 1
+    assert inserted[3:].sum() == 1
+
+
+def test_table_insert_collision_chains():
+    # Tiny table, heavy collisions: all distinct keys still land.
+    key_hi, key_lo = make_table(64)
+    hi, lo = _fps(48, seed=3)
+    valid = np.ones(48, dtype=bool)
+    inserted, key_hi, key_lo, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert not bool(overflow)
+    assert np.asarray(inserted).sum() == 48
+    # Table contents equal the key set.
+    khi, klo = np.asarray(key_hi), np.asarray(key_lo)
+    stored = {(int(a), int(b)) for a, b in zip(khi, klo) if (a, b) != (0, 0)}
+    assert stored == {(int(a), int(b)) for a, b in zip(hi, lo)}
+
+
+def test_table_insert_overflow_detected():
+    key_hi, key_lo = make_table(16)
+    hi, lo = _fps(32, seed=5)
+    valid = np.ones(32, dtype=bool)
+    _, _, _, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid),
+        max_rounds=64)
+    assert bool(overflow)
+
+
+def test_table_insert_respects_valid_mask():
+    key_hi, key_lo = make_table(64)
+    hi, lo = _fps(10)
+    valid = np.zeros(10, dtype=bool)
+    valid[::2] = True
+    inserted, *_ = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert np.asarray(inserted).sum() == 5
+
+
+# --- packed model contracts ------------------------------------------------
+
+def test_packed_linear_equation_contract():
+    validate_packed_model(PackedLinearEquation(2, 10, 14), max_states=300)
+
+
+def test_packed_twopc_contract():
+    validate_packed_model(TwoPhaseSys(3), max_states=300)
+
+
+# --- end-to-end engine -----------------------------------------------------
+
+def test_tpu_twopc_check3():
+    # SURVEY.md §7 stage 3's minimum end-to-end slice: 2pc with 3 RMs on the
+    # device engine matches the host oracle: 288 unique states
+    # (2pc.rs:128) and the same property verdicts.
+    model = TwoPhaseSys(3)
+    checker = (model.checker()
+               .tpu_options(capacity=1 << 12)
+               .spawn_tpu().join())
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()  # both sometimes found; always holds
+
+    # Discovered witnesses replay correctly through the host model.
+    for name in ("abort agreement", "commit agreement"):
+        path = checker.discovery(name)
+        assert path is not None
+        prop = model.property(name)
+        assert prop.condition(model, path.last_state())
+
+
+def test_tpu_matches_host_visited_set():
+    model = TwoPhaseSys(2)
+    host = TwoPhaseSys(2).checker().spawn_bfs().join()
+    tpu = (model.checker().tpu_options(capacity=1 << 10)
+           .spawn_tpu().join())
+    # Set equality of visited fingerprints (order is engine-specific).
+    assert set(tpu._generated.keys()) == set(host._generated.keys())
+
+
+def test_tpu_linear_equation_full_enumeration():
+    # Unsolvable equation forces full enumeration: 256*256 unique states
+    # (bfs.rs:371). Also exercises table growth (initial capacity 2^14 must
+    # grow to hold 65,536 fingerprints).
+    checker = (PackedLinearEquation(2, 4, 7).checker()
+               .tpu_options(capacity=1 << 14)
+               .spawn_tpu().join())
+    assert checker.is_done()
+    checker.assert_no_discovery("solvable")
+    assert checker.unique_state_count() == 256 * 256
+
+
+def test_tpu_finds_sometimes_discovery():
+    checker = (PackedLinearEquation(2, 10, 14).checker()
+               .tpu_options(capacity=1 << 12)
+               .spawn_tpu().join())
+    path = checker.assert_any_discovery("solvable")
+    x, y = path.last_state()
+    assert (2 * x + 10 * y) & 0xFF == 14
+
+
+def test_tpu_target_state_count():
+    checker = (PackedLinearEquation(2, 4, 7).checker()
+               .target_state_count(500)
+               .tpu_options(capacity=1 << 14)
+               .spawn_tpu().join())
+    assert checker.state_count() >= 500
+    assert checker.unique_state_count() < 256 * 256
+
+
+def test_tpu_requires_packed_model():
+    from stateright_tpu.models import LinearEquation
+    with pytest.raises(TypeError):
+        LinearEquation(2, 10, 14).checker().spawn_tpu()
