@@ -1,0 +1,67 @@
+"""Benchmark: TPU engine states/sec vs host BFS (the reference strategy).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute numbers (BASELINE.md), so the baseline
+is the host BFS engine measured in-process on the same workload family —
+the moral equivalent of the reference's `spawn_bfs` (its bench harness greps
+states/sec from `Checker::report`, `bench.sh:22`). Workload: two-phase
+commit (`/root/reference/examples/2pc.rs`), the abstract Model benchmark
+config from BASELINE.json. The TPU engine runs a larger instance (rates are
+per-state comparable; bigger frontiers amortize launch overhead), and runs
+twice so the second, compile-cached run is timed.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from stateright_tpu.models.twopc import TwoPhaseSys
+
+
+def run_tpu(n: int, capacity: int = 1 << 22):
+    model = TwoPhaseSys(n)
+    checker = (model.checker()
+               .tpu_options(capacity=capacity)
+               .spawn_tpu()
+               .join())
+    return checker
+
+
+def time_tpu(n: int) -> tuple[float, int]:
+    # warm-up run populates the jit cache (shapes recur across runs)
+    run_tpu(n)
+    t0 = time.perf_counter()
+    checker = run_tpu(n)
+    dt = time.perf_counter() - t0
+    return dt, checker.unique_state_count()
+
+
+def time_host(n: int) -> tuple[float, int]:
+    model = TwoPhaseSys(n)
+    t0 = time.perf_counter()
+    checker = model.checker().spawn_bfs().join()
+    dt = time.perf_counter() - t0
+    return dt, checker.unique_state_count()
+
+
+def main() -> None:
+    host_dt, host_states = time_host(5)      # 8,832 states (2pc.rs:133)
+    tpu_dt, tpu_states = time_tpu(7)         # ~271k states
+    host_rate = host_states / host_dt
+    tpu_rate = tpu_states / tpu_dt
+    print(json.dumps({
+        "metric": "2pc states/sec (spawn_tpu, n=7)",
+        "value": round(tpu_rate, 1),
+        "unit": "states/sec",
+        "vs_baseline": round(tpu_rate / host_rate, 2),
+    }))
+    print(f"# host spawn_bfs n=5: {host_states} states in {host_dt:.2f}s "
+          f"({host_rate:.0f}/s); spawn_tpu n=7: {tpu_states} states in "
+          f"{tpu_dt:.2f}s ({tpu_rate:.0f}/s)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

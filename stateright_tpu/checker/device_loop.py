@@ -1,0 +1,179 @@
+"""Device-resident multi-level search loop for ``spawn_tpu``.
+
+The per-level orchestration in `tpu.py` pays one host round trip per BFS
+level — fatal when the device is remote (tunneled TPU) and wasteful even
+locally. This module compiles the *entire search loop* into one XLA
+computation: a ``lax.while_loop`` whose carry holds
+
+  * a FIFO **ring queue** of pending packed states (the device analog of the
+    reference's shared ``pending`` deques, `/root/reference/src/checker/bfs.rs:29-30`),
+  * the open-addressed visited table (`ops/hashtable.py`),
+  * an append-only **log** of (child fp, parent fp) pairs — the complete
+    search record from which the host lazily mirrors its
+    fingerprint->parent map for trace reconstruction (TLC-style,
+    `bfs.rs:314-342`) and checkpointing,
+  * sticky per-property discovery registers (first witnessing fingerprint),
+  * counters and overflow flags.
+
+Each ``while_loop`` iteration expands up to ``fmax`` queue rows exactly like
+the reference's ``check_block`` hot loop (`bfs.rs:165-274`): property
+evaluation, action expansion, fingerprinting, dedup-insert, enqueue. The
+host re-enters the loop only every ``steps`` iterations (one dispatch per
+chunk) to read a handful of scalars — progress, discoveries, growth/exit
+conditions.
+
+Queue order is FIFO, so expansion stays level-ordered (BFS) and discovered
+witness paths stay shortest, like ``spawn_bfs``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.expand import (discovery_candidates, eventually_indices,
+                          expand_frontier)
+from ..ops.hashtable import table_insert
+
+
+class ChunkCarry(NamedTuple):
+    q_rows: jax.Array   # uint32[qcap, W] ring queue of pending states
+    q_eb: jax.Array     # uint32[qcap]    their eventually-bits
+    q_head: jax.Array   # int32[]         ring head index
+    q_size: jax.Array   # int32[]         pending count
+    key_hi: jax.Array   # uint32[cap]     visited table
+    key_lo: jax.Array   # uint32[cap]
+    log_chi: jax.Array  # uint32[logcap]  child fp (insertion order)
+    log_clo: jax.Array  # uint32[logcap]
+    log_phi: jax.Array  # uint32[logcap]  parent fp
+    log_plo: jax.Array  # uint32[logcap]
+    log_n: jax.Array    # int32[]
+    disc_hit: jax.Array  # bool[P]   property discovered?
+    disc_hi: jax.Array   # uint32[P] witnessing state fp (sticky first)
+    disc_lo: jax.Array   # uint32[P]
+    gen: jax.Array      # int32[]  states generated THIS chunk (host accumulates)
+    ovf: jax.Array      # bool[]   table probe overflow (should not happen
+    #                              below the growth limit)
+    steps: jax.Array    # int32[]  remaining step budget for this chunk
+
+
+def build_chunk_fn(model, qcap: int, capacity: int, fmax: int):
+    """Compile the K-level chunk runner for fixed buffer shapes.
+
+    Returned callable: ``chunk(carry, target_remaining, grow_limit) ->
+    carry`` where ``target_remaining`` bounds ``gen`` (INT32_MAX when
+    unbounded) and ``grow_limit`` is the log length at which the loop exits
+    so the host can grow the table.
+    """
+    assert qcap & (qcap - 1) == 0, "qcap must be a power of two"
+    n_actions = model.max_actions
+    properties = model.properties()
+    prop_count = len(properties)
+    eventually_idx = eventually_indices(properties)
+    logcap = capacity
+    qmask = qcap - 1
+    fa = fmax * n_actions
+
+    def cond(state):
+        c, target_remaining, grow_limit = state
+        go = (c.q_size > 0) & (c.steps > 0) & ~c.ovf \
+            & (c.gen < target_remaining) \
+            & (c.log_n < grow_limit) \
+            & (c.q_size <= qcap - fa)
+        if prop_count:
+            go = go & ~c.disc_hit.all()
+        return go
+
+    def body(state):
+        c, target_remaining, grow_limit = state
+        idxs = (c.q_head + jnp.arange(fmax, dtype=jnp.int32)) & qmask
+        frontier = c.q_rows[idxs]
+        ebits = c.q_eb[idxs]
+        take = jnp.minimum(c.q_size, fmax)
+        fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
+
+        # the shared check_block analog (ops/expand.py)
+        exp = expand_frontier(model, frontier, fvalid, ebits,
+                              eventually_idx)
+        inserted, key_hi, key_lo, t_ovf = table_insert(
+            c.key_hi, c.key_lo, exp.chi, exp.clo, exp.cvalid)
+        cnt = inserted.sum(dtype=jnp.int32)
+        pos = jnp.cumsum(inserted.astype(jnp.int32)) - 1
+
+        # enqueue fresh children (ring append)
+        qidx = jnp.where(inserted, (c.q_head + c.q_size + pos) & qmask, qcap)
+        q_rows = c.q_rows.at[qidx].set(exp.flat, mode="drop")
+        ceb = jnp.repeat(exp.ebits, n_actions)
+        q_eb = c.q_eb.at[qidx].set(ceb, mode="drop")
+
+        # log (child, parent) fingerprints in insertion order
+        lidx = jnp.where(inserted, c.log_n + pos, logcap)
+        par_hi = jnp.repeat(exp.phi, n_actions)
+        par_lo = jnp.repeat(exp.plo, n_actions)
+        log_chi = c.log_chi.at[lidx].set(exp.chi, mode="drop")
+        log_clo = c.log_clo.at[lidx].set(exp.clo, mode="drop")
+        log_phi = c.log_phi.at[lidx].set(par_hi, mode="drop")
+        log_plo = c.log_plo.at[lidx].set(par_lo, mode="drop")
+
+        # sticky discovery registers
+        disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
+        if prop_count:
+            new_hit, cand_hi, cand_lo = discovery_candidates(
+                properties, exp, fvalid)
+            keep = disc_hit | ~new_hit
+            disc_hi = jnp.where(keep, disc_hi, cand_hi)
+            disc_lo = jnp.where(keep, disc_lo, cand_lo)
+            disc_hit = disc_hit | new_hit
+
+        nc = ChunkCarry(
+            q_rows=q_rows, q_eb=q_eb,
+            q_head=(c.q_head + take) & qmask,
+            q_size=c.q_size - take + cnt,
+            key_hi=key_hi, key_lo=key_lo,
+            log_chi=log_chi, log_clo=log_clo,
+            log_phi=log_phi, log_plo=log_plo,
+            log_n=c.log_n + cnt,
+            disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
+            gen=c.gen + exp.cvalid.sum(dtype=jnp.int32),
+            ovf=c.ovf | t_ovf,
+            steps=c.steps - 1)
+        return (nc, target_remaining, grow_limit)
+
+    def chunk(carry: ChunkCarry, target_remaining, grow_limit):
+        out, _, _ = jax.lax.while_loop(
+            cond, body, (carry, target_remaining, grow_limit))
+        return out
+
+    return jax.jit(chunk, donate_argnums=(0,))
+
+
+def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
+               steps: int = 0):
+    """Host-side construction of the initial carry (init states enqueued;
+    the caller bulk-inserts their fingerprints into the table)."""
+    import numpy as np
+
+    width = model.packed_width
+    prop_count = len(model.properties())
+    q_rows = np.zeros((qcap, width), dtype=np.uint32)
+    q_eb = np.zeros((qcap,), dtype=np.uint32)
+    for i, row in enumerate(init_rows):
+        q_rows[i] = row
+        q_eb[i] = full_ebits
+    logcap = capacity
+    return ChunkCarry(
+        q_rows=jnp.asarray(q_rows), q_eb=jnp.asarray(q_eb),
+        q_head=jnp.int32(0), q_size=jnp.int32(len(init_rows)),
+        key_hi=jnp.zeros((capacity,), jnp.uint32),
+        key_lo=jnp.zeros((capacity,), jnp.uint32),
+        log_chi=jnp.zeros((logcap,), jnp.uint32),
+        log_clo=jnp.zeros((logcap,), jnp.uint32),
+        log_phi=jnp.zeros((logcap,), jnp.uint32),
+        log_plo=jnp.zeros((logcap,), jnp.uint32),
+        log_n=jnp.int32(0),
+        disc_hit=jnp.zeros((prop_count,), bool),
+        disc_hi=jnp.zeros((prop_count,), jnp.uint32),
+        disc_lo=jnp.zeros((prop_count,), jnp.uint32),
+        gen=jnp.int32(0), ovf=jnp.bool_(False), steps=jnp.int32(steps))

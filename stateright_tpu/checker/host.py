@@ -31,6 +31,7 @@ class HostChecker(Checker):
         self._unique_state_count = 0
         self._discovery_fps: Dict[str, object] = {}
         self._done = False
+        self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
 
@@ -48,6 +49,8 @@ class HostChecker(Checker):
     def _run_wrapper(self) -> None:
         try:
             self._run()
+        except BaseException as exc:  # re-raised at join()
+            self._error = exc
         finally:
             self._done = True
 
@@ -71,8 +74,14 @@ class HostChecker(Checker):
     def join(self) -> "HostChecker":
         self._start_background()
         self._thread.join()
+        if self._error is not None:
+            raise self._error
         return self
 
     def is_done(self) -> bool:
+        if self._error is not None:
+            # a crashed engine is not "done": surface the failure on the
+            # polling path (report()) as well as on join()
+            raise self._error
         return self._done or (
             len(self._discovery_fps) == len(self._properties))

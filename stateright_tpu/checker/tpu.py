@@ -14,13 +14,18 @@ reference does per state:
     violations are mask bits, mirroring ``next_state -> None`` pruning);
   * fingerprinting via the device hash kernel (`ops/hash_kernel.py`);
   * visited-set dedup via batched parallel insert into an HBM-resident
-    open-addressed table (`ops/hashtable.py`).
+    open-addressed table (`ops/hashtable.py`);
+  * **compaction**: newly inserted children are scatter-compacted into a
+    dense buffer that directly becomes the next frontier — packed states
+    never round-trip to the host, and the host pulls only 16 bytes per new
+    state (its fingerprint and its parent's) plus a handful of scalars.
+    Discovery selection (which frontier row violated/satisfied each
+    property) is likewise reduced on device to one fingerprint per property.
 
-The host orchestrates: it pulls per-level masks/fingerprints (small), keeps
-the (fingerprint -> parent-fingerprint) mirror used for trace reconstruction
-by replay (the TLC technique, `bfs.rs:314-342`), records discoveries, and
-builds the next frontier by index-gather on device — packed states never
-round-trip to the host.
+The host orchestrates: it keeps the (fingerprint -> parent-fingerprint)
+mirror used for trace reconstruction by replay (the TLC technique,
+`bfs.rs:314-342`), records discoveries, and slices frontier segments out of
+the device-resident compact buffers.
 
 Semantic differences vs the host engines (both documented and benign):
   * work granularity is a frontier segment, not a single state, so
@@ -45,7 +50,6 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..core import Expectation
 from .builder import CheckerBuilder
 from .host import HostChecker
 from .path import Path
@@ -59,6 +63,73 @@ def _next_pow2(n: int) -> int:
 
 def _bucket(n: int) -> int:
     return max(_MIN_BUCKET, _next_pow2(n))
+
+
+def _combine64(hi, lo) -> np.ndarray:
+    """Host-side (hi, lo) uint32 pair -> uint64 fingerprint array."""
+    return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) \
+        | np.asarray(lo).astype(np.uint64)
+
+
+def _compact(mask, *columns):
+    """Scatter-compact ``columns`` rows where ``mask`` holds to the front.
+
+    Returns (count, *compacted) with compacted columns the same shape as the
+    inputs; rows past ``count`` are zero.
+    """
+    import jax.numpy as jnp
+
+    n = mask.shape[0]
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    idx = jnp.where(mask, pos, n)
+    out = tuple(jnp.zeros_like(c).at[idx].set(c, mode="drop")
+                for c in columns)
+    return (mask.sum(dtype=jnp.int32),) + out
+
+
+def build_level_fn(model):
+    """Build the jitted single-chip BFS level step for a packed model.
+
+    One launch fuses everything the reference does per state in
+    ``check_block`` (`bfs.rs:165-274`) — the shared expansion core
+    (`ops/expand.py`) plus visited-set insert and child compaction. Outputs
+    are device-resident; everything the host must inspect is either a
+    scalar or a compacted array whose prefix length is one of those
+    scalars.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.expand import (discovery_candidates, eventually_indices,
+                              expand_frontier)
+    from ..ops.hashtable import table_insert
+
+    properties = model.properties()
+    n_actions = model.max_actions
+    eventually_idx = eventually_indices(properties)
+
+    def level_fn(frontier, fvalid, ebits, key_hi, key_lo):
+        exp = expand_frontier(model, frontier, fvalid, ebits,
+                              eventually_idx)
+        inserted, key_hi, key_lo, overflow = table_insert(
+            key_hi, key_lo, exp.chi, exp.clo, exp.cvalid)
+
+        # compact the new states: this dense prefix IS the next frontier
+        par_hi = jnp.repeat(exp.phi, n_actions)
+        par_lo = jnp.repeat(exp.plo, n_actions)
+        ceb = jnp.repeat(exp.ebits, n_actions)
+        (count, comp_rows, comp_chi, comp_clo, comp_phi, comp_plo,
+         comp_eb) = _compact(inserted, exp.flat, exp.chi, exp.clo,
+                             par_hi, par_lo, ceb)
+
+        disc_hit, disc_hi, disc_lo = discovery_candidates(
+            properties, exp, fvalid)
+        gen_count = exp.cvalid.sum(dtype=jnp.int32)
+        return (key_hi, key_lo, comp_rows, comp_chi, comp_clo, comp_phi,
+                comp_plo, comp_eb, count, disc_hit, disc_hi, disc_lo,
+                gen_count, overflow, exp.phi, exp.plo)
+
+    return jax.jit(level_fn)
 
 
 class TpuChecker(HostChecker):
@@ -75,6 +146,7 @@ class TpuChecker(HostChecker):
                     "models can use spawn_bfs()/spawn_dfs().")
         super().__init__(builder)
         opts = builder.tpu_options_
+        self._tpu_options = opts
         self._capacity = int(opts.get("capacity", 1 << 20))
         assert self._capacity & (self._capacity - 1) == 0, \
             "capacity must be a power of two"
@@ -91,72 +163,260 @@ class TpuChecker(HostChecker):
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
-        import jax
-        import jax.numpy as jnp
+        mode = str(self._tpu_options.get("mode", "auto"))
+        if mode not in ("auto", "device", "level"):
+            raise ValueError(
+                f"unknown tpu_options mode {mode!r}; expected 'auto', "
+                "'device', or 'level'")
+        if self._visitor is not None:
+            if mode == "device":
+                raise ValueError(
+                    "a visitor requires the per-level engine (it observes "
+                    "every expanded state); drop tpu_options(mode='device') "
+                    "or the visitor")
+            # the per-state visitor is a host feature: it needs each
+            # expanded state's fingerprint every level, so the per-level
+            # orchestration is the natural fit
+            mode = "level"
+        if mode == "level":
+            self._run_levels()
+        else:
+            self._run_device()
 
-        from ..ops.hash_kernel import fp64_device
-        from ..ops.hashtable import make_table, table_insert
 
+    def _seed_inits(self) -> "List[np.ndarray]":
+        """Filter/fingerprint/encode the initial states into the mirror and
+        return their packed rows (both engine modes seed identically)."""
         model = self._model
-        properties = self._properties
-        prop_count = len(properties)
-        width = model.packed_width
-        n_actions = model.max_actions
-        eventually_idx = [i for i, p in enumerate(properties)
-                         if p.expectation == Expectation.EVENTUALLY]
-        full_ebits = np.uint32(sum(1 << i for i in eventually_idx))
-        generated = self._generated
-        discoveries = self._discovery_fps
-        target = self._target_state_count
-        visitor = self._visitor
-
-        # --- jitted level step -----------------------------------------
-        def level_fn(frontier, fvalid, ebits, key_hi, key_lo):
-            pbits = jax.vmap(model.packed_properties)(frontier)  # [F, P]
-            if eventually_idx:
-                sat_bits = jnp.zeros(
-                    (frontier.shape[0],), dtype=jnp.uint32)
-                for i in eventually_idx:
-                    sat_bits = sat_bits | jnp.where(
-                        pbits[:, i], jnp.uint32(1 << i), jnp.uint32(0))
-                ebits = ebits & ~sat_bits
-            succ, avalid = jax.vmap(model.packed_step)(frontier)
-            avalid = avalid & fvalid[:, None]
-            flat = succ.reshape((-1, width))
-            fhi, flo = fp64_device(flat)
-            phi, plo = fp64_device(frontier)
-            inserted, key_hi, key_lo, overflow = table_insert(
-                key_hi, key_lo, fhi, flo, avalid.reshape(-1))
-            terminal = fvalid & ~avalid.any(axis=1)
-            gen_count = avalid.sum(dtype=jnp.int32)
-            return (key_hi, key_lo, flat, inserted, fhi, flo, phi, plo,
-                    pbits, ebits, terminal, gen_count, overflow)
-
-        level_fn = jax.jit(level_fn)
-
-        def gather_fn(flat, ebits_new, idx):
-            return flat[idx], ebits_new[idx // n_actions]
-
-        gather_fn = jax.jit(gather_fn)
-
-        insert_fn = jax.jit(table_insert)
-
-        # --- init -------------------------------------------------------
         init_states = [s for s in model.init_states()
                        if model.within_boundary(s)]
         self._state_count = len(init_states)
         init_rows: List[np.ndarray] = []
         for s in init_states:
             fp = model.fingerprint(s)
-            if fp not in generated:
-                generated[fp] = None
+            if fp not in self._generated:
+                self._generated[fp] = None
                 init_rows.append(model.encode(s))
-        self._unique_state_count = len(generated)
+        self._unique_state_count = len(self._generated)
+        return init_rows
+
+    # ------------------------------------------------------------------
+    def _run_device(self) -> None:
+        """Device-resident search: the whole multi-level loop is one XLA
+        ``while_loop`` (see `device_loop.py`); the host syncs once per
+        K-level chunk and pulls the (child fp, parent fp) log at the end."""
+        import jax
+        import jax.numpy as jnp
+
+        from .device_loop import build_chunk_fn, seed_carry
+        from ..ops.hashtable import table_insert
+
+        model = self._model
+        properties = self._properties
+        prop_count = len(properties)
+        from ..ops.expand import eventually_indices
+        full_ebits = np.uint32(sum(1 << i
+                                   for i in eventually_indices(properties)))
+        generated = self._generated
+        discoveries = self._discovery_fps
+        target = self._target_state_count
+        opts = self._tpu_options
+        fmax = int(opts.get("fmax", min(self._max_segment, 1 << 13)))
+        k_steps = int(opts.get("chunk_steps", 64))
+        insert_fn = jax.jit(table_insert)
+
+        # --- seed -------------------------------------------------------
+        init_rows = self._seed_inits()
+        n_init = len(generated)
+        if prop_count == 0:
+            # nothing to search for: mirror the reference's immediate stop
+            # once discoveries (vacuously) cover all properties
+            # (bfs.rs:121-128)
+            return
+
+        # one while_loop iteration can insert up to fmax*max_actions new
+        # states; capacity must leave that headroom below the growth exit
+        headroom = fmax * model.max_actions
+        while self._grow_at * self._capacity <= headroom + n_init:
+            self._capacity *= 4
+
+        qcap = int(opts.get("qcap", self._capacity))
+        assert qcap & (qcap - 1) == 0, "qcap must be a power of two"
+        while qcap < max(len(init_rows), 2 * headroom):
+            qcap *= 2
+        carry = seed_carry(model, qcap, self._capacity, init_rows,
+                           full_ebits)
+        key_hi, key_lo = self._bulk_insert(
+            insert_fn, carry.key_hi, carry.key_lo, list(generated.keys()))
+        carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
+        chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax)
+
+        # --- chunk loop -------------------------------------------------
+        while True:
+            grow_limit = np.int32(min(
+                self._grow_at * self._capacity,
+                self._capacity - headroom))
+            remaining = np.int32(
+                min(max(target - self._state_count, 0), 2**31 - 1)
+                if target is not None else 2**31 - 1)
+            carry = carry._replace(gen=jnp.int32(0),
+                                   steps=jnp.int32(k_steps))
+            carry = chunk_fn(carry, remaining, grow_limit)
+            (q_size, log_n, disc_hit, disc_hi, disc_lo, gen, ovf) = \
+                jax.device_get((carry.q_size, carry.log_n, carry.disc_hit,
+                                carry.disc_hi, carry.disc_lo, carry.gen,
+                                carry.ovf))
+            self._state_count += int(gen)
+            self._unique_state_count = n_init + int(log_n)
+            disc_fps = _combine64(disc_hi, disc_lo)
+            for i, prop in enumerate(properties):
+                if disc_hit[i] and prop.name not in discoveries:
+                    discoveries[prop.name] = int(disc_fps[i])
+            if bool(ovf):
+                raise RuntimeError(
+                    "device hash table probe overflow below the growth "
+                    f"limit (capacity {self._capacity}); raise via "
+                    "checker_builder.tpu_options(capacity=...)")
+            done = (int(q_size) == 0
+                    or len(discoveries) == prop_count
+                    or (target is not None
+                        and self._state_count >= target))
+            if done:
+                break
+            need_grow = (int(log_n) >= int(grow_limit)
+                         or int(q_size) > qcap - fmax * model.max_actions)
+            if need_grow:
+                carry, qcap = self._grow_device(carry, qcap, insert_fn)
+                chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax)
+
+        self._finalize_mirror(carry)
+
+    # ------------------------------------------------------------------
+    def _grow_device(self, carry, qcap: int, insert_fn):
+        """Quadruple table+log capacity (and queue when pressed), re-insert
+        all known fingerprints from the device-resident log, and rebuild the
+        carry. No host round trip for the fingerprints themselves."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.hashtable import table_insert as table_insert_local
+
+        old_capacity = self._capacity
+        self._capacity = old_capacity * 4
+        new_qcap = qcap
+        if int(jax.device_get(carry.q_size)) > qcap // 2:
+            new_qcap = qcap * 4
+
+        def rebuild(q_rows, q_eb, q_head,
+                    log_chi, log_clo, log_phi, log_plo, log_n):
+            # relocate the ring to head=0 in the (possibly larger) queue
+            idx = (q_head + jnp.arange(qcap, dtype=jnp.int32)) & (qcap - 1)
+            nq_rows = jnp.zeros((new_qcap, q_rows.shape[1]), jnp.uint32)
+            nq_rows = nq_rows.at[:qcap].set(q_rows[idx])
+            nq_eb = jnp.zeros((new_qcap,), jnp.uint32)
+            nq_eb = nq_eb.at[:qcap].set(q_eb[idx])
+            # bigger log
+            nl_chi = jnp.zeros((self._capacity,), jnp.uint32)
+            nl_chi = nl_chi.at[:old_capacity].set(log_chi)
+            nl_clo = jnp.zeros((self._capacity,), jnp.uint32)
+            nl_clo = nl_clo.at[:old_capacity].set(log_clo)
+            nl_phi = jnp.zeros((self._capacity,), jnp.uint32)
+            nl_phi = nl_phi.at[:old_capacity].set(log_phi)
+            nl_plo = jnp.zeros((self._capacity,), jnp.uint32)
+            nl_plo = nl_plo.at[:old_capacity].set(log_plo)
+            # fresh table; re-insert every logged fingerprint
+            key_hi = jnp.zeros((self._capacity,), jnp.uint32)
+            key_lo = jnp.zeros((self._capacity,), jnp.uint32)
+            valid = jnp.arange(old_capacity, dtype=jnp.int32) < log_n
+            _, key_hi, key_lo, ovf = table_insert_local(
+                key_hi, key_lo, log_chi, log_clo, valid)
+            return (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo,
+                    nl_phi, nl_plo, ovf)
+
+        rebuild = jax.jit(rebuild)
+        (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo, nl_phi, nl_plo,
+         ovf) = rebuild(carry.q_rows, carry.q_eb, carry.q_head,
+                        carry.log_chi, carry.log_clo, carry.log_phi,
+                        carry.log_plo, carry.log_n)
+        if bool(jax.device_get(ovf)):
+            raise RuntimeError("overflow while re-inserting during growth")
+        # init fingerprints are not in the log; re-insert from the host
+        init_fps = [fp for fp, parent in self._generated.items()
+                    if parent is None]
+        key_hi, key_lo = self._bulk_insert(insert_fn, key_hi, key_lo,
+                                           init_fps)
+        carry = carry._replace(
+            q_rows=nq_rows, q_eb=nq_eb, q_head=jnp.int32(0),
+            key_hi=key_hi, key_lo=key_lo,
+            log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
+            log_plo=nl_plo)
+        return carry, new_qcap
+
+    def _finalize_mirror(self, carry) -> None:
+        """Pull the (child fp, parent fp) log and complete the host mirror
+        used for path reconstruction and checkpointing."""
+        import jax
+
+        log_n = int(jax.device_get(carry.log_n))
+        if not log_n:
+            return
+        # pull only the live prefix (pow2-padded slice jitted on device)
+        n = _bucket(log_n)
+
+        def prefix(chi, clo, phi, plo):
+            return chi[:n], clo[:n], phi[:n], plo[:n]
+
+        chi, clo, phi, plo = jax.device_get(jax.jit(prefix)(
+            carry.log_chi, carry.log_clo, carry.log_phi, carry.log_plo))
+        child = _combine64(chi[:log_n], clo[:log_n])
+        parent = _combine64(phi[:log_n], plo[:log_n])
+        self._generated.update(zip(child.tolist(), parent.tolist()))
+        self._unique_state_count = len(self._generated)
+
+    # ------------------------------------------------------------------
+    def _run_levels(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.hashtable import make_table, table_insert
+
+        model = self._model
+        properties = self._properties
+        prop_count = len(properties)
+        width = model.packed_width
+        from ..ops.expand import eventually_indices
+        full_ebits = np.uint32(sum(1 << i
+                                   for i in eventually_indices(properties)))
+        generated = self._generated
+        discoveries = self._discovery_fps
+        target = self._target_state_count
+        visitor = self._visitor
+
+        level_fn = build_level_fn(model)
+        insert_fn = jax.jit(table_insert)
+
+        def slice_fn(rows, ebs, start, size):
+            # clipped gather: out-of-range rows are garbage but always land
+            # in the fvalid-masked tail, so no state is shifted or dropped
+            idx = jnp.minimum(start + jnp.arange(size),
+                              rows.shape[0] - 1)
+            return rows[idx], ebs[idx]
+
+        slice_fn = jax.jit(slice_fn, static_argnums=(3,))
+
+        def take_fn(chi, clo, phi, plo, size):
+            return chi[:size], clo[:size], phi[:size], plo[:size]
+
+        take_fn = jax.jit(take_fn, static_argnums=(4,))
+
+        # --- init -------------------------------------------------------
+        init_rows = self._seed_inits()
 
         key_hi, key_lo = make_table(self._capacity)
         key_hi, key_lo = self._bulk_insert(
             insert_fn, key_hi, key_lo, list(generated.keys()))
 
+        # segments reference (rows, ebits, start, length) on device
         segments: deque = deque()
         for start in range(0, len(init_rows), self._max_segment):
             chunk = init_rows[start:start + self._max_segment]
@@ -164,70 +424,65 @@ class TpuChecker(HostChecker):
             bucket = _bucket(fcount)
             rows = np.zeros((bucket, width), dtype=np.uint32)
             rows[:fcount] = np.stack(chunk)
-            fvalid = np.arange(bucket) < fcount
-            ebits = np.full((bucket,), full_ebits, dtype=np.uint32)
-            segments.append((jnp.asarray(rows), jnp.asarray(fvalid),
-                             jnp.asarray(ebits)))
+            ebs = np.full((bucket,), full_ebits, dtype=np.uint32)
+            segments.append((jnp.asarray(rows), jnp.asarray(ebs), 0, fcount))
 
         # --- search loop ------------------------------------------------
         while segments:
             if len(discoveries) == prop_count:
                 return
-            frontier, fvalid, ebits = segments.popleft()
-            (key_hi, key_lo, flat, inserted_d, fhi_d, flo_d, phi_d, plo_d,
-             pbits_d, ebits_d, terminal_d, gen_count_d, overflow_d) = \
-                level_fn(frontier, fvalid, ebits, key_hi, key_lo)
-            (inserted, fhi, flo, phi, plo, pbits, ebits_np, terminal,
-             gen_count, overflow, fvalid_np) = jax.device_get(
-                (inserted_d, fhi_d, flo_d, phi_d, plo_d, pbits_d, ebits_d,
-                 terminal_d, gen_count_d, overflow_d, fvalid))
-            if overflow:
-                raise RuntimeError(
-                    "device hash table overflow (capacity "
-                    f"{self._capacity}); raise via "
-                    "checker_builder.tpu_options(capacity=...)")
+            rows, ebs, start, length = segments.popleft()
+            bucket = _bucket(length)
+            if rows.shape[0] == bucket and start == 0:
+                frontier, ebits = rows, ebs
+            else:
+                frontier, ebits = slice_fn(rows, ebs, start, bucket)
+            fvalid = jnp.arange(bucket) < length
 
+            while True:
+                (key_hi, key_lo, comp_rows, comp_chi, comp_clo, comp_phi,
+                 comp_plo, comp_eb, count_d, disc_hit_d, disc_hi_d,
+                 disc_lo_d, gen_d, ovf_d, fp_hi_d, fp_lo_d) = \
+                    level_fn(frontier, fvalid, ebits, key_hi, key_lo)
+
+                # small pull: scalars + per-property discovery candidates
+                (count, disc_hit, disc_hi, disc_lo, gen_count, overflow) = \
+                    jax.device_get((count_d, disc_hit_d, disc_hi_d,
+                                    disc_lo_d, gen_d, ovf_d))
+                if not overflow:
+                    break
+                # a single level's batch outran the table headroom: grow,
+                # rebuild from the host mirror (which excludes this level's
+                # partial inserts), and retry the level cleanly
+                self._capacity *= 4
+                key_hi, key_lo = make_table(self._capacity)
+                key_hi, key_lo = self._bulk_insert(
+                    insert_fn, key_hi, key_lo, list(generated.keys()))
+            count = int(count)
             self._state_count += int(gen_count)
-            frontier_fps = (phi.astype(np.uint64) << np.uint64(32)) \
-                | plo.astype(np.uint64)
-            child_fps = (fhi.astype(np.uint64) << np.uint64(32)) \
-                | flo.astype(np.uint64)
 
             if visitor is not None:
-                for k in np.nonzero(fvalid_np)[0]:
+                # host-fallback feature: materialize each frontier state's
+                # path (requires the frontier fingerprints — pull them)
+                phi, plo = jax.device_get((fp_hi_d, fp_lo_d))
+                fps = _combine64(phi, plo)
+                for k in range(length):
                     visitor.visit(
-                        model, self._reconstruct_path(int(frontier_fps[k])))
+                        model, self._reconstruct_path(int(fps[k])))
 
-            # discoveries: always/sometimes on the evaluated frontier rows
+            disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
-                if prop.name in discoveries:
-                    continue
-                if prop.expectation == Expectation.ALWAYS:
-                    mask = fvalid_np & ~pbits[:, i]
-                elif prop.expectation == Expectation.SOMETIMES:
-                    mask = fvalid_np & pbits[:, i]
-                else:
-                    continue
-                hits = np.nonzero(mask)[0]
-                if hits.size:
-                    discoveries[prop.name] = int(frontier_fps[hits[0]])
-            # eventually: flushed at terminal rows with bits remaining
-            if eventually_idx:
-                term_hits = np.nonzero(
-                    fvalid_np & terminal & (ebits_np != 0))[0]
-                for k in term_hits:
-                    bits = int(ebits_np[k])
-                    for i in eventually_idx:
-                        if bits & (1 << i) and \
-                                properties[i].name not in discoveries:
-                            discoveries[properties[i].name] = \
-                                int(frontier_fps[k])
+                if disc_hit[i] and prop.name not in discoveries:
+                    discoveries[prop.name] = int(disc_fps[i])
 
-            # mirror the newly inserted (fingerprint, parent) pairs
-            new_idx = np.nonzero(inserted)[0]
-            for k in new_idx:
-                generated[int(child_fps[k])] = \
-                    int(frontier_fps[k // n_actions])
+            # mirror the newly inserted (fingerprint, parent) pairs:
+            # 16 bytes per new state over the host link
+            if count:
+                chi_h, clo_h, phi_h, plo_h = jax.device_get(take_fn(
+                    comp_chi, comp_clo, comp_phi, comp_plo, _bucket(count)))
+                fp_c = _combine64(chi_h[:count], clo_h[:count])
+                fp_p = _combine64(phi_h[:count], plo_h[:count])
+                generated.update(zip(fp_c.tolist(), fp_p.tolist()))
             self._unique_state_count = len(generated)
 
             if len(discoveries) == prop_count:
@@ -242,15 +497,10 @@ class TpuChecker(HostChecker):
                 key_hi, key_lo = self._bulk_insert(
                     insert_fn, key_hi, key_lo, list(generated.keys()))
 
-            # next frontier segments: device gather of winner rows
-            for start in range(0, len(new_idx), self._max_segment):
-                group = new_idx[start:start + self._max_segment]
-                bucket = _bucket(len(group))
-                idx = np.zeros((bucket,), dtype=np.int32)
-                idx[:len(group)] = group
-                new_fvalid = np.arange(bucket) < len(group)
-                rows, eb = gather_fn(flat, ebits_d, jnp.asarray(idx))
-                segments.append((rows, jnp.asarray(new_fvalid), eb))
+            # next frontier: the compacted child buffer, segmented lazily
+            for seg_start in range(0, count, self._max_segment):
+                seg_len = min(self._max_segment, count - seg_start)
+                segments.append((comp_rows, comp_eb, seg_start, seg_len))
 
     # ------------------------------------------------------------------
     def _bulk_insert(self, insert_fn, key_hi, key_lo, fps: List[int]):

@@ -1,0 +1,86 @@
+"""Shared frontier-expansion core used by every device engine.
+
+One place implements what the reference's ``check_block`` does per state
+(`/root/reference/src/checker/bfs.rs:165-274`) — property evaluation,
+eventually-bit clearing, action expansion with validity masking, and
+fingerprinting — so the single-chip level step (`checker/tpu.py`), the
+device-resident loop (`checker/device_loop.py`), and the SPMD sharded step
+(`parallel/sharded.py`) compose it with their own dedup/enqueue policies
+without drifting apart.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import Expectation
+from .hash_kernel import fp64_device
+
+
+class Expansion(NamedTuple):
+    pbits: jax.Array     # bool[F, P]  property bits per frontier row
+    ebits: jax.Array     # uint32[F]   eventually-bits after clearing
+    flat: jax.Array      # uint32[F*A, W] children (action-major per row)
+    cvalid: jax.Array    # bool[F*A]   child validity (enabled & non-no-op)
+    chi: jax.Array       # uint32[F*A] child fingerprints
+    clo: jax.Array
+    phi: jax.Array       # uint32[F]   frontier fingerprints
+    plo: jax.Array
+    terminal: jax.Array  # bool[F]     rows with no valid action
+
+
+def eventually_indices(properties) -> list:
+    return [i for i, p in enumerate(properties)
+            if p.expectation == Expectation.EVENTUALLY]
+
+
+def expand_frontier(model, frontier, fvalid, ebits,
+                    eventually_idx: Sequence[int]) -> Expansion:
+    """Evaluate properties and expand one frontier batch (pure JAX)."""
+    fcount = frontier.shape[0]
+    width = model.packed_width
+    pbits = jax.vmap(model.packed_properties)(frontier)
+    if eventually_idx:
+        sat = jnp.zeros((fcount,), dtype=jnp.uint32)
+        for i in eventually_idx:
+            sat = sat | jnp.where(pbits[:, i], jnp.uint32(1 << i),
+                                  jnp.uint32(0))
+        ebits = ebits & ~sat
+    succ, avalid = jax.vmap(model.packed_step)(frontier)
+    avalid = avalid & fvalid[:, None]
+    flat = succ.reshape((-1, width))
+    chi, clo = fp64_device(flat)
+    phi, plo = fp64_device(frontier)
+    terminal = fvalid & ~avalid.any(axis=1)
+    return Expansion(pbits=pbits, ebits=ebits, flat=flat,
+                     cvalid=avalid.reshape(-1), chi=chi, clo=clo,
+                     phi=phi, plo=plo, terminal=terminal)
+
+
+def discovery_candidates(properties, exp: Expansion, fvalid):
+    """Per-property (hit, fp_hi, fp_lo) selection on the frontier batch.
+
+    ALWAYS: a row where the condition is false; SOMETIMES: a row where it
+    holds; EVENTUALLY: a terminal row whose bit is still set
+    (`bfs.rs:192-226`, `:265-272`).
+    """
+    hit_l, hi_l, lo_l = [], [], []
+    term_flush = exp.terminal & (exp.ebits != 0)
+    for i, prop in enumerate(properties):
+        if prop.expectation == Expectation.ALWAYS:
+            mask = fvalid & ~exp.pbits[:, i]
+        elif prop.expectation == Expectation.SOMETIMES:
+            mask = fvalid & exp.pbits[:, i]
+        else:
+            mask = term_flush & ((exp.ebits >> i) & 1).astype(bool)
+        k = jnp.argmax(mask)
+        hit_l.append(mask.any())
+        hi_l.append(exp.phi[k])
+        lo_l.append(exp.plo[k])
+    if not hit_l:
+        z32 = jnp.zeros((0,), jnp.uint32)
+        return jnp.zeros((0,), bool), z32, z32
+    return jnp.stack(hit_l), jnp.stack(hi_l), jnp.stack(lo_l)

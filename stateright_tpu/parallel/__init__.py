@@ -1,0 +1,13 @@
+"""Multi-chip scale-out for the TPU checking engine.
+
+The reference scales with shared-memory worker threads and a condvar job
+market (`/root/reference/src/checker/bfs.rs:70-152`). The TPU-native analog
+is SPMD frontier sharding: states are owned by the chip selected by their
+fingerprint prefix, the visited table is sharded the same way, and each BFS
+level ends with an ICI exchange routing newly generated children to their
+owner shard (SURVEY.md §2.7, §5 "distributed communication backend").
+"""
+
+from .sharded import build_sharded_level, ShardedLevelOutputs
+
+__all__ = ["build_sharded_level", "ShardedLevelOutputs"]

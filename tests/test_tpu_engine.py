@@ -186,3 +186,58 @@ def test_tpu_requires_packed_model():
     from stateright_tpu.models import LinearEquation
     with pytest.raises(TypeError):
         LinearEquation(2, 10, 14).checker().spawn_tpu()
+
+
+def test_tpu_level_mode_grows_mid_level():
+    # Regression: in per-level mode a single level's insert batch can exceed
+    # the growth headroom; the engine must grow and retry the level rather
+    # than overflow (and join() must surface engine errors, not swallow
+    # them).
+    checker = (TwoPhaseSys(4).checker()
+               .tpu_options(mode="level", capacity=256, max_segment=64)
+               .spawn_tpu().join())
+    host = TwoPhaseSys(4).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == host.unique_state_count()
+    assert set(checker._generated.keys()) == set(host._generated.keys())
+
+
+def test_tpu_visitor_with_device_mode_rejected():
+    from stateright_tpu.checker.visitor import StateRecorder
+    rec, _ = StateRecorder.new_with_accessor()
+    with pytest.raises(ValueError):
+        (TwoPhaseSys(2).checker().visitor(rec)
+         .tpu_options(mode="device").spawn_tpu().join())
+
+
+def test_tpu_unknown_mode_rejected():
+    with pytest.raises(ValueError):
+        (TwoPhaseSys(2).checker()
+         .tpu_options(mode="lvel").spawn_tpu().join())
+
+
+def test_join_reraises_engine_errors():
+    # spawn_tpu runs init_states on the background worker, so the failure
+    # must travel through the _error capture to join() (spawn_bfs would
+    # raise synchronously at construction and not exercise that path).
+    class Exploding(TwoPhaseSys):
+        def init_states(self):
+            raise RuntimeError("boom")
+    with pytest.raises(RuntimeError, match="boom"):
+        Exploding(2).checker().spawn_tpu().join()
+
+
+def test_report_surfaces_engine_errors():
+    import io
+    checker = TwoPhaseSys(2).checker().tpu_options(mode="lvel").spawn_tpu()
+    with pytest.raises(ValueError):
+        checker.report(io.StringIO())
+
+
+def test_tpu_device_mode_grows_from_tiny_capacity():
+    # Regression: device mode must leave one iteration of table headroom so
+    # growth fires before a probe overflow even with tiny capacity.
+    checker = (TwoPhaseSys(4).checker()
+               .tpu_options(mode="device", capacity=256, fmax=64)
+               .spawn_tpu().join())
+    host = TwoPhaseSys(4).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == host.unique_state_count()
