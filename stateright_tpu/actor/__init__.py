@@ -1,0 +1,22 @@
+"""Actor framework: model-checkable AND runnable event-driven actors.
+
+Layer L4/L5 of the reference (`/root/reference/src/actor.rs`,
+`src/actor/{model,model_state,network,spawn}.rs`): the same ``Actor``
+implementations are exhaustively model-checked through :class:`ActorModel`
+(which implements the core ``Model`` protocol) and executed over real UDP
+sockets via :func:`spawn` — the framework's signature dual use.
+"""
+
+from .core import (Actor, CancelTimer, Envelope, Id, Out, Send, SetTimer,
+                   is_no_op, majority, model_peers, model_timeout)
+from .model import (ActorModel, ActorModelState, Deliver, Drop, Timeout)
+from .network import (Network, Ordered, UnorderedDuplicating,
+                      UnorderedNonDuplicating)
+
+__all__ = [
+    "Actor", "ActorModel", "ActorModelState", "CancelTimer", "Deliver",
+    "Drop", "Envelope", "Id", "Network", "Ordered", "Out", "Send",
+    "SetTimer", "Timeout", "UnorderedDuplicating",
+    "UnorderedNonDuplicating", "is_no_op", "majority", "model_peers",
+    "model_timeout",
+]

@@ -1,0 +1,131 @@
+"""Actor protocol: event-driven state machines that can be model-checked
+and executed.
+
+Mirrors the reference's ``Actor`` trait and effect vocabulary
+(`/root/reference/src/actor.rs:243-286`, `:154-231`). One Python-idiomatic
+divergence: where the reference passes ``&mut Cow<State>`` and detects
+no-ops via ``Cow::Borrowed`` (`src/actor.rs:233-237`), handlers here
+*return* the next state — ``None`` means "unchanged", which combined with an
+empty ``Out`` is the no-op signal the model uses to prune actions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable, List, Optional, Tuple
+
+
+class Id(int):
+    """Uniquely identifies an actor. Encodes an index for model-checked
+    actors and an IPv4 socket address for spawned actors
+    (`src/actor.rs:107-151`, `src/actor/spawn.rs:9-33`)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # Id(3) — matches the reference's Debug
+        return f"Id({int(self)})"
+
+    # --- runtime encoding: (ip << 16) | port, as in spawn.rs:9-33 --------
+    @staticmethod
+    def from_socket_addr(ip: Tuple[int, int, int, int], port: int) -> "Id":
+        ip_u32 = (ip[0] << 24) | (ip[1] << 16) | (ip[2] << 8) | ip[3]
+        return Id((ip_u32 << 16) | port)
+
+    def socket_addr(self) -> Tuple[Tuple[int, int, int, int], int]:
+        v = int(self)
+        ip_u32 = (v >> 16) & 0xFFFFFFFF
+        ip = ((ip_u32 >> 24) & 0xFF, (ip_u32 >> 16) & 0xFF,
+              (ip_u32 >> 8) & 0xFF, ip_u32 & 0xFF)
+        return ip, v & 0xFFFF
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """A message in flight (`src/actor/network.rs:24-39`)."""
+    src: Id
+    dst: Id
+    msg: Any
+
+
+# --- commands (`src/actor.rs:154-165`) -------------------------------------
+
+@dataclass(frozen=True)
+class Send:
+    dst: Id
+    msg: Any
+
+
+@dataclass(frozen=True)
+class SetTimer:
+    """Durations only matter at runtime; the model reduces a set timer to a
+    boolean (`src/actor/model.rs:59-64`)."""
+    min_seconds: float
+    max_seconds: float
+
+
+@dataclass(frozen=True)
+class CancelTimer:
+    pass
+
+
+class Out(list):
+    """Commands collected from an actor handler (`src/actor.rs:167-231`)."""
+
+    def send(self, recipient: Id, msg: Any) -> None:
+        self.append(Send(Id(recipient), msg))
+
+    def broadcast(self, recipients: Iterable[Id], msg: Any) -> None:
+        for recipient in recipients:
+            self.send(recipient, msg)
+
+    def set_timer(self, timer_range: Tuple[float, float]) -> None:
+        lo, hi = timer_range
+        self.append(SetTimer(lo, hi))
+
+    def cancel_timer(self) -> None:
+        self.append(CancelTimer())
+
+
+class Actor:
+    """An event-driven state machine (`src/actor.rs:243-286`).
+
+    The same instance serves model checking (`ActorModel`) and real
+    execution (`spawn`) — the framework's signature dual use.
+    """
+
+    def on_start(self, id: Id, o: Out) -> Any:
+        """Return the initial state, optionally emitting commands."""
+        raise NotImplementedError
+
+    def on_msg(self, id: Id, state: Any, src: Id, msg: Any,
+               o: Out) -> Optional[Any]:
+        """Handle a delivery; return the next state or ``None`` if
+        unchanged (the ``Cow::Borrowed`` analog)."""
+        return None
+
+    def on_timeout(self, id: Id, state: Any, o: Out) -> Optional[Any]:
+        return None
+
+
+def is_no_op(next_state: Optional[Any], out: Out) -> bool:
+    """True if the actor neither changed state nor emitted commands
+    (`src/actor.rs:233-237`)."""
+    return next_state is None and not out
+
+
+# --- helpers ----------------------------------------------------------------
+
+def majority(participant_count: int) -> int:
+    """Minimum size of a majority (`src/actor.rs:440-442`)."""
+    return participant_count // 2 + 1
+
+
+def model_peers(self_ix: int, count: int) -> List[Id]:
+    """All ids but one's own (`src/actor/model.rs:68-73`)."""
+    return [Id(j) for j in range(count) if j != self_ix]
+
+
+def model_timeout() -> Tuple[float, float]:
+    """Arbitrary zero-length timer range for model checking
+    (`src/actor/model.rs:59-64`)."""
+    return (0.0, 0.0)

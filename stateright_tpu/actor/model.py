@@ -1,0 +1,246 @@
+"""``ActorModel``: N actors + a network (+ optional history) as a ``Model``.
+
+The bridge between the actor world and the checker world — a direct
+behavioral port of `/root/reference/src/actor/model.rs` (struct `:27-40`,
+builder `:79-155`, ``Model`` impl `:187-494`). Because it implements the
+``Model`` protocol, every engine (host BFS/DFS and, via the packed actor
+encoding, ``spawn_tpu``) checks actor systems without knowing about actors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from ..core import Expectation, Model, Property
+from .core import (Actor, CancelTimer, Envelope, Id, Out, Send, SetTimer,
+                   is_no_op)
+from .network import Network, Ordered
+
+
+@dataclass(frozen=True)
+class ActorModelState:
+    """Snapshot of the entire actor system
+    (`src/actor/model_state.rs:10-15`)."""
+    actor_states: Tuple[Any, ...]
+    network: Network
+    is_timer_set: Tuple[bool, ...]
+    history: Any = None
+
+    def representative(self) -> "ActorModelState":
+        """Symmetry canonicalization: sort actor states and rewrite ids
+        (`model_state.rs:103-118`)."""
+        from ..checker.representative import RewritePlan, rewrite_value
+        plan = RewritePlan.from_values_to_sort(self.actor_states)
+        return ActorModelState(
+            actor_states=plan.reindex(self.actor_states),
+            network=rewrite_value(self.network, plan),
+            is_timer_set=plan.reindex(self.is_timer_set),
+            history=rewrite_value(self.history, plan),
+        )
+
+
+# --- actions (`model.rs:43-51`) --------------------------------------------
+
+@dataclass(frozen=True)
+class Deliver:
+    src: Id
+    dst: Id
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Drop:
+    envelope: Envelope
+
+
+@dataclass(frozen=True)
+class Timeout:
+    id: Id
+
+
+class ActorModel(Model):
+    """Builder + ``Model`` implementation (`model.rs:79-155`, `:187-494`).
+
+    ``record_msg_in``/``record_msg_out`` return a new history or ``None``
+    for "unchanged" — the consistency testers hook in here.
+    """
+
+    def __init__(self, cfg: Any = None, init_history: Any = None):
+        self.actors: List[Actor] = []
+        self.cfg = cfg
+        self.init_history = init_history
+        self.init_network_: Network = Network.new_unordered_duplicating()
+        self.lossy_network_: bool = False
+        self.properties_: List[Property] = []
+        self.record_msg_in_: Callable = lambda cfg, history, env: None
+        self.record_msg_out_: Callable = lambda cfg, history, env: None
+        self.within_boundary_: Callable = lambda cfg, state: True
+
+    # --- builder ---------------------------------------------------------
+    def actor(self, actor: Actor) -> "ActorModel":
+        self.actors.append(actor)
+        return self
+
+    def with_actors(self, actors: Iterable[Actor]) -> "ActorModel":
+        self.actors.extend(actors)
+        return self
+
+    def init_network(self, network: Network) -> "ActorModel":
+        self.init_network_ = network
+        return self
+
+    def lossy_network(self, lossy: bool) -> "ActorModel":
+        self.lossy_network_ = lossy
+        return self
+
+    def property(self, *args):
+        """Two roles, as in the reference: with one argument, the ``Model``
+        lookup (`src/lib.rs:218-225`); with three, the builder method
+        adding a property (`model.rs:119-125`)."""
+        if len(args) == 1:
+            return super().property(args[0])
+        expectation, name, condition = args
+        self.properties_.append(Property(expectation, name, condition))
+        return self
+
+    def record_msg_in(self, fn: Callable) -> "ActorModel":
+        self.record_msg_in_ = fn
+        return self
+
+    def record_msg_out(self, fn: Callable) -> "ActorModel":
+        self.record_msg_out_ = fn
+        return self
+
+    def within_boundary_fn(self, fn: Callable) -> "ActorModel":
+        self.within_boundary_ = fn
+        return self
+
+    # --- command processing (`model.rs:157-184`) --------------------------
+    def _process_commands(self, id: Id, out: Out, actor_states: list,
+                          network: Network, is_timer_set: list,
+                          history: Any) -> Tuple[Network, Any]:
+        index = int(id)
+        for command in out:
+            if isinstance(command, Send):
+                env = Envelope(src=id, dst=command.dst, msg=command.msg)
+                new_history = self.record_msg_out_(self.cfg, history, env)
+                if new_history is not None:
+                    history = new_history
+                network = network.send(env)
+            elif isinstance(command, SetTimer):
+                while len(is_timer_set) <= index:
+                    is_timer_set.append(False)
+                is_timer_set[index] = True
+            elif isinstance(command, CancelTimer):
+                is_timer_set[index] = False
+            else:
+                raise TypeError(f"unknown command {command!r}")
+        return network, history
+
+    # --- Model implementation (`model.rs:187-494`) ------------------------
+    def init_states(self) -> List[ActorModelState]:
+        actor_states: list = []
+        network = self.init_network_
+        is_timer_set = [False] * len(self.actors)
+        history = self.init_history
+        for index, actor in enumerate(self.actors):
+            id = Id(index)
+            out = Out()
+            state = actor.on_start(id, out)
+            actor_states.append(state)
+            network, history = self._process_commands(
+                id, out, actor_states, network, is_timer_set, history)
+        return [ActorModelState(
+            actor_states=tuple(actor_states), network=network,
+            is_timer_set=tuple(is_timer_set), history=history)]
+
+    def actions(self, state: ActorModelState, actions: List) -> None:
+        prev_channel = None  # only deliver the head of an ordered channel
+        for env in state.network.iter_deliverable():
+            # option 1: message is lost
+            if self.lossy_network_:
+                actions.append(Drop(env))
+            # option 2: message is delivered (ignored if recipient DNE)
+            if int(env.dst) < len(self.actors):
+                if isinstance(self.init_network_, Ordered):
+                    curr_channel = (env.src, env.dst)
+                    if prev_channel == curr_channel:
+                        continue  # queued behind previous
+                    prev_channel = curr_channel
+                actions.append(Deliver(src=env.src, dst=env.dst,
+                                       msg=env.msg))
+        # option 3: actor timeout
+        for index, is_scheduled in enumerate(state.is_timer_set):
+            if is_scheduled:
+                actions.append(Timeout(Id(index)))
+
+    def next_state(self, last_sys_state: ActorModelState,
+                   action: Any) -> Optional[ActorModelState]:
+        if isinstance(action, Drop):
+            return ActorModelState(
+                actor_states=last_sys_state.actor_states,
+                network=last_sys_state.network.on_drop(action.envelope),
+                is_timer_set=last_sys_state.is_timer_set,
+                history=last_sys_state.history)
+
+        if isinstance(action, Deliver):
+            index = int(action.dst)
+            if index >= len(last_sys_state.actor_states):
+                return None  # not all messages can be delivered
+            last_actor_state = last_sys_state.actor_states[index]
+            out = Out()
+            next_actor_state = self.actors[index].on_msg(
+                action.dst, last_actor_state, action.src, action.msg, out)
+            if is_no_op(next_actor_state, out):
+                return None
+            env = Envelope(src=action.src, dst=action.dst, msg=action.msg)
+            history = self.record_msg_in_(
+                self.cfg, last_sys_state.history, env)
+            if history is None:
+                history = last_sys_state.history
+
+            actor_states = list(last_sys_state.actor_states)
+            if next_actor_state is not None:
+                actor_states[index] = next_actor_state
+            network = last_sys_state.network.on_deliver(env)
+            is_timer_set = list(last_sys_state.is_timer_set)
+            network, history = self._process_commands(
+                action.dst, out, actor_states, network, is_timer_set,
+                history)
+            return ActorModelState(
+                actor_states=tuple(actor_states), network=network,
+                is_timer_set=tuple(is_timer_set), history=history)
+
+        if isinstance(action, Timeout):
+            index = int(action.id)
+            out = Out()
+            next_actor_state = self.actors[index].on_timeout(
+                action.id, last_sys_state.actor_states[index], out)
+            keep_timer = any(isinstance(c, SetTimer) for c in out)
+            if is_no_op(next_actor_state, out) and keep_timer:
+                return None
+            actor_states = list(last_sys_state.actor_states)
+            if next_actor_state is not None:
+                actor_states[index] = next_actor_state
+            is_timer_set = list(last_sys_state.is_timer_set)
+            is_timer_set[index] = False  # timer is no longer valid
+            network, history = self._process_commands(
+                action.id, out, actor_states, last_sys_state.network,
+                is_timer_set, last_sys_state.history)
+            return ActorModelState(
+                actor_states=tuple(actor_states), network=network,
+                is_timer_set=tuple(is_timer_set), history=history)
+
+        raise TypeError(f"unknown action {action!r}")
+
+    def properties(self) -> List[Property]:
+        return list(self.properties_)
+
+    def within_boundary(self, state: ActorModelState) -> bool:
+        return self.within_boundary_(self.cfg, state)
+
+    def format_action(self, action: Any) -> str:
+        if isinstance(action, Deliver):
+            return f"{action.src!r} → {action.msg!r} → {action.dst!r}"
+        return repr(action)

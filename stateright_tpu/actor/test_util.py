@@ -1,0 +1,104 @@
+"""Actor test fixtures: the ping_pong pair.
+
+Port of `/root/reference/src/actor/actor_test_util.rs:4-96` — two actors
+bouncing an incrementing counter, with optional (in, out) message-count
+history and six properties (two deliberately falsifiable). Its exact state
+counts anchor many engine tests: lossy duplicating max 5 -> 4,094 unique
+states; lossless nonduplicating max 5 -> 11 (`src/actor/model.rs:611`,
+`:642`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..core import Expectation
+from .core import Actor, Id, Out
+from .model import ActorModel
+
+
+@dataclass(frozen=True)
+class Ping:
+    value: int
+
+
+@dataclass(frozen=True)
+class Pong:
+    value: int
+
+
+class PingPongActor(Actor):
+    def __init__(self, serve_to: Optional[Id]):
+        self.serve_to = serve_to
+
+    def on_start(self, id: Id, o: Out) -> int:
+        if self.serve_to is not None:
+            o.send(self.serve_to, Ping(0))
+        return 0
+
+    def on_msg(self, id: Id, state: int, src: Id, msg: Any,
+               o: Out) -> Optional[int]:
+        if isinstance(msg, Pong) and state == msg.value:
+            o.send(src, Ping(msg.value + 1))
+            return state + 1
+        if isinstance(msg, Ping) and state == msg.value:
+            o.send(src, Pong(msg.value))
+            return state + 1
+        return None
+
+
+@dataclass
+class PingPongCfg:
+    maintains_history: bool
+    max_nat: int
+
+    def into_model(self) -> ActorModel:
+        def record_msg_in(cfg, history, env):
+            if cfg.maintains_history:
+                msg_in, msg_out = history
+                return (msg_in + 1, msg_out)
+            return None
+
+        def record_msg_out(cfg, history, env):
+            if cfg.maintains_history:
+                msg_in, msg_out = history
+                return (msg_in, msg_out + 1)
+            return None
+
+        return (ActorModel(cfg=self, init_history=(0, 0))
+                .actor(PingPongActor(serve_to=Id(1)))
+                .actor(PingPongActor(serve_to=None))
+                .record_msg_in(record_msg_in)
+                .record_msg_out(record_msg_out)
+                .within_boundary_fn(
+                    lambda cfg, state: all(
+                        count <= cfg.max_nat
+                        for count in state.actor_states))
+                .property(
+                    Expectation.ALWAYS, "delta within 1",
+                    lambda _, state: (max(state.actor_states)
+                                      - min(state.actor_states)) <= 1)
+                .property(
+                    Expectation.SOMETIMES, "can reach max",
+                    lambda model, state: any(
+                        count == model.cfg.max_nat
+                        for count in state.actor_states))
+                .property(
+                    Expectation.EVENTUALLY, "must reach max",
+                    lambda model, state: any(
+                        count == model.cfg.max_nat
+                        for count in state.actor_states))
+                .property(
+                    # falsifiable due to the boundary
+                    Expectation.EVENTUALLY, "must exceed max",
+                    lambda model, state: any(
+                        count == model.cfg.max_nat + 1
+                        for count in state.actor_states))
+                .property(
+                    Expectation.ALWAYS, "#in <= #out",
+                    lambda _, state: state.history[0] <= state.history[1])
+                .property(
+                    Expectation.EVENTUALLY, "#out <= #in + 1",
+                    lambda _, state: state.history[1]
+                    <= state.history[0] + 1))

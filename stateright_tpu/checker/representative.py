@@ -31,6 +31,15 @@ def rewrite_value(value: Any, plan: "RewritePlan") -> Any:
     rw = getattr(value, "rewrite", None)
     if rw is not None:
         return rw(plan)
+    from ..actor.core import Id
+    if isinstance(value, Id):
+        # actor ids permute; plain ints do not (`rewrite.rs:119-124`)
+        return Id(plan.rewrite(value))
+    import dataclasses
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return type(value)(**{
+            f.name: rewrite_value(getattr(value, f.name), plan)
+            for f in dataclasses.fields(value)})
     if isinstance(value, tuple):
         return tuple(rewrite_value(v, plan) for v in value)
     if isinstance(value, list):

@@ -1,0 +1,7 @@
+"""Example protocol workloads — the benchmark suite.
+
+Each module mirrors one of the reference's examples
+(`/root/reference/examples/`) and exposes ``main()`` with the same
+subcommands (``check`` / ``check-sym`` / ``explore`` / ``spawn``) plus an
+extra ``check-tpu`` strategy where a packed encoding exists.
+"""

@@ -1,0 +1,27 @@
+"""Semantics layer: sequential specs + consistency testers.
+
+Layer L7 of the reference (`/root/reference/src/semantics.rs` and
+`src/semantics/*`): define correctness via a sequential "reference object"
+(:class:`SequentialSpec`), then verify a concurrent system against a
+consistency model by recording operation invocations/returns in a
+:class:`ConsistencyTester` carried as the ``ActorModel`` history and queried
+inside ``Property`` conditions (e.g. `examples/paxos.rs:252-254`).
+
+The testers run host-side: the serialization search is irregular recursion
+(SURVEY.md §7 stage 5); on TPU runs it executes per *new* history on the
+host, not per state on device.
+"""
+
+from .core import ConsistencyTester, SequentialSpec
+from .linearizability import LinearizabilityTester
+from .register import Read, ReadOk, Register, Write, WriteOk
+from .sequential_consistency import SequentialConsistencyTester
+from .vec import Len, LenOk, Pop, PopOk, Push, PushOk, VecSpec
+from .write_once_register import WORegister, WriteFail
+
+__all__ = [
+    "ConsistencyTester", "LinearizabilityTester", "Len", "LenOk", "Pop",
+    "PopOk", "Push", "PushOk", "Read", "ReadOk", "Register",
+    "SequentialConsistencyTester", "SequentialSpec", "VecSpec",
+    "WORegister", "Write", "WriteFail", "WriteOk",
+]

@@ -1,0 +1,155 @@
+"""Linearizability tester
+(`/root/reference/src/semantics/linearizability.rs`).
+
+Captures real-time ordering without a global clock: each invocation records,
+per *other* thread, the index of that thread's last completed operation
+(`linearizability.rs:102-125`). ``serialized_history`` then searches the
+interleavings recursively, pruning when a candidate step would place an
+operation before one of its recorded prerequisites or fail the sequential
+spec (`:177-240`) — worst-case exponential, which is why the framework runs
+it host-side (it executes inside ``Property`` conditions, once per explored
+history).
+
+The tester is a value carried in model state ``history``: equality, hash,
+and stable fingerprints are defined over its canonical contents, and the
+record hooks clone before mutating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import ConsistencyTester, SequentialSpec
+
+
+class LinearizabilityTester(ConsistencyTester):
+    def __init__(self, init_ref_obj: SequentialSpec):
+        self._init = init_ref_obj
+        # thread -> list of (last_completed: dict peer->index, op, ret)
+        self._history: Dict[Any, List[Tuple[dict, Any, Any]]] = {}
+        # thread -> (last_completed, op)
+        self._in_flight: Dict[Any, Tuple[dict, Any]] = {}
+        self._valid = True
+
+    # --- value semantics -------------------------------------------------
+    def clone(self) -> "LinearizabilityTester":
+        dup = LinearizabilityTester(self._init.clone())
+        dup._history = {t: list(h) for t, h in self._history.items()}
+        dup._in_flight = dict(self._in_flight)
+        dup._valid = self._valid
+        return dup
+
+    def _key(self):
+        return (self._init,
+                tuple(sorted(
+                    (t, tuple((tuple(sorted(c.items())), op, ret)
+                              for c, op, ret in h))
+                    for t, h in self._history.items())),
+                tuple(sorted(
+                    (t, (tuple(sorted(c.items())), op))
+                    for t, (c, op) in self._in_flight.items())),
+                self._valid)
+
+    def __eq__(self, other):
+        return isinstance(other, LinearizabilityTester) \
+            and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __stable_words__(self, out):
+        from ..fingerprint import stable_words
+        stable_words(("LinearizabilityTester",) + self._key(), out)
+
+    def __len__(self) -> int:
+        return len(self._in_flight) \
+            + sum(len(h) for h in self._history.values())
+
+    # --- recording (`linearizability.rs:102-155`) -------------------------
+    def on_invoke(self, thread_id, op):
+        if not self._valid:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id in self._in_flight:
+            self._valid = False
+            raise ValueError(
+                f"Thread already has an operation in flight. "
+                f"thread_id={thread_id!r}, "
+                f"op={self._in_flight[thread_id][1]!r}")
+        last_completed = {
+            t: len(h) - 1 for t, h in self._history.items()
+            if t != thread_id and h}
+        self._in_flight[thread_id] = (last_completed, op)
+        self._history.setdefault(thread_id, [])
+        return self
+
+    def on_return(self, thread_id, ret):
+        if not self._valid:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id not in self._in_flight:
+            self._valid = False
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}")
+        completed, op = self._in_flight.pop(thread_id)
+        self._history.setdefault(thread_id, []).append(
+            (completed, op, ret))
+        return self
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    # --- the search (`linearizability.rs:177-240`) ------------------------
+    def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        if not self._valid:
+            return None
+        remaining = {
+            t: [(i, entry) for i, entry in enumerate(h)]
+            for t, h in self._history.items()}
+        return _serialize([], self._init, remaining, dict(self._in_flight))
+
+
+def _violates_realtime(last_completed: dict, remaining: dict) -> bool:
+    """A step is invalid if any peer still has an operation pending at or
+    before the index this operation observed as completed."""
+    for peer_id, min_peer_time in last_completed.items():
+        ops = remaining.get(peer_id)
+        if ops:
+            next_peer_time = ops[0][0]
+            if next_peer_time <= min_peer_time:
+                return True
+    return False
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight):
+    if all(not h for h in remaining.values()):
+        return valid_history
+    for thread_id in list(remaining):
+        history = remaining[thread_id]
+        if not history:
+            # Case 1: no completed ops left; maybe an in-flight one.
+            if thread_id not in in_flight:
+                continue
+            last_completed, op = in_flight[thread_id]
+            if _violates_realtime(last_completed, remaining):
+                continue
+            obj = ref_obj.clone()
+            ret = obj.invoke(op)
+            branch_in_flight = {t: v for t, v in in_flight.items()
+                                if t != thread_id}
+            branch_remaining = remaining
+        else:
+            # Case 2: interleave this thread's next completed op.
+            _index, (last_completed, op, ret) = history[0]
+            if _violates_realtime(last_completed, remaining):
+                continue
+            obj = ref_obj.clone()
+            if not obj.is_valid_step(op, ret):
+                continue
+            branch_remaining = dict(remaining)
+            branch_remaining[thread_id] = history[1:]
+            branch_in_flight = in_flight
+        result = _serialize(valid_history + [(op, ret)], obj,
+                            branch_remaining, branch_in_flight)
+        if result is not None:
+            return result
+    return None

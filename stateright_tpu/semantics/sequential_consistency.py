@@ -1,0 +1,113 @@
+"""Sequential-consistency tester
+(`/root/reference/src/semantics/sequential_consistency.rs`): the same
+interleaving search as linearizability minus the real-time constraints —
+only per-thread program order and the sequential spec prune the search
+(`sequential_consistency.rs:166-213`)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import ConsistencyTester, SequentialSpec
+
+
+class SequentialConsistencyTester(ConsistencyTester):
+    def __init__(self, init_ref_obj: SequentialSpec):
+        self._init = init_ref_obj
+        self._history: Dict[Any, List[Tuple[Any, Any]]] = {}
+        self._in_flight: Dict[Any, Any] = {}
+        self._valid = True
+
+    # --- value semantics -------------------------------------------------
+    def clone(self) -> "SequentialConsistencyTester":
+        dup = SequentialConsistencyTester(self._init.clone())
+        dup._history = {t: list(h) for t, h in self._history.items()}
+        dup._in_flight = dict(self._in_flight)
+        dup._valid = self._valid
+        return dup
+
+    def _key(self):
+        return (self._init,
+                tuple(sorted((t, tuple(h))
+                             for t, h in self._history.items())),
+                tuple(sorted(self._in_flight.items())),
+                self._valid)
+
+    def __eq__(self, other):
+        return isinstance(other, SequentialConsistencyTester) \
+            and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __stable_words__(self, out):
+        from ..fingerprint import stable_words
+        stable_words(("SequentialConsistencyTester",) + self._key(), out)
+
+    def __len__(self) -> int:
+        return len(self._in_flight) \
+            + sum(len(h) for h in self._history.values())
+
+    # --- recording -------------------------------------------------------
+    def on_invoke(self, thread_id, op):
+        if not self._valid:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id in self._in_flight:
+            self._valid = False
+            raise ValueError(
+                f"Thread already has an operation in flight. "
+                f"thread_id={thread_id!r}")
+        self._in_flight[thread_id] = op
+        self._history.setdefault(thread_id, [])
+        return self
+
+    def on_return(self, thread_id, ret):
+        if not self._valid:
+            raise ValueError("Earlier history was invalid.")
+        if thread_id not in self._in_flight:
+            self._valid = False
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}")
+        op = self._in_flight.pop(thread_id)
+        self._history.setdefault(thread_id, []).append((op, ret))
+        return self
+
+    def is_consistent(self) -> bool:
+        return self.serialized_history() is not None
+
+    # --- the search ------------------------------------------------------
+    def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        if not self._valid:
+            return None
+        remaining = {t: list(h) for t, h in self._history.items()}
+        return _serialize([], self._init, remaining, dict(self._in_flight))
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight):
+    if all(not h for h in remaining.values()):
+        return valid_history
+    for thread_id in list(remaining):
+        history = remaining[thread_id]
+        if not history:
+            if thread_id not in in_flight:
+                continue
+            op = in_flight[thread_id]
+            obj = ref_obj.clone()
+            ret = obj.invoke(op)
+            branch_in_flight = {t: v for t, v in in_flight.items()
+                                if t != thread_id}
+            branch_remaining = remaining
+        else:
+            op, ret = history[0]
+            obj = ref_obj.clone()
+            if not obj.is_valid_step(op, ret):
+                continue
+            branch_remaining = dict(remaining)
+            branch_remaining[thread_id] = history[1:]
+            branch_in_flight = in_flight
+        result = _serialize(valid_history + [(op, ret)], obj,
+                            branch_remaining, branch_in_flight)
+        if result is not None:
+            return result
+    return None

@@ -1,0 +1,239 @@
+"""ActorModel tests, mirroring the reference's oracles
+(`/root/reference/src/actor/model.rs` tests)."""
+
+from typing import Optional
+
+import pytest
+
+from stateright_tpu.actor import (Actor, ActorModel, Deliver, Drop, Envelope,
+                                 Id, Network, Out, Timeout, model_timeout)
+from stateright_tpu.actor.test_util import PingPongCfg
+from stateright_tpu.checker.visitor import PathRecorder, StateRecorder
+from stateright_tpu.core import Expectation
+
+
+def test_ping_pong_lossy_duplicating_counts():
+    # `model.rs:603-614`: 4,094 unique states; safety holds.
+    checker = (PingPongCfg(max_nat=5, maintains_history=False)
+               .into_model()
+               .lossy_network(True)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 4_094
+    checker.assert_no_discovery("delta within 1")
+
+
+def test_ping_pong_may_never_reach_max_on_lossy_network():
+    # `model.rs:616-631`: dropping the first message gets stuck.
+    checker = (PingPongCfg(max_nat=5, maintains_history=False)
+               .into_model()
+               .lossy_network(True)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 4_094
+    from stateright_tpu.actor.test_util import Ping
+    checker.assert_discovery("must reach max", [
+        Drop(Envelope(src=Id(0), dst=Id(1), msg=Ping(0))),
+    ])
+
+
+def test_ping_pong_eventually_reaches_max_on_perfect_network():
+    # `model.rs:633-646`: 11 unique states, no liveness counterexample.
+    checker = (PingPongCfg(max_nat=5, maintains_history=False)
+               .into_model()
+               .init_network(Network.new_unordered_nonduplicating())
+               .lossy_network(False)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_ping_pong_can_reach_max():
+    # `model.rs:648-663`
+    checker = (PingPongCfg(max_nat=5, maintains_history=False)
+               .into_model()
+               .lossy_network(False)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 11
+    found = checker.discovery("can reach max")
+    assert found.last_state().actor_states == (4, 5)
+
+
+def test_ping_pong_might_never_reach_beyond_max():
+    # `model.rs:665-687`: falsifiable liveness due to the boundary.
+    checker = (PingPongCfg(max_nat=5, maintains_history=False)
+               .into_model()
+               .init_network(Network.new_unordered_nonduplicating())
+               .lossy_network(False)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 11
+    found = checker.discovery("must exceed max")
+    assert found.last_state().actor_states == (5, 5)
+
+
+def test_ping_pong_history_properties():
+    checker = (PingPongCfg(max_nat=3, maintains_history=True)
+               .into_model()
+               .init_network(Network.new_unordered_nonduplicating())
+               .checker().spawn_bfs().join())
+    checker.assert_no_discovery("#in <= #out")
+    checker.assert_no_discovery("#out <= #in + 1")
+
+
+def test_handles_undeliverable_messages():
+    # `model.rs:689-699`: a message to a nonexistent actor is ignored.
+    class Unit(Actor):
+        def on_start(self, id, o):
+            return ()
+
+    checker = (ActorModel()
+               .actor(Unit())
+               .property(Expectation.ALWAYS, "unused", lambda _, __: True)
+               .init_network(Network.new_unordered_duplicating(
+                   [Envelope(src=Id(0), dst=Id(99), msg=())]))
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 1
+
+
+class _CountdownActor(Actor):
+    """`model.rs:697-716`: actor 0 sends 2 then 1; actor 1 records order."""
+
+    def on_start(self, id, o):
+        if id == Id(0):
+            o.send(Id(1), 2)
+            o.send(Id(1), 1)
+        return ()
+
+    def on_msg(self, id, state, src, msg, o):
+        return state + (msg,)
+
+
+def test_ordered_network_flag():
+    # `model.rs:695-752`: ordered nets deliver 2 then 1 only; unordered
+    # nets explore both interleavings.
+    def recipient_states(network):
+        recorder, accessor = StateRecorder.new_with_accessor()
+        (ActorModel()
+         .with_actors([_CountdownActor(), _CountdownActor()])
+         .property(Expectation.ALWAYS, "", lambda _, __: True)
+         .init_network(network)
+         .checker().visitor(recorder).spawn_bfs().join())
+        return [s.actor_states[1] for s in accessor()]
+
+    ordered = recipient_states(Network.new_ordered())
+    assert ordered == [(), (2,), (2, 1)]
+
+    unordered = recipient_states(Network.new_unordered_nonduplicating())
+    assert sorted(unordered) == sorted(
+        [(), (2,), (1,), (2, 1), (1, 2)])
+
+
+class _DupCounter(Actor):
+    """`model.rs:754-836`: actor 0 sends the same message twice."""
+
+    def on_start(self, id, o):
+        if id == Id(0):
+            o.send(Id(1), ())
+            o.send(Id(1), ())
+        return 0
+
+    def on_msg(self, id, state, src, msg, o):
+        return state + 1
+
+
+def _action_sequences(lossy: bool, network):
+    recorder, accessor = PathRecorder.new_with_accessor()
+    (ActorModel()
+     .with_actors([_DupCounter(), _DupCounter()])
+     .init_network(network)
+     .lossy_network(lossy)
+     .property(Expectation.ALWAYS, "force visiting all states",
+               lambda _, __: True)
+     .within_boundary_fn(lambda _, s: s.actor_states[1] < 4)
+     .checker().visitor(recorder).spawn_dfs().join())
+    return {tuple(p.into_actions()) for p in accessor()}
+
+
+def test_unordered_network_drop_semantics():
+    # The reference's meta-test of modeled race semantics
+    # (`model.rs:754-836`).
+    deliver = Deliver(src=Id(0), dst=Id(1), msg=())
+    drop = Drop(Envelope(src=Id(0), dst=Id(1), msg=()))
+
+    ordered_lossless = _action_sequences(False, Network.new_ordered())
+    assert (deliver, deliver) in ordered_lossless
+    assert (deliver, deliver, deliver) not in ordered_lossless
+    ordered_lossy = _action_sequences(True, Network.new_ordered())
+    assert (deliver, deliver) in ordered_lossy
+    assert (deliver, drop) in ordered_lossy
+    assert (drop, drop) in ordered_lossy
+
+    unord_dup_lossless = _action_sequences(
+        False, Network.new_unordered_duplicating())
+    assert (deliver, deliver, deliver) in unord_dup_lossless
+    unord_dup_lossy = _action_sequences(
+        True, Network.new_unordered_duplicating())
+    assert (deliver, deliver, deliver) in unord_dup_lossy
+    assert (deliver, deliver, drop) in unord_dup_lossy
+    assert (deliver, drop) in unord_dup_lossy
+    assert (drop,) in unord_dup_lossy
+    # drop means "never deliver again"
+    assert (drop, deliver) not in unord_dup_lossy
+
+    unord_nondup_lossless = _action_sequences(
+        False, Network.new_unordered_nonduplicating())
+    assert (deliver, deliver) in unord_nondup_lossless
+    unord_nondup_lossy = _action_sequences(
+        True, Network.new_unordered_nonduplicating())
+    assert (deliver, drop) in unord_nondup_lossy
+    assert (drop, drop) in unord_nondup_lossy
+
+
+def test_resets_timer():
+    # `model.rs:838-861`: timer set at init; timeout clears it.
+    class TimerActor(Actor):
+        def on_start(self, id, o):
+            o.set_timer(model_timeout())
+            return ()
+
+        def on_msg(self, id, state, src, msg, o):
+            return None
+
+    checker = (ActorModel()
+               .actor(TimerActor())
+               .property(Expectation.ALWAYS, "unused", lambda _, __: True)
+               .checker().spawn_bfs().join())
+    assert checker.unique_state_count() == 2
+
+
+def test_timeout_noop_with_reset_keeps_timer_pruned():
+    # `model.rs:288-306`: a no-op timeout that re-sets its timer is pruned.
+    class RearmActor(Actor):
+        def on_start(self, id, o):
+            o.set_timer(model_timeout())
+            return ()
+
+        def on_timeout(self, id, state, o):
+            o.set_timer(model_timeout())
+            return None
+
+    checker = (ActorModel()
+               .actor(RearmActor())
+               .property(Expectation.ALWAYS, "unused", lambda _, __: True)
+               .checker().spawn_bfs().join())
+    # only the init state: the rearming timeout is a no-op transition
+    assert checker.unique_state_count() == 1
+
+
+def test_actor_model_state_representative():
+    # sorting actor states + rewriting ids (`model_state.rs:103-118`)
+    from stateright_tpu.actor import ActorModelState
+    state = ActorModelState(
+        actor_states=(2, 1),
+        network=Network.new_unordered_nonduplicating(
+            [Envelope(src=Id(0), dst=Id(1), msg=7)]),
+        is_timer_set=(True, False),
+        history=None)
+    rep = state.representative()
+    assert rep.actor_states == (1, 2)
+    assert rep.is_timer_set == (False, True)
+    assert list(rep.network.iter_all()) == [
+        Envelope(src=Id(1), dst=Id(0), msg=7)]

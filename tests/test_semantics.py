@@ -1,0 +1,204 @@
+"""Semantics-layer tests: spec tables + tester accept/reject tables.
+
+Ports of the reference's co-located tests
+(`/root/reference/src/semantics/{register,vec,write_once_register}.rs` and
+`linearizability.rs:268-453`, `sequential_consistency.rs:240-344`).
+"""
+
+import pytest
+
+from stateright_tpu.semantics import (
+    Len, LenOk, LinearizabilityTester, Pop, PopOk, Push, PushOk, Read,
+    ReadOk, Register, SequentialConsistencyTester, VecSpec, WORegister,
+    Write, WriteFail, WriteOk)
+
+
+# --- reference objects ------------------------------------------------------
+
+def test_register_semantics():
+    r = Register('A')
+    assert r.invoke(Read()) == ReadOk('A')
+    assert r.invoke(Write('B')) == WriteOk()
+    assert r.invoke(Read()) == ReadOk('B')
+
+
+def test_register_histories():
+    assert Register('A').is_valid_history([])
+    assert Register('A').is_valid_history([
+        (Read(), ReadOk('A')),
+        (Write('B'), WriteOk()),
+        (Read(), ReadOk('B')),
+        (Write('C'), WriteOk()),
+        (Read(), ReadOk('C')),
+    ])
+    assert not Register('A').is_valid_history([
+        (Read(), ReadOk('B')),
+        (Write('B'), WriteOk()),
+    ])
+    assert not Register('A').is_valid_history([
+        (Write('B'), WriteOk()),
+        (Read(), ReadOk('A')),
+    ])
+
+
+def test_wo_register_semantics():
+    # duplicate write of same value succeeds (`write_once_register.rs:32-39`)
+    r = WORegister()
+    assert r.invoke(Read()) == ReadOk(None)
+    assert r.invoke(Write('B')) == WriteOk()
+    assert r.invoke(Write('B')) == WriteOk()
+    assert r.invoke(Write('C')) == WriteFail()
+    assert r.invoke(Read()) == ReadOk('B')
+    assert WORegister().is_valid_history([
+        (Write('B'), WriteOk()),
+        (Write('C'), WriteFail()),
+        (Read(), ReadOk('B')),
+    ])
+    assert not WORegister().is_valid_history([
+        (Write('B'), WriteOk()),
+        (Write('C'), WriteOk()),
+    ])
+
+
+def test_vec_semantics():
+    v = VecSpec()
+    assert v.invoke(Pop()) == PopOk(None)
+    assert v.invoke(Push(10)) == PushOk()
+    assert v.invoke(Len()) == LenOk(1)
+    assert v.invoke(Pop()) == PopOk(10)
+    assert v.invoke(Len()) == LenOk(0)
+
+
+# --- linearizability (`linearizability.rs:268-453`) -------------------------
+
+def test_linearizability_rejects_invalid_history():
+    t = LinearizabilityTester(Register('A'))
+    t.on_invoke(99, Write('B'))
+    with pytest.raises(ValueError, match="already has an operation"):
+        t.on_invoke(99, Write('C'))
+
+    t = LinearizabilityTester(Register('A'))
+    t.on_invret(99, Write('B'), WriteOk())
+    t.on_invret(99, Write('C'), WriteOk())
+    with pytest.raises(ValueError, match="no in-flight invocation"):
+        t.on_return(99, WriteOk())
+
+
+def test_linearizable_register_history():
+    t = LinearizabilityTester(Register('A'))
+    t.on_invoke(0, Write('B'))
+    t.on_invret(1, Read(), ReadOk('A'))
+    assert t.serialized_history() == [(Read(), ReadOk('A'))]
+
+    t = LinearizabilityTester(Register('A'))
+    t.on_invoke(0, Read())
+    t.on_invoke(1, Write('B'))
+    t.on_return(0, ReadOk('B'))
+    assert t.serialized_history() == [
+        (Write('B'), WriteOk()),
+        (Read(), ReadOk('B')),
+    ]
+
+
+def test_unlinearizable_register_history():
+    t = LinearizabilityTester(Register('A'))
+    t.on_invret(0, Read(), ReadOk('B'))
+    assert t.serialized_history() is None
+
+    t = LinearizabilityTester(Register('A'))
+    t.on_invret(0, Read(), ReadOk('B'))
+    t.on_invoke(1, Write('B'))
+    assert t.serialized_history() is None  # SC but not linearizable
+
+
+def test_linearizable_vec_history():
+    t = LinearizabilityTester(VecSpec())
+    t.on_invoke(0, Push(10))
+    assert t.serialized_history() == []
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invoke(0, Push(10))
+    t.on_invret(1, Pop(), PopOk(None))
+    assert t.serialized_history() == [(Pop(), PopOk(None))]
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invoke(0, Push(10))
+    t.on_invret(1, Pop(), PopOk(10))
+    assert t.serialized_history() == [
+        (Push(10), PushOk()), (Pop(), PopOk(10))]
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(0, Push(20))
+    t.on_invret(1, Len(), LenOk(1))
+    t.on_invret(1, Pop(), PopOk(20))
+    t.on_invret(1, Pop(), PopOk(10))
+    assert t.serialized_history() == [
+        (Push(10), PushOk()), (Len(), LenOk(1)), (Push(20), PushOk()),
+        (Pop(), PopOk(20)), (Pop(), PopOk(10))]
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(1, Len())
+    t.on_invoke(0, Push(20))
+    t.on_return(1, LenOk(2))
+    assert t.serialized_history() == [
+        (Push(10), PushOk()), (Push(20), PushOk()), (Len(), LenOk(2))]
+
+
+def test_unlinearizable_vec_history():
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invret(1, Pop(), PopOk(None))
+    assert t.serialized_history() is None  # SC but not linearizable
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(1, Len())
+    t.on_invoke(0, Push(20))
+    t.on_return(1, LenOk(0))
+    assert t.serialized_history() is None
+
+    t = LinearizabilityTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invoke(0, Push(20))
+    t.on_invret(1, Len(), LenOk(2))
+    t.on_invret(1, Pop(), PopOk(10))
+    t.on_invret(1, Pop(), PopOk(20))
+    assert t.serialized_history() is None
+
+
+# --- sequential consistency -------------------------------------------------
+
+def test_sc_accepts_what_linearizability_rejects():
+    # real-time order is not an SC constraint
+    t = SequentialConsistencyTester(Register('A'))
+    t.on_invret(0, Read(), ReadOk('B'))
+    t.on_invoke(1, Write('B'))
+    assert t.serialized_history() == [
+        (Write('B'), WriteOk()), (Read(), ReadOk('B'))]
+
+    t = SequentialConsistencyTester(VecSpec())
+    t.on_invret(0, Push(10), PushOk())
+    t.on_invret(1, Pop(), PopOk(None))
+    assert t.serialized_history() is not None
+
+
+def test_sc_rejects_spec_violations():
+    t = SequentialConsistencyTester(Register('A'))
+    t.on_invret(0, Read(), ReadOk('B'))
+    assert t.serialized_history() is None
+
+
+def test_testers_are_values():
+    # clone + hash/eq over canonical contents (they ride in model state)
+    t = LinearizabilityTester(Register('A'))
+    t.on_invoke(0, Write('B'))
+    dup = t.clone()
+    assert dup == t and hash(dup) == hash(t)
+    dup.on_return(0, WriteOk())
+    assert dup != t
+
+    from stateright_tpu.fingerprint import stable_fingerprint
+    assert stable_fingerprint(t) != stable_fingerprint(dup)
+    assert stable_fingerprint(t) == stable_fingerprint(t.clone())
