@@ -29,13 +29,15 @@ from .checker import (
     rewrite_value,
 )
 from .fingerprint import fp64_words, stable_fingerprint, stable_words
+from .util import DenseNatMap, VectorClock
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Checker",
     "CheckerBuilder",
     "CheckerVisitor",
+    "DenseNatMap",
     "Expectation",
     "Model",
     "NondeterministicModelError",
@@ -45,6 +47,7 @@ __all__ = [
     "Representative",
     "RewritePlan",
     "StateRecorder",
+    "VectorClock",
     "fingerprint",
     "fp64_words",
     "rewrite_value",

@@ -1,0 +1,65 @@
+"""Native (C) components, built lazily with the system toolchain.
+
+The fingerprint core is the host engines' hottest function (profiling showed
+~90% of `paxos check 2` in pure-Python hashing), and the reference's
+equivalent is native as well (fixed-key aHash, `src/lib.rs:331-344`). The
+shared library is compiled once into this package directory and loaded via
+ctypes; every user keeps working (slower) if no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fphash.c")
+_LIB = os.path.join(_DIR, "libfphash.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            result = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                capture_output=True, timeout=120)
+            if result.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The fphash library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB) \
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+                if not _build():
+                    return None
+            lib = ctypes.CDLL(_LIB)
+            lib.fp64_words.argtypes = [
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t]
+            lib.fp64_words.restype = ctypes.c_uint64
+            lib.fp64_rows.argtypes = [
+                ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+                ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
+            lib.fp64_rows.restype = None
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib

@@ -156,18 +156,14 @@ class ActorModel(Model):
             is_timer_set=tuple(is_timer_set), history=history)]
 
     def actions(self, state: ActorModelState, actions: List) -> None:
-        prev_channel = None  # only deliver the head of an ordered channel
+        # iter_deliverable already yields exactly one head per ordered
+        # channel (`network.rs:157-170`), so no per-channel dedup is needed
         for env in state.network.iter_deliverable():
             # option 1: message is lost
             if self.lossy_network_:
                 actions.append(Drop(env))
             # option 2: message is delivered (ignored if recipient DNE)
             if int(env.dst) < len(self.actors):
-                if isinstance(self.init_network_, Ordered):
-                    curr_channel = (env.src, env.dst)
-                    if prev_channel == curr_channel:
-                        continue  # queued behind previous
-                    prev_channel = curr_channel
                 actions.append(Deliver(src=env.src, dst=env.dst,
                                        msg=env.msg))
         # option 3: actor timeout

@@ -105,6 +105,10 @@ class Checker:
         raise NotImplementedError
 
     # --- shared helpers --------------------------------------------------
+    def error(self) -> Optional[BaseException]:
+        """The engine's failure, if any (overridden by engines)."""
+        return None
+
     def discovery(self, name: str) -> Optional[Path]:
         return self.discoveries().get(name)
 
@@ -127,6 +131,9 @@ class Checker:
                     w.write(f"Checking. states={self.state_count()}, "
                             f"unique={self.unique_state_count()}\n")
                     last_print = now
+        err = self.error()
+        if err is not None:
+            raise err
         w.write(f"Done. states={self.state_count()}, "
                 f"unique={self.unique_state_count()}, "
                 f"sec={int(time.monotonic() - start)}\n")
@@ -154,10 +161,17 @@ class Checker:
             else:
                 self.assert_no_discovery(p.name)
 
+    def _raise_engine_error(self) -> None:
+        """A crashed engine must not read as "checked clean"."""
+        err = self.error()
+        if err is not None:
+            raise err
+
     def assert_any_discovery(self, name: str) -> Path:
         found = self.discovery(name)
         if found is not None:
             return found
+        self._raise_engine_error()
         assert self.is_done(), (
             f'Discovery for "{name}" not found, but model checking is '
             "incomplete.")
@@ -169,6 +183,7 @@ class Checker:
             raise AssertionError(
                 f'Unexpected "{name}" {self.discovery_classification(name)} '
                 f"{found}Last state: {found.last_state()!r}\n")
+        self._raise_engine_error()
         assert self.is_done(), (
             f'Discovery for "{name}" not found, but model checking is '
             "incomplete.")

@@ -78,10 +78,12 @@ class HostChecker(Checker):
             raise self._error
         return self
 
+    def error(self) -> Optional[BaseException]:
+        """The engine's failure, if it crashed; raised by ``join()``."""
+        return self._error
+
     def is_done(self) -> bool:
-        if self._error is not None:
-            # a crashed engine is not "done": surface the failure on the
-            # polling path (report()) as well as on join()
-            raise self._error
+        # a crashed engine counts as done for polling purposes; the failure
+        # itself surfaces on join() (and report(), which joins at the end)
         return self._done or (
             len(self._discovery_fps) == len(self._properties))

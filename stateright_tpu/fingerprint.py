@@ -22,12 +22,27 @@ Layout contract (shared with the device kernel):
 
 from __future__ import annotations
 
+import ctypes as _ctypes
 import dataclasses
 import enum
 import struct
-from typing import Any, Iterable, List
+from array import array as _array
+from typing import Any, Iterable, List, Optional
 
 M32 = 0xFFFFFFFF
+
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _native_lib():
+    """The C fingerprint core, or None (pure-Python fallback)."""
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        from . import _native
+        _NATIVE = _native.load()
+    return _NATIVE
 
 # Lane 1: murmur3_x86_32 constants. Lane 2: first constant pair from
 # murmur3_x86_128. Both lanes use the standard murmur3 rotation schedule.
@@ -53,9 +68,54 @@ def _fmix32(h: int) -> int:
 def fp64_words(words: Iterable[int]) -> int:
     """Hash a sequence of uint32 words into a non-zero 64-bit fingerprint.
 
-    This is the host reference implementation; the device implementation in
-    ``ops/hash_kernel.py`` must match it bit-for-bit (differential-tested).
+    Dispatches to the native C core (`_native/fphash.c`) when available;
+    the pure-Python body below is the reference implementation and
+    fallback. The device implementation in ``ops/hash_kernel.py`` must
+    match both bit-for-bit (differential-tested).
     """
+    lib = _native_lib()
+    if lib is not None:
+        if not isinstance(words, (list, tuple)):
+            # materialize: the masked retry below must see every word
+            words = list(words)
+        try:
+            buf = _array("I", words)
+        except (OverflowError, TypeError):
+            buf = _array("I", [w & M32 for w in words])
+        n = len(buf)
+        if n == 0:
+            return lib.fp64_words(None, 0)
+        addr, _ = buf.buffer_info()
+        return lib.fp64_words(
+            _ctypes.cast(addr, _ctypes.POINTER(_ctypes.c_uint32)), n)
+    return _fp64_words_py(words)
+
+
+def fp64_rows(rows) -> "list":
+    """Fingerprint a batch of packed states on the host.
+
+    ``rows`` is a uint32[N, W] numpy array (C-contiguous); returns a list of
+    N non-zero 64-bit fingerprints, equal row-for-row to ``fp64_words``.
+    This is the bulk path the host mirror uses when pulling packed states
+    back from the device.
+    """
+    import numpy as np
+    rows = np.ascontiguousarray(rows, dtype=np.uint32)
+    count, width = rows.shape
+    lib = _native_lib()
+    if lib is None:
+        return [_fp64_words_py(row.tolist()) for row in rows]
+    out = np.empty((count,), dtype=np.uint64)
+    if count:
+        lib.fp64_rows(
+            rows.ctypes.data_as(_ctypes.POINTER(_ctypes.c_uint32)),
+            count, width,
+            out.ctypes.data_as(_ctypes.POINTER(_ctypes.c_uint64)))
+    return out.tolist()
+
+
+def _fp64_words_py(words: Iterable[int]) -> int:
+    """Pure-Python reference implementation of :func:`fp64_words`."""
     h1 = SEED1
     h2 = SEED2
     n = 0
@@ -112,6 +172,15 @@ def _emit_packed_bytes(data: bytes, out: List[int]) -> None:
 
 
 _CLASS_FP_CACHE: dict = {}
+_FIELD_NAMES_CACHE: dict = {}
+
+
+def _field_names(cls: type) -> tuple:
+    names = _FIELD_NAMES_CACHE.get(cls)
+    if names is None:
+        names = tuple(f.name for f in dataclasses.fields(cls))
+        _FIELD_NAMES_CACHE[cls] = names
+    return names
 
 
 def _class_fp(cls: type) -> int:
@@ -185,8 +254,8 @@ def stable_words(value: Any, out: List[int]) -> None:
         cfp = _class_fp(type(value))
         out.append(cfp & M32)
         out.append((cfp >> 32) & M32)
-        for f in dataclasses.fields(value):
-            stable_words(getattr(value, f.name), out)
+        for name in _field_names(type(value)):
+            stable_words(getattr(value, name), out)
     else:
         raise TypeError(
             f"cannot stably fingerprint value of type {type(value)!r}; "

@@ -21,6 +21,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .core import ConsistencyTester, SequentialSpec
 
+# history key -> serialization (or None for "not linearizable"); cleared
+# wholesale if it ever reaches _CACHE_MAX entries (histories are tiny, so
+# in practice a checking run never evicts)
+_SERIALIZATION_CACHE: dict = {}
+_CACHE_MAX = 1 << 20
+_MISS = object()
+
 
 class LinearizabilityTester(ConsistencyTester):
     def __init__(self, init_ref_obj: SequentialSpec):
@@ -100,12 +107,32 @@ class LinearizabilityTester(ConsistencyTester):
 
     # --- the search (`linearizability.rs:177-240`) ------------------------
     def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        """Memoized by the canonical history key: the checker re-evaluates
+        the ``linearizable`` property once per explored *state*, but
+        histories recur massively across states (SURVEY hard-part #4), so
+        the exponential interleaving search runs once per distinct history.
+        """
         if not self._valid:
             return None
+        # caching keys on spec value-equality; identity-equality specs
+        # would never hit (every state holds fresh clones) and only leak
+        cacheable = type(self._init).__eq__ is not object.__eq__
+        if cacheable:
+            key = self._key()
+            hit = _SERIALIZATION_CACHE.get(key, _MISS)
+            if hit is not _MISS:
+                return None if hit is None else list(hit)
         remaining = {
             t: [(i, entry) for i, entry in enumerate(h)]
             for t, h in self._history.items()}
-        return _serialize([], self._init, remaining, dict(self._in_flight))
+        result = _serialize([], self._init, remaining,
+                            dict(self._in_flight))
+        if cacheable:
+            if len(_SERIALIZATION_CACHE) >= _CACHE_MAX:
+                _SERIALIZATION_CACHE.clear()
+            _SERIALIZATION_CACHE[key] = None if result is None \
+                else tuple(result)
+        return result
 
 
 def _violates_realtime(last_completed: dict, remaining: dict) -> bool:

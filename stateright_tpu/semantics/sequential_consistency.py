@@ -10,6 +10,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .core import ConsistencyTester, SequentialSpec
 
+# history key -> serialization (or None); see the linearizability tester
+_SERIALIZATION_CACHE: dict = {}
+_CACHE_MAX = 1 << 20
+_MISS = object()
+
 
 class SequentialConsistencyTester(ConsistencyTester):
     def __init__(self, init_ref_obj: SequentialSpec):
@@ -78,10 +83,26 @@ class SequentialConsistencyTester(ConsistencyTester):
 
     # --- the search ------------------------------------------------------
     def serialized_history(self) -> Optional[List[Tuple[Any, Any]]]:
+        """Memoized by the canonical history key (histories recur across
+        explored states; see the linearizability tester)."""
         if not self._valid:
             return None
+        # see the linearizability tester: only cache for value-equal specs
+        cacheable = type(self._init).__eq__ is not object.__eq__
+        if cacheable:
+            key = self._key()
+            hit = _SERIALIZATION_CACHE.get(key, _MISS)
+            if hit is not _MISS:
+                return None if hit is None else list(hit)
         remaining = {t: list(h) for t, h in self._history.items()}
-        return _serialize([], self._init, remaining, dict(self._in_flight))
+        result = _serialize([], self._init, remaining,
+                            dict(self._in_flight))
+        if cacheable:
+            if len(_SERIALIZATION_CACHE) >= _CACHE_MAX:
+                _SERIALIZATION_CACHE.clear()
+            _SERIALIZATION_CACHE[key] = None if result is None \
+                else tuple(result)
+        return result
 
 
 def _serialize(valid_history, ref_obj, remaining, in_flight):

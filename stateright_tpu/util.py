@@ -1,0 +1,188 @@
+"""User-model vocabulary types.
+
+Ports of the reference's exported utility types
+(`/root/reference/src/util/densenatmap.rs`, `src/util/vector_clock.rs`).
+The order-insensitive set/map hashing that `src/util.rs` provides via
+``HashableHashSet``/``HashableHashMap`` lives in
+:mod:`stateright_tpu.fingerprint` (sorted-element-fingerprint encoding);
+these are the remaining two exported value types.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, List, Optional, Tuple
+
+
+class DenseNatMap:
+    """A map whose keys are exactly the dense range ``0..len`` of int-like
+    ids (`src/util/densenatmap.rs:75-132`).
+
+    A type-safe ``Vec`` replacement in the reference; in Python the value
+    proposition is the gap-checking and the symmetry-rewrite integration.
+    Inserting beyond the end raises; building from (key, value) pairs
+    requires the keys to form a dense range.
+    """
+
+    __slots__ = ("_values",)
+
+    def __init__(self, values: Iterable[Any] = ()):
+        self._values: List[Any] = list(values)
+
+    @staticmethod
+    def from_pairs(pairs: Iterable[Tuple[Any, Any]]) -> "DenseNatMap":
+        """Build from (key, value) pairs in any order; the keys must be
+        exactly ``0..len`` (`densenatmap.rs:149-169`)."""
+        items = sorted(((int(k), v) for k, v in pairs), key=lambda kv: kv[0])
+        for expected, (index, _value) in enumerate(items):
+            if index != expected:
+                raise ValueError(
+                    f"Invalid key at index. index={index}, "
+                    f"expected_index={expected}")
+        return DenseNatMap(v for _, v in items)
+
+    def get(self, key) -> Optional[Any]:
+        index = int(key)
+        if 0 <= index < len(self._values):
+            return self._values[index]
+        return None
+
+    def insert(self, key, value) -> Optional[Any]:
+        """Insert/overwrite; returns the previous value if overwriting.
+        Raises when the key would leave a gap (`densenatmap.rs:95-110`)."""
+        index = int(key)
+        if index < 0 or index > len(self._values):
+            raise IndexError(
+                f"Out of bounds. index={index}, len={len(self._values)}")
+        if index == len(self._values):
+            self._values.append(value)
+            return None
+        previous = self._values[index]
+        self._values[index] = value
+        return previous
+
+    def __getitem__(self, key):
+        index = int(key)
+        if index < 0:
+            raise IndexError(f"Out of bounds. index={index}")
+        return self._values[index]
+
+    def __setitem__(self, key, value):
+        self.insert(key, value)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __iter__(self) -> Iterator[Tuple[int, Any]]:
+        from .actor.core import Id
+        return ((Id(i), v) for i, v in enumerate(self._values))
+
+    def values(self) -> Iterator[Any]:
+        return iter(self._values)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DenseNatMap) \
+            and self._values == other._values
+
+    def __hash__(self):
+        return hash(tuple(self._values))
+
+    def __repr__(self):
+        return f"DenseNatMap({self._values!r})"
+
+    def __stable_words__(self, out) -> None:
+        from .fingerprint import stable_words
+        stable_words(("DenseNatMap", tuple(self._values)), out)
+
+    def rewrite(self, plan) -> "DenseNatMap":
+        """Symmetry rewrite: reindex keys under the plan while rewriting
+        values (`densenatmap.rs:209-223`)."""
+        from .checker.representative import rewrite_value
+        pairs = ((plan.rewrite(i), rewrite_value(v, plan))
+                 for i, v in enumerate(self._values))
+        return DenseNatMap.from_pairs(pairs)
+
+
+class VectorClock:
+    """A vector clock providing a partial causal order
+    (`src/util/vector_clock.rs:11-106`).
+
+    Equality, hashing, and ordering ignore trailing zeros, so clocks of
+    different lengths compare correctly.
+    """
+
+    __slots__ = ("_elems",)
+
+    def __init__(self, elems: Iterable[int] = ()):
+        self._elems: Tuple[int, ...] = tuple(int(e) for e in elems)
+
+    @staticmethod
+    def merge_max(c1: "VectorClock", c2: "VectorClock") -> "VectorClock":
+        """Component-wise maximum (`vector_clock.rs:20-31`)."""
+        a, b = c1._elems, c2._elems
+        n = max(len(a), len(b))
+        return VectorClock(
+            max(a[i] if i < len(a) else 0, b[i] if i < len(b) else 0)
+            for i in range(n))
+
+    def incremented(self, index: int) -> "VectorClock":
+        """A copy with component ``index`` incremented, growing as needed
+        (`vector_clock.rs:33-40`)."""
+        elems = list(self._elems)
+        if index >= len(elems):
+            elems.extend(0 for _ in range(index + 1 - len(elems)))
+        elems[index] += 1
+        return VectorClock(elems)
+
+    def _canonical(self) -> Tuple[int, ...]:
+        """Elements with trailing zeros stripped — the identity the
+        reference hashes (`vector_clock.rs:54-61`)."""
+        cutoff = len(self._elems)
+        while cutoff and self._elems[cutoff - 1] == 0:
+            cutoff -= 1
+        return self._elems[:cutoff]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, VectorClock) \
+            and self._canonical() == other._canonical()
+
+    def __hash__(self):
+        return hash(self._canonical())
+
+    def __stable_words__(self, out) -> None:
+        from .fingerprint import stable_words
+        stable_words(("VectorClock", self._canonical()), out)
+
+    def _compare(self, other: "VectorClock") -> Optional[int]:
+        """-1/0/+1 for ordered clocks; None when incomparable
+        (`vector_clock.rs:86-106`)."""
+        a, b = self._elems, other._elems
+        expected = 0
+        for i in range(max(len(a), len(b))):
+            x = a[i] if i < len(a) else 0
+            y = b[i] if i < len(b) else 0
+            ordering = (x > y) - (x < y)
+            if expected == 0:
+                expected = ordering
+            elif ordering not in (0, expected):
+                return None
+        return expected
+
+    def __lt__(self, other) -> bool:
+        return self._compare(other) == -1
+
+    def __le__(self, other) -> bool:
+        cmp = self._compare(other)
+        return cmp is not None and cmp <= 0
+
+    def __gt__(self, other) -> bool:
+        return self._compare(other) == 1
+
+    def __ge__(self, other) -> bool:
+        cmp = self._compare(other)
+        return cmp is not None and cmp >= 0
+
+    def __repr__(self):
+        return f"VectorClock({list(self._elems)!r})"
+
+    def __str__(self):
+        return "<" + "".join(f"{c}, " for c in self._elems) + "...>"

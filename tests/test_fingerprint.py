@@ -105,3 +105,24 @@ def test_enum_fingerprints():
     assert stable_fingerprint(Color.RED) == stable_fingerprint(Color.RED)
     assert stable_fingerprint(Color.RED) != stable_fingerprint(Color.BLUE)
     assert stable_fingerprint(Color.RED) != stable_fingerprint(Shade.RED)
+
+
+def test_native_hash_matches_python_reference():
+    """The C core (when built) must agree with the pure-Python reference."""
+    import random
+
+    import numpy as np
+
+    from stateright_tpu.fingerprint import (_fp64_words_py, fp64_rows,
+                                            fp64_words)
+
+    rng = random.Random(7)
+    for _ in range(100):
+        words = [rng.randrange(0, 2 ** 32)
+                 for _ in range(rng.randrange(0, 40))]
+        assert fp64_words(words) == _fp64_words_py(words)
+    assert fp64_words([]) == _fp64_words_py([])
+    # iterator inputs must not lose words on the masked-retry path
+    assert fp64_words(iter([1, 2 ** 32])) == _fp64_words_py([1, 0])
+    rows = np.array([[1, 2, 3], [4, 5, 6], [0, 0, 0]], dtype=np.uint32)
+    assert fp64_rows(rows) == [_fp64_words_py(r.tolist()) for r in rows]

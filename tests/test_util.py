@@ -1,0 +1,100 @@
+"""DenseNatMap and VectorClock, mirroring the reference's coverage
+(`/root/reference/src/util/densenatmap.rs:238-329`,
+`src/util/vector_clock.rs:108-273`)."""
+
+import pytest
+
+from stateright_tpu import DenseNatMap, VectorClock, stable_fingerprint
+from stateright_tpu.actor.core import Id
+from stateright_tpu.checker.representative import RewritePlan
+
+
+class TestDenseNatMap:
+    def test_insert_in_order(self):
+        m = DenseNatMap()
+        assert m.insert(Id(0), "first") is None
+        assert m.insert(Id(1), "second") is None
+        assert len(m) == 2
+        assert m[Id(0)] == "first" and m[Id(1)] == "second"
+
+    def test_insert_overwrites(self):
+        m = DenseNatMap(["a", "b"])
+        assert m.insert(Id(1), "B") == "b"
+        assert m[1] == "B"
+
+    def test_insert_out_of_order_raises(self):
+        m = DenseNatMap()
+        with pytest.raises(IndexError):
+            m.insert(Id(1), "second")
+
+    def test_from_pairs_any_order(self):
+        m = DenseNatMap.from_pairs([(Id(1), "second"), (Id(0), "first")])
+        assert list(m.values()) == ["first", "second"]
+
+    def test_from_pairs_gap_raises(self):
+        with pytest.raises(ValueError):
+            DenseNatMap.from_pairs([(Id(0), "a"), (Id(2), "c")])
+
+    def test_get(self):
+        m = DenseNatMap(["a"])
+        assert m.get(Id(0)) == "a"
+        assert m.get(Id(1)) is None
+
+    def test_iter_yields_ids(self):
+        m = DenseNatMap(["a", "b"])
+        assert list(m) == [(Id(0), "a"), (Id(1), "b")]
+
+    def test_value_semantics(self):
+        assert DenseNatMap(["a"]) == DenseNatMap(["a"])
+        assert hash(DenseNatMap(["a"])) == hash(DenseNatMap(["a"]))
+        assert DenseNatMap(["a"]) != DenseNatMap(["b"])
+        assert stable_fingerprint(DenseNatMap(["a"])) \
+            == stable_fingerprint(DenseNatMap(["a"]))
+
+    def test_rewrite_reindexes_keys_and_values(self):
+        # plan sorting ['B', 'A'] swaps ids 0 and 1; values that are Ids
+        # are themselves rewritten (densenatmap.rs:209-223)
+        m = DenseNatMap(["B", "A"])
+        plan = RewritePlan.from_values_to_sort(["B", "A"])
+        assert m.rewrite(plan) == DenseNatMap(["A", "B"])
+        # keys AND values both permute, so a swap map is a fixed point
+        ids = DenseNatMap([Id(1), Id(0)])
+        assert ids.rewrite(plan) == DenseNatMap([Id(1), Id(0)])
+        ids2 = DenseNatMap([Id(0), Id(0)])
+        assert ids2.rewrite(plan) == DenseNatMap([Id(1), Id(1)])
+
+
+class TestVectorClock:
+    def test_equality_ignores_trailing_zeros(self):
+        assert VectorClock() == VectorClock([0, 0])
+        assert VectorClock([1, 2]) == VectorClock([1, 2, 0])
+        assert VectorClock([1, 2]) != VectorClock([1, 2, 3])
+
+    def test_hash_ignores_trailing_zeros(self):
+        assert hash(VectorClock([1, 0])) == hash(VectorClock([1]))
+        assert stable_fingerprint(VectorClock([1, 0])) \
+            == stable_fingerprint(VectorClock([1]))
+
+    def test_incremented_grows(self):
+        c = VectorClock().incremented(2)
+        assert c == VectorClock([0, 0, 1])
+        assert c.incremented(0) == VectorClock([1, 0, 1])
+
+    def test_merge_max(self):
+        a = VectorClock([1, 5])
+        b = VectorClock([2, 3, 4])
+        assert VectorClock.merge_max(a, b) == VectorClock([2, 5, 4])
+
+    def test_partial_order(self):
+        assert VectorClock([1, 2]) < VectorClock([1, 3])
+        assert VectorClock([1, 3]) > VectorClock([1, 2])
+        assert VectorClock([1, 2]) <= VectorClock([1, 2, 0])
+        assert VectorClock([1, 2]) >= VectorClock([1, 2])
+        # incomparable: neither <= nor >=
+        a, b = VectorClock([1, 2, 4]), VectorClock([1, 3, 0])
+        assert not a <= b and not a >= b and not a < b and not a > b
+
+    def test_display(self):
+        assert str(VectorClock([1, 2, 3, 4])) == "<1, 2, 3, 4, ...>"
+        assert str(VectorClock()) == "<...>"
+        assert str(VectorClock([0])) == "<0, ...>"
