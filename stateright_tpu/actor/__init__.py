@@ -7,16 +7,17 @@ implementations are exhaustively model-checked through :class:`ActorModel`
 sockets via :func:`spawn` — the framework's signature dual use.
 """
 
-from .core import (Actor, CancelTimer, Envelope, Id, Out, Send, SetTimer,
-                   is_no_op, majority, model_peers, model_timeout)
+from .core import (Actor, CancelTimer, Envelope, Id, Out, ScriptedActor,
+                   Send, SetTimer, is_no_op, majority, model_peers,
+                   model_timeout)
 from .model import (ActorModel, ActorModelState, Deliver, Drop, Timeout)
 from .network import (Network, Ordered, UnorderedDuplicating,
                       UnorderedNonDuplicating)
 
 __all__ = [
     "Actor", "ActorModel", "ActorModelState", "CancelTimer", "Deliver",
-    "Drop", "Envelope", "Id", "Network", "Ordered", "Out", "Send",
-    "SetTimer", "Timeout", "UnorderedDuplicating",
+    "Drop", "Envelope", "Id", "Network", "Ordered", "Out", "ScriptedActor",
+    "Send", "SetTimer", "Timeout", "UnorderedDuplicating",
     "UnorderedNonDuplicating", "is_no_op", "majority", "model_peers",
     "model_timeout",
 ]

@@ -113,6 +113,30 @@ def is_no_op(next_state: Optional[Any], out: Out) -> bool:
     return next_state is None and not out
 
 
+class ScriptedActor(Actor):
+    """Sends a scripted series of (dst, msg) pairs, advancing one step per
+    delivery received — the ``Vec<(Id, Msg)> as Actor`` testing helper
+    (`src/actor.rs:415-437`). State is the index of the next message."""
+
+    def __init__(self, script: List[Tuple[Id, Any]]):
+        self.script = list(script)
+
+    def on_start(self, id: Id, o: Out) -> int:
+        if self.script:
+            dst, msg = self.script[0]
+            o.send(dst, msg)
+            return 1
+        return 0
+
+    def on_msg(self, id: Id, state: int, src: Id, msg: Any,
+               o: Out) -> Optional[int]:
+        if state < len(self.script):
+            dst, nxt = self.script[state]
+            o.send(dst, nxt)
+            return state + 1
+        return None
+
+
 # --- helpers ----------------------------------------------------------------
 
 def majority(participant_count: int) -> int:

@@ -25,6 +25,19 @@ from typing import Any, Iterable, Iterator, Tuple
 from .core import Envelope, Id
 
 
+def _env_order(env: Envelope) -> int:
+    """Deterministic envelope iteration order.
+
+    Python set iteration depends on the process hash seed (messages contain
+    strings), which would make action order — and with it early-exit state
+    counts and witness choice — vary run to run. Iterating unordered
+    networks in stable-fingerprint order keeps every engine's exploration
+    deterministic, which path replay and the pinned oracle counts rely on.
+    """
+    from ..fingerprint import stable_fingerprint
+    return stable_fingerprint(env)
+
+
 class Network:
     """Base class + factories (`network.rs:79-140`)."""
 
@@ -91,16 +104,22 @@ class Network:
 
 
 class UnorderedDuplicating(Network):
-    __slots__ = ("_set",)
+    __slots__ = ("_set", "_sorted")
 
     def __init__(self, envelopes: frozenset):
         self._set = envelopes
+        self._sorted = None  # lazy: sorted-by-fingerprint iteration order
+
+    def _iter_sorted(self):
+        if self._sorted is None:
+            self._sorted = sorted(self._set, key=_env_order)
+        return iter(self._sorted)
 
     def iter_all(self):
-        return iter(self._set)
+        return self._iter_sorted()
 
     def iter_deliverable(self):
-        return iter(self._set)
+        return self._iter_sorted()
 
     def __len__(self):
         return len(self._set)
@@ -132,20 +151,27 @@ class UnorderedDuplicating(Network):
 
 
 class UnorderedNonDuplicating(Network):
-    __slots__ = ("_counts",)
+    __slots__ = ("_counts", "_sorted")
 
     def __init__(self, counts: frozenset):
         # frozenset of (envelope, count>0) pairs — canonical since counts
         # are unique per envelope
         self._counts = counts
+        self._sorted = None  # lazy: sorted-by-fingerprint iteration order
+
+    def _iter_sorted(self):
+        if self._sorted is None:
+            self._sorted = sorted(self._counts,
+                                  key=lambda ec: _env_order(ec[0]))
+        return iter(self._sorted)
 
     def iter_all(self):
-        for env, count in self._counts:
+        for env, count in self._iter_sorted():
             for _ in range(count):
                 yield env
 
     def iter_deliverable(self):
-        for env, _count in self._counts:
+        for env, _count in self._iter_sorted():
             yield env
 
     def __len__(self):

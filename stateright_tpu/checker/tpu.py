@@ -218,7 +218,11 @@ class TpuChecker(HostChecker):
         full_ebits = np.uint32(sum(1 << i
                                    for i in eventually_indices(properties)))
         generated = self._generated
-        discoveries = self._discovery_fps
+        # discoveries are buffered locally and published only after the
+        # mirror is finalized: publishing early flips is_done() (all
+        # properties discovered) while reconstruction data is still
+        # device-resident, racing report()/assert_* with an empty mirror
+        discoveries: Dict[str, int] = {}
         target = self._target_state_count
         opts = self._tpu_options
         fmax = int(opts.get("fmax", min(self._max_segment, 1 << 13)))
@@ -290,6 +294,7 @@ class TpuChecker(HostChecker):
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax)
 
         self._finalize_mirror(carry)
+        self._discovery_fps.update(discoveries)
 
     # ------------------------------------------------------------------
     def _grow_device(self, carry, qcap: int, insert_fn):

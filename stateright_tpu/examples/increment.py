@@ -1,0 +1,142 @@
+"""Shared-counter race: N threads read then write-increment without a lock.
+
+Port of `/root/reference/examples/increment.rs`: each thread runs
+``1: local = SHARED; 2: SHARED = local + 1; 3:`` with the two instructions
+atomic but interleavable. The intended invariant "SHARED == number of
+finished threads" (property ``fin``) is deliberately falsifiable. The doc
+comment at `increment.rs:36-105` enumerates the full 2-thread state space:
+13 unique states, 8 under symmetry reduction — both pinned in tests.
+
+This is also a packed model, so the same workload runs under ``spawn_tpu``.
+
+Run: ``python -m stateright_tpu.examples.increment check [THREAD_COUNT]``
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+import numpy as np
+
+from ..checker.representative import RewritePlan
+from ..core import Property
+from ..models.packed import PackedModel
+
+# state: (i, ((t, pc), ...)) — shared counter, per-thread (local, counter)
+State = Tuple[int, Tuple[Tuple[int, int], ...]]
+
+
+class Increment(PackedModel):
+    """N racing increment threads (`increment.rs:147-204`)."""
+
+    def __init__(self, n: int):
+        assert 1 <= n <= 16
+        self.n = n
+        self.packed_width = 1 + n
+        self.max_actions = n
+
+    # --- host side -------------------------------------------------------
+    def init_states(self) -> List[State]:
+        return [(0, ((0, 1),) * self.n)]
+
+    def actions(self, state: State, actions: List) -> None:
+        _i, s = state
+        for thread_id in range(self.n):
+            pc = s[thread_id][1]
+            if pc == 1:
+                actions.append(("Read", thread_id))
+            elif pc == 2:
+                actions.append(("Write", thread_id))
+
+    def next_state(self, state: State, action) -> State:
+        i, s = state
+        kind, tid = action
+        if kind == "Read":
+            s = s[:tid] + ((i, 2),) + s[tid + 1:]
+            return (i, s)
+        t = s[tid][0]
+        s = s[:tid] + ((t, 3),) + s[tid + 1:]
+        return ((t + 1) & 0xFF, s)
+
+    def properties(self) -> List[Property]:
+        return [Property.always(
+            "fin",
+            lambda _, state: sum(1 for t, pc in state[1] if pc == 3)
+            == state[0])]
+
+    def representative(self, state: State) -> State:
+        """Sort the (identical) threads' states (`increment.rs:143-153`)."""
+        i, s = state
+        plan = RewritePlan.from_values_to_sort(s)
+        return (i, tuple(plan.reindex(s)))
+
+    def format_action(self, action) -> str:
+        return f"{action[0]}({action[1]})"
+
+    # --- packed side: [i, thread_0, ..., thread_n-1], thread = t<<4 | pc --
+    def encode(self, state: State) -> np.ndarray:
+        i, s = state
+        return np.array([i] + [(t << 4) | pc for t, pc in s],
+                        dtype=np.uint32)
+
+    def decode(self, words) -> State:
+        i = int(words[0])
+        s = tuple((int(w) >> 4, int(w) & 0xF) for w in words[1:self.n + 1])
+        return (i, s)
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+        i = words[0]
+        succs, valids = [], []
+        for tid in range(self.n):
+            w = words[1 + tid]
+            t, pc = w >> 4, w & 0xF
+            is_read = pc == 1
+            # Read: (t, pc) <- (i, 2); Write: pc <- 3, i <- t + 1
+            new_thread = jnp.where(is_read, (i << 4) | 2, (t << 4) | 3)
+            new_i = jnp.where(is_read, i, (t + 1) & 0xFF)
+            row = words.at[0].set(new_i).at[1 + tid].set(
+                new_thread.astype(jnp.uint32))
+            succs.append(row)
+            valids.append((pc == 1) | (pc == 2))
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        i = words[0]
+        fin_count = jnp.uint32(0)
+        for tid in range(self.n):
+            fin_count = fin_count + ((words[1 + tid] & 0xF) == 3)
+        return jnp.stack([fin_count == i])
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args[0] if args else None
+    thread_count = int(args[1]) if len(args) > 1 else 3
+    if cmd == "check":
+        print(f"Model checking increment with {thread_count} threads.")
+        Increment(thread_count).checker().spawn_dfs().report(sys.stdout)
+    elif cmd == "check-sym":
+        print(f"Model checking increment with {thread_count} threads "
+              "using symmetry reduction.")
+        model = Increment(thread_count)
+        (model.checker().symmetry_fn(model.representative)
+         .spawn_dfs().report(sys.stdout))
+    elif cmd == "check-tpu":
+        print(f"Model checking increment with {thread_count} threads "
+              "on the TPU engine.")
+        Increment(thread_count).checker().spawn_tpu().report(sys.stdout)
+    else:
+        print("USAGE:")
+        print("  python -m stateright_tpu.examples.increment "
+              "check [THREAD_COUNT]")
+        print("  python -m stateright_tpu.examples.increment "
+              "check-sym [THREAD_COUNT]")
+        print("  python -m stateright_tpu.examples.increment "
+              "check-tpu [THREAD_COUNT]")
+
+
+if __name__ == "__main__":
+    main()

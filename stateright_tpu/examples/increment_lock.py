@@ -1,0 +1,165 @@
+"""Shared counter with a lock: the fixed version of the increment race.
+
+Port of `/root/reference/examples/increment_lock.rs`: each thread acquires a
+global lock, reads, write-increments, and releases. Properties ``fin``
+(counter equals finished threads) and ``mutex`` (at most one thread in the
+critical section) both hold. A BASELINE.md bench config.
+
+Also a packed model, so the workload runs under ``spawn_tpu``.
+
+Run: ``python -m stateright_tpu.examples.increment_lock check [THREAD_COUNT]``
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+import numpy as np
+
+from ..checker.representative import RewritePlan
+from ..core import Property
+from ..models.packed import PackedModel
+
+# state: (i, lock, ((t, pc), ...))
+State = Tuple[int, bool, Tuple[Tuple[int, int], ...]]
+
+
+class IncrementLock(PackedModel):
+    """N lock-protected increment threads (`increment_lock.rs:47-107`)."""
+
+    def __init__(self, n: int):
+        assert 1 <= n <= 16
+        self.n = n
+        self.packed_width = 2 + n
+        self.max_actions = n
+
+    # --- host side -------------------------------------------------------
+    def init_states(self) -> List[State]:
+        return [(0, False, ((0, 0),) * self.n)]
+
+    def actions(self, state: State, actions: List) -> None:
+        _i, lock, s = state
+        for thread_id in range(self.n):
+            pc = s[thread_id][1]
+            if pc == 0 and not lock:
+                actions.append(("Lock", thread_id))
+            elif pc == 1:
+                actions.append(("Read", thread_id))
+            elif pc == 2:
+                actions.append(("Write", thread_id))
+            elif pc == 3 and lock:
+                actions.append(("Release", thread_id))
+
+    def next_state(self, state: State, action) -> State:
+        i, lock, s = state
+        kind, tid = action
+        t, pc = s[tid]
+        if kind == "Lock":
+            return (i, True, s[:tid] + ((t, 1),) + s[tid + 1:])
+        if kind == "Read":
+            return (i, lock, s[:tid] + ((i, 2),) + s[tid + 1:])
+        if kind == "Write":
+            return ((t + 1) & 0xFF, lock, s[:tid] + ((t, 3),) + s[tid + 1:])
+        assert kind == "Release"
+        return (i, False, s[:tid] + ((t, 4),) + s[tid + 1:])
+
+    def properties(self) -> List[Property]:
+        return [
+            Property.always(
+                "fin",
+                lambda _, state: sum(1 for t, pc in state[2] if pc >= 3)
+                == state[0]),
+            Property.always(
+                "mutex",
+                lambda _, state: sum(1 for t, pc in state[2]
+                                     if 1 <= pc < 4) <= 1),
+        ]
+
+    def representative(self, state: State) -> State:
+        i, lock, s = state
+        plan = RewritePlan.from_values_to_sort(s)
+        return (i, lock, tuple(plan.reindex(s)))
+
+    def format_action(self, action) -> str:
+        return f"{action[0]}({action[1]})"
+
+    # --- packed side: [i, lock, thread_0, ...], thread = t<<4 | pc --------
+    def encode(self, state: State) -> np.ndarray:
+        i, lock, s = state
+        return np.array([i, int(lock)] + [(t << 4) | pc for t, pc in s],
+                        dtype=np.uint32)
+
+    def decode(self, words) -> State:
+        i = int(words[0])
+        lock = bool(int(words[1]))
+        s = tuple((int(w) >> 4, int(w) & 0xF) for w in words[2:self.n + 2])
+        return (i, lock, s)
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+        i, lock = words[0], words[1]
+        succs, valids = [], []
+        for tid in range(self.n):
+            w = words[2 + tid]
+            t, pc = w >> 4, w & 0xF
+            can_lock = (pc == 0) & (lock == 0)
+            is_read = pc == 1
+            is_write = pc == 2
+            can_release = (pc == 3) & (lock == 1)
+            new_pc = jnp.where(can_lock, 1,
+                               jnp.where(is_read, 2,
+                                         jnp.where(is_write, 3, 4)))
+            new_t = jnp.where(is_read, i, t)
+            new_i = jnp.where(is_write, (t + 1) & 0xFF, i)
+            new_lock = jnp.where(can_lock, 1,
+                                 jnp.where(can_release, 0, lock))
+            row = (words.at[0].set(new_i.astype(jnp.uint32))
+                   .at[1].set(new_lock.astype(jnp.uint32))
+                   .at[2 + tid].set(((new_t << 4) | new_pc)
+                                    .astype(jnp.uint32)))
+            succs.append(row)
+            valids.append(can_lock | is_read | is_write | can_release)
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        i = words[0]
+        fin = jnp.uint32(0)
+        crit = jnp.uint32(0)
+        for tid in range(self.n):
+            pc = words[2 + tid] & 0xF
+            fin = fin + (pc >= 3)
+            crit = crit + ((pc >= 1) & (pc < 4))
+        return jnp.stack([fin == i, crit <= 1])
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args[0] if args else None
+    thread_count = int(args[1]) if len(args) > 1 else 3
+    if cmd == "check":
+        print(f"Model checking increment_lock with {thread_count} threads.")
+        IncrementLock(thread_count).checker().spawn_dfs().report(sys.stdout)
+    elif cmd == "check-sym":
+        print(f"Model checking increment_lock with {thread_count} threads "
+              "using symmetry reduction.")
+        model = IncrementLock(thread_count)
+        (model.checker().symmetry_fn(model.representative)
+         .spawn_dfs().report(sys.stdout))
+    elif cmd == "check-tpu":
+        print(f"Model checking increment_lock with {thread_count} threads "
+              "on the TPU engine.")
+        IncrementLock(thread_count).checker().spawn_tpu().report(sys.stdout)
+    else:
+        print("USAGE:")
+        print("  python -m stateright_tpu.examples.increment_lock "
+              "check [THREAD_COUNT]")
+        print("  python -m stateright_tpu.examples.increment_lock "
+              "check-sym [THREAD_COUNT]")
+        print("  python -m stateright_tpu.examples.increment_lock "
+              "check-tpu [THREAD_COUNT]")
+
+
+if __name__ == "__main__":
+    main()
