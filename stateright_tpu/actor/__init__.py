@@ -13,11 +13,14 @@ from .core import (Actor, CancelTimer, Envelope, Id, Out, ScriptedActor,
 from .model import (ActorModel, ActorModelState, Deliver, Drop, Timeout)
 from .network import (Network, Ordered, UnorderedDuplicating,
                       UnorderedNonDuplicating)
+from .packed import PackedActorModel
+from .runtime import SpawnHandle, spawn
 
 __all__ = [
     "Actor", "ActorModel", "ActorModelState", "CancelTimer", "Deliver",
-    "Drop", "Envelope", "Id", "Network", "Ordered", "Out", "ScriptedActor",
-    "Send", "SetTimer", "Timeout", "UnorderedDuplicating",
+    "Drop", "Envelope", "Id", "Network", "Ordered", "Out",
+    "PackedActorModel", "ScriptedActor", "Send", "SetTimer",
+    "SpawnHandle", "Timeout", "UnorderedDuplicating",
     "UnorderedNonDuplicating", "is_no_op", "majority", "model_peers",
-    "model_timeout",
+    "model_timeout", "spawn",
 ]

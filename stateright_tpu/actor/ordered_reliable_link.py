@@ -1,0 +1,143 @@
+"""Ordered reliable link (ORL): actor middleware adding per-(src, dst)
+ordering, resends-until-ack, and redelivery suppression.
+
+Port of `/root/reference/src/actor/ordered_reliable_link.rs:29-148` — the
+reference's "reliable transport" layered over the fire-and-forget UDP
+runtime. Wraps any :class:`~stateright_tpu.actor.core.Actor`; assumes no
+actor restarts. The wrapped actor's ``SetTimer``/``CancelTimer`` are
+unsupported (the wrapper owns the timer), mirroring the reference's
+``todo!()`` (`ordered_reliable_link.rs:130-148`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+from .core import (Actor, CancelTimer, Id, Out, Send, SetTimer, is_no_op,
+                   model_timeout)
+
+
+# --- wire messages (`ordered_reliable_link.rs:36-41`) -----------------------
+
+@dataclass(frozen=True)
+class Deliver:
+    seq: int
+    msg: Any
+
+
+@dataclass(frozen=True)
+class Ack:
+    seq: int
+
+
+# --- wrapper state (`ordered_reliable_link.rs:47-57`) -----------------------
+
+@dataclass(frozen=True)
+class StateWrapper:
+    # send side
+    next_send_seq: int
+    msgs_pending_ack: frozenset  # {(seq, (dst, msg))}
+    # receive (ack'ing) side
+    last_delivered_seqs: frozenset  # {(src, seq)}
+    wrapped_state: Any
+
+
+def _last_delivered(state: StateWrapper, src: Id) -> int:
+    for s, seq in state.last_delivered_seqs:
+        if s == src:
+            return seq
+    return 0
+
+
+class ActorWrapper(Actor):
+    """Wraps an actor with ordering + resend + dedup
+    (`ordered_reliable_link.rs:29-33`)."""
+
+    def __init__(self, wrapped_actor: Actor,
+                 resend_interval: Tuple[float, float] = (1.0, 2.0)):
+        self.wrapped_actor = wrapped_actor
+        self.resend_interval = resend_interval
+
+    @staticmethod
+    def with_default_timeout(wrapped_actor: Actor) -> "ActorWrapper":
+        return ActorWrapper(wrapped_actor)
+
+    # ------------------------------------------------------------------
+    def _process_output(self, state: StateWrapper, wrapped_out: Out,
+                        o: Out) -> StateWrapper:
+        """Wrap inner Sends as sequenced Delivers
+        (`ordered_reliable_link.rs:122-148`)."""
+        next_seq = state.next_send_seq
+        pending = set(state.msgs_pending_ack)
+        for command in wrapped_out:
+            if isinstance(command, (SetTimer, CancelTimer)):
+                raise NotImplementedError(
+                    "timers of ORL-wrapped actors are not supported at "
+                    "this time")
+            assert isinstance(command, Send)
+            o.send(command.dst, Deliver(next_seq, command.msg))
+            pending.add((next_seq, (command.dst, command.msg)))
+            next_seq += 1
+        return StateWrapper(
+            next_send_seq=next_seq,
+            msgs_pending_ack=frozenset(pending),
+            last_delivered_seqs=state.last_delivered_seqs,
+            wrapped_state=state.wrapped_state)
+
+    def on_start(self, id: Id, o: Out) -> StateWrapper:
+        o.set_timer(self.resend_interval)
+        wrapped_out = Out()
+        state = StateWrapper(
+            next_send_seq=1,
+            msgs_pending_ack=frozenset(),
+            last_delivered_seqs=frozenset(),
+            wrapped_state=self.wrapped_actor.on_start(id, wrapped_out))
+        return self._process_output(state, wrapped_out, o)
+
+    def on_msg(self, id: Id, state: StateWrapper, src: Id, msg: Any,
+               o: Out) -> Optional[StateWrapper]:
+        if isinstance(msg, Deliver):
+            # Always ack to stop resends; drop if already delivered
+            # (`ordered_reliable_link.rs:88-115`).
+            o.send(src, Ack(msg.seq))
+            if msg.seq <= _last_delivered(state, src):
+                return None
+            wrapped_out = Out()
+            next_wrapped = self.wrapped_actor.on_msg(
+                id, state.wrapped_state, src, msg.msg, wrapped_out)
+            if is_no_op(next_wrapped, wrapped_out):
+                return None
+            delivered = frozenset(
+                {(s, q) for s, q in state.last_delivered_seqs if s != src}
+                | {(src, msg.seq)})
+            new_state = StateWrapper(
+                next_send_seq=state.next_send_seq,
+                msgs_pending_ack=state.msgs_pending_ack,
+                last_delivered_seqs=delivered,
+                wrapped_state=state.wrapped_state if next_wrapped is None
+                else next_wrapped)
+            return self._process_output(new_state, wrapped_out, o)
+
+        if isinstance(msg, Ack):
+            remaining = frozenset(
+                (seq, dm) for seq, dm in state.msgs_pending_ack
+                if seq != msg.seq)
+            if remaining == state.msgs_pending_ack:
+                return None
+            return StateWrapper(
+                next_send_seq=state.next_send_seq,
+                msgs_pending_ack=remaining,
+                last_delivered_seqs=state.last_delivered_seqs,
+                wrapped_state=state.wrapped_state)
+        return None
+
+    def on_timeout(self, id: Id, state: StateWrapper,
+                   o: Out) -> Optional[StateWrapper]:
+        """Re-arm and resend everything unacked
+        (`ordered_reliable_link.rs:117-127`)."""
+        o.set_timer(self.resend_interval)
+        for seq, (dst, msg) in sorted(state.msgs_pending_ack,
+                                      key=lambda e: e[0]):
+            o.send(dst, Deliver(seq, msg))
+        return None

@@ -1,0 +1,308 @@
+"""Packed ``ActorModel`` encoding: actor systems on the TPU engine.
+
+The reference's whole value is that ``ActorModel`` is just another ``Model``
+(`/root/reference/src/actor/model.rs:187-494`); this module carries that
+bridge onto the device. A :class:`PackedActorModel` *is* an ``ActorModel``
+(the host side reuses the exact behavioral semantics) that additionally
+implements the :class:`~stateright_tpu.models.packed.PackedModel` protocol
+with a canonical struct-of-words state layout:
+
+    [ actor states | E network slots | timer bits | history words ]
+
+* **Actor states** are fixed-width per actor index (ragged widths allowed);
+  the subclass supplies ``encode_actor``/``decode_actor`` and a single JAX
+  ``packed_deliver`` kernel that dispatches on the destination internally
+  (under ``vmap`` every branch is computed and masked anyway, so explicit
+  masks beat ``lax.switch``).
+* **The network multiset** is the hard part (SURVEY hard-part #3): each
+  distinct in-flight envelope occupies one slot ``[hdr, count, msg...]``
+  with ``hdr = occupied<<16 | src<<8 | dst``; slots are kept sorted
+  lexicographically (empties last), which makes the encoding — and thus the
+  fingerprint — order-insensitive, the device analog of the reference's
+  sorted-element-hash ``HashableHashSet`` recipe (`src/util.rs:124-145`).
+  Currently implements the ``UnorderedNonDuplicating`` semantics (the
+  default for every register-protocol example and the paxos north star).
+* **History** (e.g. a linearizability tester) rides as packed words with
+  JAX record hooks mirroring ``record_msg_out``/``record_msg_in``
+  (`model.rs:157-184`, `:261-264`), so history distinctions stay part of
+  device state identity. Properties that need the *decoded* history (the
+  exponential linearizability search) are declared in
+  ``host_property_indices`` and evaluated host-side per level on newly
+  inserted states only — see ``checker/tpu.py``.
+
+Delivery nondeterminism is the action axis: action ``e`` delivers slot
+``e``; disabled slots, missing recipients, and no-op handler results
+(``next_state -> None``, `model.rs:259-260`) are mask bits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.packed import PackedModel
+from .core import Envelope, Id
+from .model import ActorModel, ActorModelState
+from .network import UnorderedNonDuplicating
+
+_OCC = 1 << 16  # slot-occupied flag in the hdr word
+_EMPTY_SORT_KEY = 0xFFFFFFFF  # empties sort last
+
+
+class PackedActorModel(ActorModel, PackedModel):
+    """An ``ActorModel`` with a packed device encoding.
+
+    Subclasses configure (before calling :meth:`finalize_layout`):
+      * ``actor_widths``: words per actor state, by actor index;
+      * ``msg_width``: words per message;
+      * ``net_capacity``: max distinct in-flight envelopes (E);
+      * ``history_width``: words of packed history (0 = no history);
+      * ``max_sends``: max Sends one handler emits;
+      * ``host_property_indices``: property indices evaluated host-side.
+
+    And implement: ``encode_actor``/``decode_actor``, ``encode_msg``/
+    ``decode_msg``, ``encode_history``/``decode_history`` (if any),
+    ``packed_deliver``, ``packed_record_out``/``packed_record_in`` (if
+    history), and ``packed_properties``.
+    """
+
+    msg_width: int
+    net_capacity: int
+    history_width: int = 0
+    max_sends: int = 1
+    host_property_indices: Tuple[int, ...] = ()
+
+    def finalize_layout(self) -> None:
+        """Compute offsets once the config fields are set."""
+        self.actor_widths: List[int] = list(self.actor_widths)
+        self._actor_off = np.cumsum([0] + self.actor_widths).tolist()
+        self._aw = self._actor_off[-1]
+        self._sw = 2 + self.msg_width  # hdr, count, msg words
+        self._net_off = self._aw
+        self._timer_off = self._net_off + self.net_capacity * self._sw
+        self._hist_off = self._timer_off + 1
+        self.packed_width = self._hist_off + self.history_width
+        self.max_actions = self.net_capacity
+
+    # --- subclass interface ----------------------------------------------
+    def encode_actor(self, index: int, state: Any) -> List[int]:
+        raise NotImplementedError
+
+    def decode_actor(self, index: int, words: List[int]) -> Any:
+        raise NotImplementedError
+
+    def encode_msg(self, msg: Any) -> List[int]:
+        raise NotImplementedError
+
+    def decode_msg(self, words: List[int]) -> Any:
+        raise NotImplementedError
+
+    def encode_history(self, history: Any) -> List[int]:
+        raise NotImplementedError
+
+    def decode_history(self, words: List[int]) -> Any:
+        raise NotImplementedError
+
+    def packed_deliver(self, actors, src, dst, msg):
+        """JAX delivery kernel.
+
+        Args:
+          actors: uint32[AW] concatenated actor states;
+          src, dst: traced uint32 scalars; msg: uint32[msg_width].
+        Returns:
+          (new_actors uint32[AW], changed bool,
+           sends: list of (dst scalar, msg uint32[msg_width], valid bool)
+           of length ``max_sends``, in emission order).
+        """
+        raise NotImplementedError
+
+    def packed_record_out(self, history, src, dst, msg):
+        """JAX analog of ``record_msg_out`` (applied per valid Send)."""
+        return history
+
+    def packed_record_in(self, history, src, dst, msg):
+        """JAX analog of ``record_msg_in`` (applied per delivery)."""
+        return history
+
+    def packed_boundary(self, words) -> Any:
+        """JAX analog of ``within_boundary``; True = keep."""
+        import jax.numpy as jnp
+        return jnp.bool_(True)
+
+    # --- canonical encode/decode (host side) ------------------------------
+    def _slot_sort_key(self, slot_words: Tuple[int, ...]) -> Tuple[int, ...]:
+        if slot_words[0] == 0:  # empty
+            return (_EMPTY_SORT_KEY,) + slot_words[1:]
+        return slot_words
+
+    def encode(self, state: ActorModelState) -> np.ndarray:
+        out = np.zeros((self.packed_width,), dtype=np.uint32)
+        for i, actor_state in enumerate(state.actor_states):
+            off = self._actor_off[i]
+            words = self.encode_actor(i, actor_state)
+            assert len(words) == self.actor_widths[i]
+            out[off:off + len(words)] = words
+        network = state.network
+        assert isinstance(network, UnorderedNonDuplicating), \
+            "PackedActorModel currently packs the unordered " \
+            "non-duplicating network semantics"
+        slots = []
+        for env, count in network._counts:
+            hdr = _OCC | (int(env.src) << 8) | int(env.dst)
+            slots.append(tuple([hdr, count] + self.encode_msg(env.msg)))
+        assert len(slots) <= self.net_capacity, \
+            f"network exceeds net_capacity={self.net_capacity}: " \
+            f"{len(slots)} distinct envelopes"
+        slots.sort(key=self._slot_sort_key)
+        for e, slot in enumerate(slots):
+            off = self._net_off + e * self._sw
+            out[off:off + self._sw] = slot
+        timer = 0
+        for i, set_ in enumerate(state.is_timer_set):
+            timer |= int(bool(set_)) << i
+        out[self._timer_off] = timer
+        if self.history_width:
+            hwords = self.encode_history(state.history)
+            assert len(hwords) == self.history_width
+            out[self._hist_off:] = hwords
+        return out
+
+    def decode(self, words) -> ActorModelState:
+        words = [int(w) for w in words]
+        actor_states = tuple(
+            self.decode_actor(i, words[self._actor_off[i]:
+                                       self._actor_off[i + 1]])
+            for i in range(len(self.actor_widths)))
+        counts = {}
+        for e in range(self.net_capacity):
+            off = self._net_off + e * self._sw
+            hdr = words[off]
+            if not hdr & _OCC:
+                continue
+            env = Envelope(src=Id((hdr >> 8) & 0xFF), dst=Id(hdr & 0xFF),
+                           msg=self.decode_msg(words[off + 2:off + self._sw]))
+            counts[env] = words[off + 1]
+        network = UnorderedNonDuplicating(frozenset(counts.items()))
+        timer = words[self._timer_off]
+        is_timer_set = tuple(bool((timer >> i) & 1)
+                             for i in range(len(self.actor_widths)))
+        history = self.decode_history(words[self._hist_off:]) \
+            if self.history_width else self.init_history
+        return ActorModelState(actor_states=actor_states, network=network,
+                               is_timer_set=is_timer_set, history=history)
+
+    # --- device step -------------------------------------------------------
+    def _sort_slots(self, slots):
+        """Canonical slot order: lexicographic over slot words with
+        empties last (stable multi-pass argsort)."""
+        import jax.numpy as jnp
+        idx = jnp.arange(self.net_capacity)
+        for w in reversed(range(self._sw)):
+            keys = slots[idx, w]
+            if w == 0:
+                keys = jnp.where(keys == 0, jnp.uint32(_EMPTY_SORT_KEY),
+                                 keys)
+            idx = idx[jnp.argsort(keys, stable=True)]
+        return slots[idx]
+
+    def _net_consume(self, slots, e):
+        """Deliver slot ``e``: decrement its count, freeing it at zero."""
+        import jax.numpy as jnp
+        count = slots[e, 1]
+        emptied = count <= 1
+        new_slot = jnp.where(emptied,
+                             jnp.zeros((self._sw,), jnp.uint32),
+                             slots[e].at[1].set(count - 1))
+        return slots.at[e].set(new_slot)
+
+    def _net_send(self, slots, src, dst, msg, valid):
+        """Send one envelope: bump the matching slot's count or claim the
+        first empty slot. Returns (slots, overflowed)."""
+        import jax.numpy as jnp
+        hdr = jnp.uint32(_OCC) | (src.astype(jnp.uint32) << 8) \
+            | dst.astype(jnp.uint32)
+        occupied = (slots[:, 0] & _OCC) != 0
+        match = occupied & (slots[:, 0] == hdr) \
+            & jnp.all(slots[:, 2:] == msg[None, :], axis=1)
+        has_match = match.any()
+        match_idx = jnp.argmax(match)
+        empty_idx = jnp.argmax(~occupied)
+        has_empty = (~occupied).any()
+        new_slot = jnp.concatenate(
+            [jnp.stack([hdr, jnp.uint32(1)]), msg.astype(jnp.uint32)])
+        target = jnp.where(has_match, match_idx, empty_idx)
+        updated = jnp.where(
+            has_match,
+            slots[target].at[1].set(slots[target, 1] + 1),
+            new_slot)
+        do_write = valid & (has_match | has_empty)
+        slots = slots.at[target].set(
+            jnp.where(do_write, updated, slots[target]))
+        overflowed = valid & ~has_match & ~has_empty
+        return slots, overflowed
+
+    def packed_step(self, words):
+        import jax
+        import jax.numpy as jnp
+        aw, sw, e_cap = self._aw, self._sw, self.net_capacity
+        hw = self.history_width
+        actors = words[:aw]
+        slots = words[self._net_off:self._timer_off].reshape(e_cap, sw)
+        hist = words[self._hist_off:] if hw else None
+        n_actors = len(self.actor_widths)
+
+        def one_action(e):
+            # the action axis is vmapped (not unrolled): one traced copy
+            # of the delivery body serves all E slots, which keeps the
+            # XLA graph — and compile time — independent of net_capacity
+            hdr = slots[e, 0]
+            occupied = (hdr & _OCC) != 0
+            src = (hdr >> 8) & 0xFF
+            dst = hdr & 0xFF
+            msg = slots[e, 2:]
+            new_actors, changed, sends = self.packed_deliver(
+                actors, src, dst, msg)
+            assert len(sends) == self.max_sends
+            any_send = jnp.bool_(False)
+            for _sdst, _smsg, svalid in sends:
+                any_send = any_send | svalid
+            # no-op pruning (model.rs:259-260) + recipient existence
+            valid = occupied & (dst < n_actors) & (changed | any_send)
+
+            new_slots = self._net_consume(slots, e)
+            new_hist = None
+            if hw:
+                new_hist = self.packed_record_in(hist, src, dst, msg)
+            overflow = jnp.bool_(False)
+            for sdst, smsg, svalid in sends:
+                smsg = smsg.astype(jnp.uint32)
+                if hw:
+                    recorded = self.packed_record_out(
+                        new_hist, dst, sdst, smsg)
+                    new_hist = jnp.where(svalid, recorded, new_hist)
+                new_slots, ovf = self._net_send(
+                    new_slots, dst.astype(jnp.uint32),
+                    sdst.astype(jnp.uint32), smsg, svalid)
+                overflow = overflow | ovf
+            new_slots = self._sort_slots(new_slots)
+
+            parts = [new_actors, new_slots.reshape(-1),
+                     words[self._timer_off:self._timer_off + 1]]
+            if hw:
+                parts.append(new_hist)
+            row = jnp.concatenate(parts).astype(jnp.uint32)
+            # an overflowing successor would silently drop a message:
+            # poison + invalidate the row so a mis-sized net_capacity
+            # shows up as a count divergence against the host oracle
+            # rather than silent corruption
+            row = jnp.where(overflow, jnp.full_like(row, 0xDEADBEEF), row)
+            valid = valid & ~overflow & self.packed_boundary(row)
+            return row, valid
+
+        return jax.vmap(one_action)(jnp.arange(e_cap))
+
+    # --- fingerprint ------------------------------------------------------
+    def fingerprint(self, state: ActorModelState) -> int:
+        from ..fingerprint import fp64_words
+        return fp64_words(self.encode(state).tolist())

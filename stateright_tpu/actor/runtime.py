@@ -1,0 +1,161 @@
+"""The actor runtime: execute the *same* ``Actor`` implementations over
+real UDP sockets.
+
+Port of `/root/reference/src/actor/spawn.rs:63-183` — the framework's
+signature "check it, then actually run it" feature. Deliberately primitive:
+one thread per actor, blocking UDP socket with a read timeout implementing
+the timer, fire-and-forget datagrams, pluggable serde functions (JSON in
+the examples). Reliability/ordering are layered on via
+:mod:`stateright_tpu.actor.ordered_reliable_link`, exactly as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket as socket_mod
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from .core import Actor, CancelTimer, Id, Out, Send, SetTimer, is_no_op
+
+log = logging.getLogger(__name__)
+
+_PRACTICALLY_NEVER = 3600.0 * 24 * 365 * 500  # seconds (spawn.rs:36-38)
+
+
+def _practically_never() -> float:
+    return time.monotonic() + _PRACTICALLY_NEVER
+
+
+class SpawnHandle:
+    """Join handle for a spawned actor cluster."""
+
+    def __init__(self, threads: List[threading.Thread],
+                 stop_event: threading.Event):
+        self._threads = threads
+        self._stop = stop_event
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block until the actors exit (they normally never do)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            t.join(remaining)
+
+    def stop(self) -> None:
+        """Signal all actor threads to exit (test/teardown helper; the
+        reference blocks forever, but a Python runtime needs clean
+        shutdown for in-process smoke tests)."""
+        self._stop.set()
+        self.join(timeout=2.0)
+
+
+def _actor_thread(id: Id, actor: Actor,
+                  serialize: Callable[[Any], bytes],
+                  deserialize: Callable[[bytes], Any],
+                  stop: threading.Event) -> None:
+    ip, port = id.socket_addr()
+    addr = (".".join(map(str, ip)), port)
+    sock = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    sock.bind(addr)
+    next_interrupt = _practically_never()
+
+    def on_command(command) -> None:
+        nonlocal next_interrupt
+        if isinstance(command, Send):
+            dst_ip, dst_port = command.dst.socket_addr()
+            try:
+                data = serialize(command.msg)
+            except Exception as e:  # mirror "ignore and log" semantics
+                log.warning("Unable to serialize. Ignoring. id=%s, msg=%r, "
+                            "err=%r", addr, command.msg, e)
+                return
+            log.info("Sending. id=%s, dst=%s:%s, msg=%r",
+                     addr, dst_ip, dst_port, command.msg)
+            sock.sendto(data, (".".join(map(str, dst_ip)), dst_port))
+        elif isinstance(command, SetTimer):
+            # random jitter within the range, as in spawn.rs:168-180
+            duration = random.uniform(command.min_seconds,
+                                      command.max_seconds)
+            next_interrupt = time.monotonic() + duration
+        elif isinstance(command, CancelTimer):
+            next_interrupt = _practically_never()
+        else:
+            raise TypeError(f"unknown command {command!r}")
+
+    out = Out()
+    state = actor.on_start(id, out)
+    log.info("Actor started. id=%s, state=%r, out=%r", addr, state, out)
+    for c in out:
+        on_command(c)
+
+    while not stop.is_set():
+        out = Out()
+        max_wait = next_interrupt - time.monotonic()
+        if max_wait > 0:
+            # wait for a message (bounded so stop() stays responsive)
+            sock.settimeout(min(max_wait, 0.2))
+            try:
+                data, src_addr = sock.recvfrom(65535)
+            except socket_mod.timeout:
+                continue
+            except OSError as e:
+                log.warning("Unable to read socket. Ignoring. id=%s, "
+                            "err=%r", addr, e)
+                continue
+            try:
+                msg = deserialize(data)
+            except Exception as e:
+                log.debug("Unable to parse message. Ignoring. id=%s, "
+                          "src=%s, buf=%r, err=%r", addr, src_addr, data, e)
+                continue
+            src_ip = tuple(int(b) for b in src_addr[0].split("."))
+            src = Id.from_socket_addr(src_ip, src_addr[1])
+            log.info("Received message. id=%s, src=%s, msg=%r",
+                     addr, src_addr, msg)
+            next_state = actor.on_msg(id, state, src, msg, out)
+        else:
+            next_interrupt = _practically_never()  # timer consumed
+            next_state = actor.on_timeout(id, state, out)
+
+        if not is_no_op(next_state, out):
+            log.debug("Acted. id=%s, state=%r, out=%r", addr, state, out)
+        if next_state is not None:
+            state = next_state
+        for c in out:
+            on_command(c)
+
+
+def spawn(serialize: Callable[[Any], bytes],
+          deserialize: Callable[[bytes], Any],
+          actors: Sequence[Tuple[Any, Actor]],
+          background: bool = False) -> SpawnHandle:
+    """Run actors over UDP, one thread each (`spawn.rs:63-140`).
+
+    ``actors`` pairs an :class:`Id` (or ``((ip, port))`` tuple) with an
+    actor. Blocks forever unless ``background=True``, in which case the
+    returned handle's ``stop()`` tears the cluster down.
+    """
+    stop = threading.Event()
+    threads: List[threading.Thread] = []
+    for raw_id, actor in actors:
+        if isinstance(raw_id, Id):
+            id = raw_id
+        else:
+            ip, port = raw_id
+            id = Id.from_socket_addr(tuple(ip), port)
+        t = threading.Thread(
+            target=_actor_thread,
+            args=(id, actor, serialize, deserialize, stop),
+            daemon=True,
+            name=f"actor-{int(id)}")
+        t.start()
+        threads.append(t)
+    handle = SpawnHandle(threads, stop)
+    if not background:
+        handle.join()
+    return handle

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..core import Expectation
 from .builder import CheckerBuilder
 from .host import HostChecker
 from .path import Path
@@ -152,6 +153,19 @@ class TpuChecker(HostChecker):
             "capacity must be a power of two"
         self._max_segment = int(opts.get("max_segment", 1 << 15))
         self._grow_at = float(opts.get("grow_at", 0.55))
+        # host-evaluated properties (e.g. the linearizability search):
+        # declared by the model, evaluated per level on newly inserted
+        # states, memoized by model.host_property_key(row)
+        self._host_props = [
+            (i, self._properties[i])
+            for i in getattr(model, "host_property_indices", ())]
+        for _i, prop in self._host_props:
+            if prop.expectation == Expectation.EVENTUALLY:
+                raise NotImplementedError(
+                    "host-evaluated eventually properties are not "
+                    "supported on the TPU engine; evaluate them with the "
+                    "host engines")
+        self._host_prop_cache: Dict[bytes, List[bool]] = {}
         # fingerprint -> parent fingerprint mirror (host side; the
         # checkpointable search record, also used for path reconstruction).
         self._generated: Dict[int, Optional[int]] = {}
@@ -177,6 +191,13 @@ class TpuChecker(HostChecker):
             # the per-state visitor is a host feature: it needs each
             # expanded state's fingerprint every level, so the per-level
             # orchestration is the natural fit
+            mode = "level"
+        if self._host_props:
+            if mode == "device":
+                raise ValueError(
+                    "host-evaluated properties require the per-level "
+                    "engine (new states are pulled back each level); drop "
+                    "tpu_options(mode='device')")
             mode = "level"
         if mode == "level":
             self._run_levels()
@@ -223,6 +244,7 @@ class TpuChecker(HostChecker):
         # properties discovered) while reconstruction data is still
         # device-resident, racing report()/assert_* with an empty mirror
         discoveries: Dict[str, int] = {}
+        host_prop_idx = {i for i, _p in self._host_props}
         target = self._target_state_count
         opts = self._tpu_options
         fmax = int(opts.get("fmax", min(self._max_segment, 1 << 13)))
@@ -274,6 +296,8 @@ class TpuChecker(HostChecker):
             self._unique_state_count = n_init + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
+                if i in host_prop_idx:
+                    continue  # host-evaluated: device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
             if bool(ovf):
@@ -394,6 +418,7 @@ class TpuChecker(HostChecker):
                                    for i in eventually_indices(properties)))
         generated = self._generated
         discoveries = self._discovery_fps
+        host_prop_idx = {i for i, _p in self._host_props}
         target = self._target_state_count
         visitor = self._visitor
 
@@ -414,8 +439,21 @@ class TpuChecker(HostChecker):
 
         take_fn = jax.jit(take_fn, static_argnums=(4,))
 
+        def take_rows_fn(rows, size):
+            return rows[:size]
+
+        take_rows_fn = jax.jit(take_rows_fn, static_argnums=(1,))
+
         # --- init -------------------------------------------------------
         init_rows = self._seed_inits()
+        if self._host_props:
+            # the reference evaluates properties on every popped unique
+            # state; our per-level insertion pass covers everything except
+            # the seeds, handled here on the host states directly
+            for s in model.init_states():
+                if model.within_boundary(s):
+                    self._eval_host_props_state(s, model.fingerprint(s),
+                                                discoveries)
 
         key_hi, key_lo = make_table(self._capacity)
         key_hi, key_lo = self._bulk_insert(
@@ -477,6 +515,8 @@ class TpuChecker(HostChecker):
 
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
+                if i in host_prop_idx:
+                    continue  # host-evaluated: device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
 
@@ -488,6 +528,16 @@ class TpuChecker(HostChecker):
                 fp_c = _combine64(chi_h[:count], clo_h[:count])
                 fp_p = _combine64(phi_h[:count], plo_h[:count])
                 generated.update(zip(fp_c.tolist(), fp_p.tolist()))
+                if self._host_props and any(
+                        p.name not in discoveries
+                        for _i, p in self._host_props):
+                    # skip the row pull + decode once every host property
+                    # already has its discovery
+                    rows_h = np.asarray(jax.device_get(take_rows_fn(
+                        comp_rows, _bucket(count))))
+                    for k in range(count):
+                        self._eval_host_props_row(
+                            rows_h[k], int(fp_c[k]), discoveries)
             self._unique_state_count = len(generated)
 
             if len(discoveries) == prop_count:
@@ -508,6 +558,38 @@ class TpuChecker(HostChecker):
                 segments.append((comp_rows, comp_eb, seg_start, seg_len))
 
     # ------------------------------------------------------------------
+    def _eval_host_props_state(self, state, fp: int,
+                               discoveries: Dict[str, int]) -> None:
+        for i, prop in self._host_props:
+            if prop.name in discoveries:
+                continue
+            res = bool(prop.condition(self._model, state))
+            if prop.expectation == Expectation.ALWAYS and not res:
+                discoveries[prop.name] = fp
+            elif prop.expectation == Expectation.SOMETIMES and res:
+                discoveries[prop.name] = fp
+
+    def _eval_host_props_row(self, row, fp: int,
+                             discoveries: Dict[str, int]) -> None:
+        """Evaluate host properties on one newly inserted packed state,
+        memoized by ``model.host_property_key`` (e.g. distinct histories
+        recur across thousands of states)."""
+        model = self._model
+        key = model.host_property_key(row)
+        results = self._host_prop_cache.get(key)
+        if results is None:
+            state = model.decode(row)
+            results = [bool(prop.condition(model, state))
+                       for _i, prop in self._host_props]
+            self._host_prop_cache[key] = results
+        for (i, prop), res in zip(self._host_props, results):
+            if prop.name in discoveries:
+                continue
+            if prop.expectation == Expectation.ALWAYS and not res:
+                discoveries[prop.name] = fp
+            elif prop.expectation == Expectation.SOMETIMES and res:
+                discoveries[prop.name] = fp
+
     def _bulk_insert(self, insert_fn, key_hi, key_lo, fps: List[int]):
         """(Re)insert known fingerprints, e.g. at init or after growth."""
         import jax.numpy as jnp

@@ -226,15 +226,22 @@ def main(argv=None) -> None:
         (PaxosModelCfg(client_count=client_count, server_count=3,
                        network=network)
          .into_model().checker().serve(address))
+    elif cmd == "check-tpu":
+        client_count = int(args[1]) if len(args) > 1 else 2
+        from .paxos_packed import PackedPaxos
+        print(f"Model checking Single Decree Paxos with {client_count} "
+              "clients on the TPU engine.")
+        (PackedPaxos(client_count).checker().spawn_tpu()
+         .report(sys.stdout))
     elif cmd == "spawn":
-        import json
-
         from .paxos_spawn import spawn_paxos_cluster
-        spawn_paxos_cluster(json)
+        spawn_paxos_cluster()
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.paxos check "
               "[CLIENT_COUNT] [NETWORK]")
+        print("  python -m stateright_tpu.examples.paxos check-tpu "
+              "[CLIENT_COUNT]")
         print("  python -m stateright_tpu.examples.paxos explore "
               "[CLIENT_COUNT] [ADDRESS] [NETWORK]")
         print("  python -m stateright_tpu.examples.paxos spawn")

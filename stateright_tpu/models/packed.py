@@ -64,9 +64,28 @@ class PackedModel(Model):
     def packed_properties(self, words):
         """JAX evaluation of all properties for one packed state.
 
+        For indices in ``host_property_indices`` (irregular predicates the
+        device cannot express, e.g. the linearizability search) return a
+        neutral placeholder (True for ALWAYS, False for SOMETIMES); the
+        TPU engine evaluates those host-side per level on new states.
+
         Returns bool[P] in ``self.properties()`` order.
         """
         raise NotImplementedError
+
+    #: property indices evaluated host-side by the TPU engine
+    host_property_indices: Tuple[int, ...] = ()
+
+    def host_property_key(self, row) -> bytes:
+        """Memoization key for host-property evaluation of a packed row.
+
+        Must discriminate at least as finely as every host property's
+        dependence on the state; defaults to the whole row. Models whose
+        host properties depend only on a state slice (e.g. the history
+        words) override this so the expensive predicate runs once per
+        distinct slice.
+        """
+        return np.asarray(row, dtype=np.uint32).tobytes()
 
     def fingerprint(self, state: Any) -> int:
         return fp64_words(self.encode(state).tolist())
@@ -120,9 +139,13 @@ def validate_packed_model(model: PackedModel, max_states: int = 2000) -> int:
         assert packed_succ == host_succ, \
             f"packed successors disagree with host successors for {state!r}:" \
             f"\n packed={packed_succ}\n host={host_succ}"
-        # packed properties match host property conditions
+        # packed properties match host property conditions (host-evaluated
+        # properties return a neutral placeholder on device — skip them)
+        host_props = set(getattr(model, "host_property_indices", ()))
         pb = np.asarray(props(jnp.asarray(enc)))
         for i, prop in enumerate(properties):
+            if i in host_props:
+                continue
             want = bool(prop.condition(model, state))
             assert bool(pb[i]) == want, \
                 f"packed property {prop.name!r} = {bool(pb[i])} != host " \

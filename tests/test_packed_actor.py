@@ -1,0 +1,63 @@
+"""Packed ActorModel encoding: the actor bridge onto the TPU engine.
+
+Differential oracles: the packed paxos model must agree with the plain
+ActorModel paxos state-for-state (265 for 1 client, 16,668 for 2 — the
+north-star oracle, `/root/reference/examples/paxos.rs:291`), and the
+packed step must reproduce host successors exactly
+(:func:`validate_packed_model` walks the contract state by state).
+"""
+
+import pytest
+
+from stateright_tpu.examples.paxos_packed import PackedPaxos
+from stateright_tpu.models.packed import validate_packed_model
+
+
+class TestPackedPaxosContract:
+    def test_validate_packed_model_full_n1(self):
+        """Every state of the 1-client space: encode/decode round-trip,
+        host/device fingerprint equality, successor-multiset equality,
+        property agreement."""
+        assert validate_packed_model(PackedPaxos(1), max_states=300) == 265
+
+    def test_history_injective_n1(self):
+        """The packed encoding separates exactly the states the host
+        ActorModel separates (fingerprint count == host state count)."""
+        m = PackedPaxos(1)
+        seen = set()
+        stack = list(m.init_states())
+        while stack:
+            s = stack.pop()
+            fp = m.fingerprint(s)
+            if fp in seen:
+                continue
+            seen.add(fp)
+            stack.extend(m.next_states(s))
+        assert len(seen) == 265
+
+
+class TestPackedPaxosOnDevice:
+    def test_spawn_tpu_n1(self):
+        """1-client paxos on the device engine: 265 unique states,
+        value-chosen example found, linearizability never violated."""
+        ck = (PackedPaxos(1).checker()
+              .tpu_options(capacity=1 << 12).spawn_tpu().join())
+        assert ck.unique_state_count() == 265
+        ck.assert_properties()
+        assert ck.discovery("value chosen") is not None
+        # witness replays through the host model (host/device agreement)
+        path = ck.discoveries()["value chosen"]
+        assert len(path.into_actions()) >= 1
+
+    def test_host_property_requires_level_mode(self):
+        with pytest.raises(ValueError):
+            (PackedPaxos(1).checker().tpu_options(mode="device")
+             .spawn_tpu().join())
+
+    @pytest.mark.slow
+    def test_spawn_tpu_n2_16668(self):
+        """The north-star oracle on the device engine."""
+        ck = (PackedPaxos(2).checker()
+              .tpu_options(capacity=1 << 17).spawn_tpu().join())
+        assert ck.unique_state_count() == 16668
+        ck.assert_properties()
