@@ -161,6 +161,14 @@ class PackedActorModel(ActorModel, PackedModel):
         timer = 0
         for i, set_ in enumerate(state.is_timer_set):
             timer |= int(bool(set_)) << i
+        # the device step enumerates Deliver actions only: a set timer (or a
+        # lossy network, checked in packed_step) would mean Timeout/Drop
+        # transitions the host model explores but the device silently
+        # wouldn't — refuse rather than under-explore
+        assert timer == 0, (
+            "PackedActorModel does not support timers on the device engine "
+            "(Timeout actions are not in the packed action axis); use the "
+            "host engines for timer-driven actors")
         out[self._timer_off] = timer
         if self.history_width:
             hwords = self.encode_history(state.history)
@@ -245,6 +253,11 @@ class PackedActorModel(ActorModel, PackedModel):
     def packed_step(self, words):
         import jax
         import jax.numpy as jnp
+        if self.lossy_network_:
+            raise NotImplementedError(
+                "lossy networks are not supported on the device engine "
+                "(Drop actions are not in the packed action axis); use "
+                "the host engines for lossy checks")
         aw, sw, e_cap = self._aw, self._sw, self.net_capacity
         hw = self.history_width
         actors = words[:aw]
@@ -292,13 +305,15 @@ class PackedActorModel(ActorModel, PackedModel):
             if hw:
                 parts.append(new_hist)
             row = jnp.concatenate(parts).astype(jnp.uint32)
-            # an overflowing successor would silently drop a message:
-            # poison + invalidate the row so a mis-sized net_capacity
-            # shows up as a count divergence against the host oracle
-            # rather than silent corruption
+            # an overflowing successor would silently drop a message and
+            # under-explore the state graph: poison + invalidate the row
+            # AND report the overflow, which every engine surfaces as a
+            # hard error (a mis-sized net_capacity must never read as
+            # "checked clean")
+            overflow = valid & overflow
             row = jnp.where(overflow, jnp.full_like(row, 0xDEADBEEF), row)
             valid = valid & ~overflow & self.packed_boundary(row)
-            return row, valid
+            return row, valid, overflow
 
         return jax.vmap(one_action)(jnp.arange(e_cap))
 

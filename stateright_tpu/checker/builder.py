@@ -71,7 +71,13 @@ class CheckerBuilder:
     def spawn_tpu(self) -> "Checker":
         """TPU-native engine: vmapped frontier expansion with device-resident
         fingerprint dedup. Requires the model to implement the
-        :class:`~stateright_tpu.models.packed.PackedModel` protocol."""
+        :class:`~stateright_tpu.models.packed.PackedModel` protocol.
+        With ``tpu_options(mesh=jax.sharding.Mesh(...))`` the search runs
+        SPMD over the mesh: frontier, visited table and logs sharded by
+        fingerprint prefix, children routed to owner shards over ICI."""
+        if "mesh" in self.tpu_options_:
+            from ..parallel.engine import ShardedTpuChecker
+            return ShardedTpuChecker(self)
         from .tpu import TpuChecker
         return TpuChecker(self)
 

@@ -56,6 +56,7 @@ class ChunkCarry(NamedTuple):
     gen: jax.Array      # int32[]  states generated THIS chunk (host accumulates)
     ovf: jax.Array      # bool[]   table probe overflow (should not happen
     #                              below the growth limit)
+    xovf: jax.Array     # bool[]   model capacity overflow (fatal)
     steps: jax.Array    # int32[]  remaining step budget for this chunk
 
 
@@ -78,7 +79,7 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int):
 
     def cond(state):
         c, target_remaining, grow_limit = state
-        go = (c.q_size > 0) & (c.steps > 0) & ~c.ovf \
+        go = (c.q_size > 0) & (c.steps > 0) & ~c.ovf & ~c.xovf \
             & (c.gen < target_remaining) \
             & (c.log_n < grow_limit) \
             & (c.q_size <= qcap - fa)
@@ -138,6 +139,7 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int):
             disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
             gen=c.gen + exp.cvalid.sum(dtype=jnp.int32),
             ovf=c.ovf | t_ovf,
+            xovf=c.xovf | exp.xovf,
             steps=c.steps - 1)
         return (nc, target_remaining, grow_limit)
 
@@ -176,4 +178,5 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
         disc_hit=jnp.zeros((prop_count,), bool),
         disc_hi=jnp.zeros((prop_count,), jnp.uint32),
         disc_lo=jnp.zeros((prop_count,), jnp.uint32),
-        gen=jnp.int32(0), ovf=jnp.bool_(False), steps=jnp.int32(steps))
+        gen=jnp.int32(0), ovf=jnp.bool_(False), xovf=jnp.bool_(False),
+        steps=jnp.int32(steps))

@@ -57,6 +57,12 @@ from .path import Path
 
 _MIN_BUCKET = 16
 
+_XOVF_MESSAGE = (
+    "packed-state capacity overflow: a successor state could not be "
+    "encoded (e.g. more distinct in-flight envelopes than net_capacity "
+    "slots). Raise the model's capacity bounds — continuing would "
+    "silently under-explore the state graph.")
+
 
 def _next_pow2(n: int) -> int:
     return 1 << max((n - 1).bit_length(), 0)
@@ -128,7 +134,7 @@ def build_level_fn(model):
         gen_count = exp.cvalid.sum(dtype=jnp.int32)
         return (key_hi, key_lo, comp_rows, comp_chi, comp_clo, comp_phi,
                 comp_plo, comp_eb, count, disc_hit, disc_hi, disc_lo,
-                gen_count, overflow, exp.phi, exp.plo)
+                gen_count, overflow, exp.phi, exp.plo, exp.xovf)
 
     return jax.jit(level_fn)
 
@@ -288,10 +294,10 @@ class TpuChecker(HostChecker):
             carry = carry._replace(gen=jnp.int32(0),
                                    steps=jnp.int32(k_steps))
             carry = chunk_fn(carry, remaining, grow_limit)
-            (q_size, log_n, disc_hit, disc_hi, disc_lo, gen, ovf) = \
+            (q_size, log_n, disc_hit, disc_hi, disc_lo, gen, ovf, xovf) = \
                 jax.device_get((carry.q_size, carry.log_n, carry.disc_hit,
                                 carry.disc_hi, carry.disc_lo, carry.gen,
-                                carry.ovf))
+                                carry.ovf, carry.xovf))
             self._state_count += int(gen)
             self._unique_state_count = n_init + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
@@ -300,6 +306,8 @@ class TpuChecker(HostChecker):
                     continue  # host-evaluated: device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
+            if bool(xovf):
+                raise RuntimeError(_XOVF_MESSAGE)
             if bool(ovf):
                 raise RuntimeError(
                     "device hash table probe overflow below the growth "
@@ -485,13 +493,15 @@ class TpuChecker(HostChecker):
             while True:
                 (key_hi, key_lo, comp_rows, comp_chi, comp_clo, comp_phi,
                  comp_plo, comp_eb, count_d, disc_hit_d, disc_hi_d,
-                 disc_lo_d, gen_d, ovf_d, fp_hi_d, fp_lo_d) = \
+                 disc_lo_d, gen_d, ovf_d, fp_hi_d, fp_lo_d, xovf_d) = \
                     level_fn(frontier, fvalid, ebits, key_hi, key_lo)
 
                 # small pull: scalars + per-property discovery candidates
-                (count, disc_hit, disc_hi, disc_lo, gen_count, overflow) = \
-                    jax.device_get((count_d, disc_hit_d, disc_hi_d,
-                                    disc_lo_d, gen_d, ovf_d))
+                (count, disc_hit, disc_hi, disc_lo, gen_count, overflow,
+                 xovf) = jax.device_get((count_d, disc_hit_d, disc_hi_d,
+                                         disc_lo_d, gen_d, ovf_d, xovf_d))
+                if bool(xovf):
+                    raise RuntimeError(_XOVF_MESSAGE)
                 if not overflow:
                     break
                 # a single level's batch outran the table headroom: grow,

@@ -57,7 +57,10 @@ class PackedModel(Model):
            valid bool[max_actions]) — row ``a`` is the result of action
           ``a``; invalid rows cover disabled actions, no-op transitions
           (the reference's ``next_state -> None``), and out-of-boundary
-          successors.
+          successors. Models whose encoding can overflow (e.g. a fixed
+          number of network slots) may return a third array
+          ``overflow bool[max_actions]``: any set bit aborts the engines
+          with a hard error rather than silently under-exploring.
         """
         raise NotImplementedError
 
@@ -128,7 +131,11 @@ def validate_packed_model(model: PackedModel, max_states: int = 2000) -> int:
         assert dev_fp == fp, \
             f"device fp {dev_fp:#x} != host fp {fp:#x} for {state!r}"
         # packed successors match host successors (as multisets of encodings)
-        succ, valid = step(jnp.asarray(enc))
+        out = step(jnp.asarray(enc))
+        succ, valid = out[0], out[1]
+        if len(out) == 3:
+            assert not np.asarray(out[2]).any(), \
+                f"packed_step reports encoding overflow for {state!r}"
         succ = np.asarray(succ)
         valid = np.asarray(valid)
         packed_succ = sorted(tuple(succ[a].tolist())

@@ -30,6 +30,9 @@ class Expansion(NamedTuple):
     phi: jax.Array       # uint32[F]   frontier fingerprints
     plo: jax.Array
     terminal: jax.Array  # bool[F]     rows with no valid action
+    xovf: jax.Array      # bool[]      model capacity overflow (fatal: a
+    #                                  successor could not be encoded, e.g.
+    #                                  net_capacity too small)
 
 
 def eventually_indices(properties) -> list:
@@ -49,7 +52,13 @@ def expand_frontier(model, frontier, fvalid, ebits,
             sat = sat | jnp.where(pbits[:, i], jnp.uint32(1 << i),
                                   jnp.uint32(0))
         ebits = ebits & ~sat
-    succ, avalid = jax.vmap(model.packed_step)(frontier)
+    out = jax.vmap(model.packed_step)(frontier)
+    if len(out) == 3:  # models reporting per-action encoding overflow
+        succ, avalid, aovf = out
+        xovf = (aovf & fvalid[:, None]).any()
+    else:
+        succ, avalid = out
+        xovf = jnp.bool_(False)
     avalid = avalid & fvalid[:, None]
     flat = succ.reshape((-1, width))
     chi, clo = fp64_device(flat)
@@ -57,7 +66,7 @@ def expand_frontier(model, frontier, fvalid, ebits,
     terminal = fvalid & ~avalid.any(axis=1)
     return Expansion(pbits=pbits, ebits=ebits, flat=flat,
                      cvalid=avalid.reshape(-1), chi=chi, clo=clo,
-                     phi=phi, plo=plo, terminal=terminal)
+                     phi=phi, plo=plo, terminal=terminal, xovf=xovf)
 
 
 def discovery_candidates(properties, exp: Expansion, fvalid):

@@ -8,6 +8,8 @@ level ends with an ICI exchange routing newly generated children to their
 owner shard (SURVEY.md §2.7, §5 "distributed communication backend").
 """
 
-from .sharded import build_sharded_level, ShardedLevelOutputs
+from .sharded import (ShardedCarry, build_sharded_chunk_fn,
+                      build_sharded_insert, owner_of, seed_sharded_carry)
 
-__all__ = ["build_sharded_level", "ShardedLevelOutputs"]
+__all__ = ["ShardedCarry", "build_sharded_chunk_fn", "build_sharded_insert",
+           "owner_of", "seed_sharded_carry"]

@@ -1,0 +1,279 @@
+"""Multi-chip checking engine: ``spawn_tpu()`` over a ``jax.sharding.Mesh``.
+
+Selected by ``checker_builder.tpu_options(mesh=mesh)``. Orchestrates the
+SPMD chunk loop built in `sharded.py` the same way ``TpuChecker._run_device``
+drives the single-chip device loop: the host re-enters the jitted loop once
+per K-iteration chunk, reads a handful of replicated scalars (progress,
+discoveries, growth pressure), grows the sharded buffers when any shard
+approaches its slice capacity, and finally pulls the per-shard
+(child fp, parent fp) logs to complete the host mirror used for trace
+reconstruction by replay (TLC-style,
+`/root/reference/src/checker/bfs.rs:314-342`).
+
+Not supported on the sharded engine (use single-chip ``spawn_tpu`` or the
+host engines): per-state visitors and host-evaluated properties — both
+require pulling every new state back each level, defeating the point of a
+device-resident multi-chip loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..checker.builder import CheckerBuilder
+from ..checker.tpu import TpuChecker, _combine64
+from .sharded import (ShardedCarry, build_sharded_chunk_fn,
+                      build_sharded_insert, owner_of, seed_sharded_carry)
+
+
+class ShardedTpuChecker(TpuChecker):
+    """Fingerprint-prefix-sharded BFS over a device mesh."""
+
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        opts = builder.tpu_options_
+        self._mesh = opts["mesh"]
+        self._axis = str(opts.get("mesh_axis", "shards"))
+        if self._axis not in self._mesh.shape:
+            raise ValueError(
+                f"mesh has no axis {self._axis!r}; axes: "
+                f"{tuple(self._mesh.shape)}")
+        d = self._mesh.shape[self._axis]
+        if d & (d - 1):
+            raise ValueError("mesh axis size must be a power of two")
+        if self._capacity % d:
+            raise ValueError("capacity must be divisible by the mesh axis")
+        if self._visitor is not None:
+            raise ValueError(
+                "visitors are a host feature; use single-chip spawn_tpu "
+                "(per-level mode) or the host engines")
+        if self._host_props:
+            raise NotImplementedError(
+                "host-evaluated properties are not supported on the "
+                "sharded engine; use single-chip spawn_tpu")
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        import jax
+
+        mesh, axis = self._mesh, self._axis
+        D = mesh.shape[axis]
+        model = self._model
+        properties = self._properties
+        prop_count = len(properties)
+        n_actions = model.max_actions
+        from ..ops.expand import eventually_indices
+        full_ebits = np.uint32(sum(1 << i
+                                   for i in eventually_indices(properties)))
+        generated = self._generated
+        discoveries: Dict[str, int] = {}
+        target = self._target_state_count
+        opts = self._tpu_options
+        k_steps = int(opts.get("chunk_steps", 64))
+
+        init_rows = self._seed_inits()
+        init_fps = list(generated.keys())
+        n_init = len(init_fps)
+        if prop_count == 0:
+            return  # vacuously done (bfs.rs:121-128)
+
+        fmax = int(opts.get("fmax", max(256, (1 << 13) // D)))
+        headroom = D * fmax * n_actions
+        # per-shard slice must keep one worst-case iteration of headroom
+        # below the growth limit (same invariant as the single-chip loop)
+        while self._grow_at * (self._capacity // D) <= headroom + n_init:
+            self._capacity *= 4
+        qcap = int(opts.get("qcap", self._capacity))
+        qloc = max(qcap // D, n_init, 2 * headroom)
+        qloc = 1 << (qloc - 1).bit_length()  # round up to a power of two
+        qcap = qloc * D
+
+        insert_fn = build_sharded_insert(mesh, axis)
+        carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
+                                   init_rows, init_fps, full_ebits,
+                                   prop_count)
+        key_hi, key_lo = self._sharded_bulk_insert(
+            insert_fn, carry.key_hi, carry.key_lo, init_fps, D)
+        carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
+        chunk_fn = build_sharded_chunk_fn(model, mesh, axis, qcap,
+                                          self._capacity, fmax)
+
+        import jax.numpy as jnp
+
+        while True:
+            closc = self._capacity // D
+            grow_limit = np.int32(min(self._grow_at * closc,
+                                      closc - headroom))
+            remaining = np.int32(
+                min(max(target - self._state_count, 0), 2**31 - 1)
+                if target is not None else 2**31 - 1)
+            carry = carry._replace(gen=jnp.int32(0),
+                                   steps=jnp.int32(k_steps))
+            carry = chunk_fn(carry, remaining, grow_limit)
+            (q_size, log_n, disc_hit, disc_hi, disc_lo, gen, ovf,
+             xovf) = jax.device_get(
+                (carry.q_size, carry.log_n, carry.disc_hit,
+                 carry.disc_hi, carry.disc_lo, carry.gen, carry.ovf,
+                 carry.xovf))
+            self._state_count += int(gen)
+            self._unique_state_count = n_init + int(log_n.sum())
+            disc_fps = _combine64(disc_hi, disc_lo)
+            for i, prop in enumerate(properties):
+                if disc_hit[i] and prop.name not in discoveries:
+                    discoveries[prop.name] = int(disc_fps[i])
+            if bool(xovf):
+                from ..checker.tpu import _XOVF_MESSAGE
+                raise RuntimeError(_XOVF_MESSAGE)
+            if bool(ovf):
+                raise RuntimeError(
+                    "device hash table probe overflow below the growth "
+                    f"limit (capacity {self._capacity}); raise via "
+                    "checker_builder.tpu_options(capacity=...)")
+            done = (int(q_size.sum()) == 0
+                    or len(discoveries) == prop_count
+                    or (target is not None
+                        and self._state_count >= target))
+            if done:
+                break
+            need_grow = (int(log_n.max()) >= int(grow_limit)
+                         or int(q_size.max()) > qcap // D - headroom)
+            if need_grow:
+                carry, qcap = self._grow_sharded(
+                    carry, qcap, headroom, init_fps, insert_fn)
+                chunk_fn = build_sharded_chunk_fn(
+                    model, mesh, axis, qcap, self._capacity, fmax)
+
+        self._finalize_sharded(carry)
+        self._discovery_fps.update(discoveries)
+
+    # ------------------------------------------------------------------
+    def _sharded_bulk_insert(self, insert_fn, key_hi, key_lo,
+                             fps: List[int], d: int):
+        """Route fingerprints to their owner shards' blocks and insert."""
+        per_shard: List[List[int]] = [[] for _ in range(d)]
+        for fp in fps:
+            per_shard[owner_of(fp, d)].append(fp)
+        n = max(1, max(len(b) for b in per_shard))
+        n = 1 << (n - 1).bit_length()
+        fhi = np.zeros((d * n,), dtype=np.uint32)
+        flo = np.zeros((d * n,), dtype=np.uint32)
+        valid = np.zeros((d * n,), dtype=bool)
+        for s, block in enumerate(per_shard):
+            arr = np.asarray(block, dtype=np.uint64)
+            fhi[s * n:s * n + len(block)] = (arr >> np.uint64(32)).astype(
+                np.uint32)
+            flo[s * n:s * n + len(block)] = arr.astype(np.uint32)
+            valid[s * n:s * n + len(block)] = True
+        key_hi, key_lo, ovf = insert_fn(key_hi, key_lo, fhi, flo, valid)
+        import jax
+        if bool(jax.device_get(ovf)):
+            raise RuntimeError(
+                "device hash table overflow during sharded bulk insert")
+        return key_hi, key_lo
+
+    # ------------------------------------------------------------------
+    def _grow_sharded(self, carry: ShardedCarry, qcap: int, headroom: int,
+                      init_fps: List[int], insert_fn):
+        """Quadruple the sharded table/log (and the queues under pressure):
+        pull the carry, rebuild the host way, re-insert every logged
+        fingerprint into the fresh table slices."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh, axis = self._mesh, self._axis
+        D = mesh.shape[axis]
+        # pull only what the rebuild reads — NOT the old table halves,
+        # which are discarded and re-derived from the logs
+        h = carry._replace(
+            key_hi=None, key_lo=None, ovf=None, go=None)._replace(
+            **jax.device_get({
+                f: getattr(carry, f)
+                for f in ("q_rows", "q_eb", "q_head", "q_size",
+                          "log_chi", "log_clo", "log_phi", "log_plo",
+                          "log_n", "disc_hit", "disc_hi", "disc_lo",
+                          "gen", "xovf", "steps")}))
+        old_qloc = qcap // D
+        old_closc = self._capacity // D
+        self._capacity *= 4
+        new_qcap = qcap
+        if int(h.q_size.max()) > old_qloc // 2:
+            new_qcap = qcap * 4
+        qloc = new_qcap // D
+        closc = self._capacity // D
+        width = self._model.packed_width
+
+        q_rows = np.zeros((new_qcap, width), dtype=np.uint32)
+        q_eb = np.zeros((new_qcap,), dtype=np.uint32)
+        log_chi = np.zeros((self._capacity,), dtype=np.uint32)
+        log_clo = np.zeros((self._capacity,), dtype=np.uint32)
+        log_phi = np.zeros((self._capacity,), dtype=np.uint32)
+        log_plo = np.zeros((self._capacity,), dtype=np.uint32)
+        fps_to_insert: List[int] = list(init_fps)
+        for s in range(D):
+            size = int(h.q_size[s])
+            head = int(h.q_head[s])
+            idx = (head + np.arange(size)) & (old_qloc - 1)
+            q_rows[s * qloc:s * qloc + size] = \
+                h.q_rows[s * old_qloc:(s + 1) * old_qloc][idx]
+            q_eb[s * qloc:s * qloc + size] = \
+                h.q_eb[s * old_qloc:(s + 1) * old_qloc][idx]
+            ln = int(h.log_n[s])
+            src = slice(s * old_closc, s * old_closc + ln)
+            dst = slice(s * closc, s * closc + ln)
+            log_chi[dst] = h.log_chi[src]
+            log_clo[dst] = h.log_clo[src]
+            log_phi[dst] = h.log_phi[src]
+            log_plo[dst] = h.log_plo[src]
+            fps_to_insert.extend(_combine64(
+                h.log_chi[src], h.log_clo[src]).tolist())
+
+        sh = NamedSharding(mesh, P(axis))
+        rep = NamedSharding(mesh, P())
+        key_hi = jax.device_put(
+            np.zeros((self._capacity,), np.uint32), sh)
+        key_lo = jax.device_put(
+            np.zeros((self._capacity,), np.uint32), sh)
+        key_hi, key_lo = self._sharded_bulk_insert(
+            insert_fn, key_hi, key_lo, fps_to_insert, D)
+        new_carry = ShardedCarry(
+            q_rows=jax.device_put(q_rows, sh),
+            q_eb=jax.device_put(q_eb, sh),
+            q_head=jax.device_put(np.zeros((D,), np.int32), sh),
+            q_size=jax.device_put(h.q_size, sh),
+            key_hi=key_hi, key_lo=key_lo,
+            log_chi=jax.device_put(log_chi, sh),
+            log_clo=jax.device_put(log_clo, sh),
+            log_phi=jax.device_put(log_phi, sh),
+            log_plo=jax.device_put(log_plo, sh),
+            log_n=jax.device_put(h.log_n, sh),
+            disc_hit=jax.device_put(h.disc_hit, rep),
+            disc_hi=jax.device_put(h.disc_hi, rep),
+            disc_lo=jax.device_put(h.disc_lo, rep),
+            gen=jax.device_put(h.gen, rep),
+            ovf=jax.device_put(np.bool_(False), rep),
+            xovf=jax.device_put(h.xovf, rep),
+            steps=jax.device_put(h.steps, rep),
+            go=jax.device_put(np.bool_(False), rep))
+        return new_carry, new_qcap
+
+    # ------------------------------------------------------------------
+    def _finalize_sharded(self, carry: ShardedCarry) -> None:
+        """Pull the per-shard logs and complete the host mirror."""
+        import jax
+
+        D = self._mesh.shape[self._axis]
+        closc = self._capacity // D
+        log_n, log_chi, log_clo, log_phi, log_plo = jax.device_get(
+            (carry.log_n, carry.log_chi, carry.log_clo, carry.log_phi,
+             carry.log_plo))
+        for s in range(D):
+            ln = int(log_n[s])
+            if not ln:
+                continue
+            src = slice(s * closc, s * closc + ln)
+            child = _combine64(log_chi[src], log_clo[src])
+            parent = _combine64(log_phi[src], log_plo[src])
+            self._generated.update(zip(child.tolist(), parent.tolist()))
+        self._unique_state_count = len(self._generated)

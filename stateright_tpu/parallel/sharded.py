@@ -1,182 +1,311 @@
-"""SPMD sharded BFS level step: the multi-chip heart of ``spawn_tpu``.
+"""SPMD sharded device-resident BFS loop: the multi-chip heart of
+``spawn_tpu``.
 
 Replaces the reference's shared-memory job market
 (`/root/reference/src/checker/bfs.rs:29-30`, worker sharing at
 `bfs.rs:138-150`) with fingerprint-prefix ownership over a
 ``jax.sharding.Mesh``:
 
-  * the frontier, the visited hash table, and every per-level output are
-    sharded over one mesh axis (default ``"shards"``);
+  * the pending-state ring queue, the visited hash table, and the
+    (child fp, parent fp) log are all sharded over one mesh axis (default
+    ``"shards"``) — every shard owns a ``1/D`` slice of each;
   * a state is *owned* by the shard selected by the top ``log2(D)`` bits of
-    its fingerprint's hi word — so the visited set partitions cleanly and a
-    state is only ever deduplicated by one shard;
-  * each level, every shard expands its local frontier rows (vmapped
-    ``packed_step``), fingerprints the children, and routes them to their
-    owners with a **ring exchange** (``lax.ppermute`` over ICI): D hops, and
-    at each hop a shard claims the in-flight children it owns, inserts them
-    into its local table slice, and appends the fresh ones to its next local
-    frontier. After D hops every child has passed its owner exactly once.
+    its fingerprint's hi word, so the visited set partitions cleanly and a
+    state is only ever deduplicated (and expanded) by one shard;
+  * each iteration, every shard dequeues up to ``fmax`` local rows, expands
+    them (vmapped ``packed_step`` via the shared `ops/expand.py` core),
+    fingerprints the children, and routes them to their owners with a
+    **ring exchange** (``lax.ppermute`` over ICI): D hops, and at each hop a
+    shard claims the in-flight children it owns, inserts them into its local
+    table slice, logs them, and appends the fresh ones to its local queue.
+    After D hops every child has passed its owner exactly once.
+
+The whole multi-level search runs inside one ``lax.while_loop`` under
+``shard_map`` — one launch per K-iteration chunk regardless of chip count,
+exactly like the single-chip device loop (`checker/device_loop.py`).
+Termination, generation counters, and discovery registers are psum-reduced
+each iteration so the loop condition is a replicated scalar and all shards
+exit in lockstep (the distributed analog of "all threads waiting and no
+jobs", `bfs.rs:94-98`).
 
 The ring costs D permutes of the full child buffer; a bucketed
 ``all_to_all`` would move less data but needs per-destination compaction.
-The ring is chosen for v1 because every hop is a fixed-size neighbor
-transfer (pure ICI, no host), and D is small on a single slice.
+The ring is chosen because every hop is a fixed-size neighbor transfer
+(pure ICI, no host), and D is small on a single slice.
 
-All collectives are inside one ``shard_map``-ped, jitted function — one
-launch per BFS level regardless of chip count. Termination and overflow are
-``psum``-reduced so the host reads replicated scalars.
+Queue-overflow safety is static: the loop condition requires every shard's
+queue to have ``D * fmax * max_actions`` free slots — the worst case of one
+iteration routing every child in the machine to a single owner — before
+another iteration may start, so ring-buffer writes can never wrap onto live
+entries.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.expand import eventually_indices, expand_frontier
+from ..ops.expand import (discovery_candidates, eventually_indices,
+                          expand_frontier)
 from ..ops.hashtable import table_insert
 
 
-class ShardedLevelOutputs(NamedTuple):
-    """Per-level results. Arrays are global views sharded over the mesh axis
-    unless noted; the host only pulls the small ones."""
+class ShardedCarry(NamedTuple):
+    """Search state, sharded over the mesh axis unless marked replicated.
 
-    key_hi: Any          # uint32[C]    updated table (device-resident)
-    key_lo: Any          # uint32[C]
-    next_frontier: Any   # uint32[D*K, W]  newly inserted children (rows)
-    next_ebits: Any      # uint32[D*K]     eventually-bits inherited by row
-    next_valid: Any      # bool[D*K]       which rows are real
-    child_hi: Any        # uint32[D*K]     fingerprints of those rows
-    child_lo: Any        # uint32[D*K]
-    parent_hi: Any       # uint32[D*K]     parent fingerprints (host mirror)
-    parent_lo: Any       # uint32[D*K]
-    pbits: Any           # bool[D*F, Pn]   property bits per frontier row
-    frontier_hi: Any     # uint32[D*F]     frontier fingerprints
-    frontier_lo: Any     # uint32[D*F]
-    ebits_cleared: Any   # uint32[D*F]     frontier ebits after clearing
-    terminal: Any        # bool[D*F]       frontier rows with no valid action
-    gen_count: Any       # int32[]   states generated this level (global)
-    next_count: Any      # int32[]   children inserted this level (global)
-    overflow: Any        # bool[]    table or append-buffer overflow (global)
-
-
-def _append(bufs, count, rows, mask):
-    """Cursor-scatter append: write ``rows[mask]`` compactly at ``count``.
-
-    ``bufs``/``rows`` are tuples of parallel arrays. Returns updated bufs,
-    count, and an overflow flag for rows that didn't fit.
+    Shapes are global; each shard holds the ``1/D`` row-slice. Per-shard
+    scalars (head, size, log length) are length-``D`` vectors whose local
+    view is a one-element array.
     """
-    cap = bufs[0].shape[0]
-    pos = count + jnp.cumsum(mask.astype(jnp.int32)) - 1
-    write = mask & (pos < cap)
-    idx = jnp.where(write, pos, cap)
-    out = tuple(b.at[idx].set(r, mode="drop") for b, r in zip(bufs, rows))
-    return out, count + mask.sum(dtype=jnp.int32), (mask & ~write).any()
+
+    q_rows: jax.Array   # uint32[D*qcap, W] per-shard ring queues
+    q_eb: jax.Array     # uint32[D*qcap]    their eventually-bits
+    q_head: jax.Array   # int32[D]          per-shard ring head
+    q_size: jax.Array   # int32[D]          per-shard pending count
+    key_hi: jax.Array   # uint32[C]         visited table (C/D per shard)
+    key_lo: jax.Array   # uint32[C]
+    log_chi: jax.Array  # uint32[C]         child fp, insertion order
+    log_clo: jax.Array  # uint32[C]
+    log_phi: jax.Array  # uint32[C]         parent fp
+    log_plo: jax.Array  # uint32[C]
+    log_n: jax.Array    # int32[D]          per-shard log length
+    disc_hit: jax.Array  # bool[P]    replicated: property discovered?
+    disc_hi: jax.Array   # uint32[P]  replicated: witness fp (sticky first)
+    disc_lo: jax.Array   # uint32[P]
+    gen: jax.Array      # int32[]  replicated: states generated this chunk
+    ovf: jax.Array      # bool[]   replicated: table probe overflow
+    xovf: jax.Array     # bool[]   replicated: model capacity overflow
+    steps: jax.Array    # int32[]  replicated: remaining step budget
+    go: jax.Array       # bool[]   replicated: loop condition
 
 
-def build_sharded_level(model, mesh: Mesh, axis: str = "shards",
-                        out_mult: int = 1):
-    """Build the jitted SPMD level function for ``model`` over ``mesh``.
+def _owner_bits(d: int) -> int:
+    assert d & (d - 1) == 0, "mesh axis size must be a power of two"
+    return d.bit_length() - 1
 
-    The returned function has signature
-    ``(frontier, fvalid, ebits, key_hi, key_lo) -> ShardedLevelOutputs``
-    where ``frontier`` is ``uint32[D*F, W]`` sharded over ``axis``, and the
-    table halves are ``uint32[C]`` sharded the same way (``C/D`` slots per
-    shard, a power of two). Per-shard append capacity is
-    ``K = out_mult * F * max_actions`` — children land uniformly under a
-    good hash, so ``out_mult=1`` covers the expected load with the overflow
-    flag guarding the tail.
+
+def carry_specs(axis: str) -> ShardedCarry:
+    """PartitionSpecs for each carry field."""
+    s, r = P(axis), P()
+    return ShardedCarry(
+        q_rows=s, q_eb=s, q_head=s, q_size=s, key_hi=s, key_lo=s,
+        log_chi=s, log_clo=s, log_phi=s, log_plo=s, log_n=s,
+        disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
+        steps=r, go=r)
+
+
+def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
+                           capacity: int, fmax: int):
+    """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
+
+    ``qcap``/``capacity`` are **global**; each shard works on its
+    ``qcap // D`` / ``capacity // D`` slice. Returned callable:
+    ``chunk(carry, target_remaining, grow_limit) -> carry`` where
+    ``grow_limit`` bounds any single shard's log length (the host grows all
+    buffers when a shard approaches its slice capacity).
     """
     D = mesh.shape[axis]
-    assert D & (D - 1) == 0, "mesh axis size must be a power of two"
-    kbits = D.bit_length() - 1
-    width = model.packed_width
+    kbits = _owner_bits(D)
+    qloc = qcap // D
+    assert qloc & (qloc - 1) == 0, "per-shard queue must be a power of two"
+    closc = capacity // D
+    assert closc & (closc - 1) == 0, "per-shard table must be a power of two"
+    qmask = qloc - 1
     n_actions = model.max_actions
     properties = model.properties()
+    prop_count = len(properties)
     eventually_idx = eventually_indices(properties)
+    logcap = closc
+    # worst case: every child generated machine-wide lands on one shard
+    ring_headroom = D * fmax * n_actions
+    ring = [(i, (i + 1) % D) for i in range(D)]
 
-    def level_local(frontier, fvalid, ebits, key_hi, key_lo):
-        # Local shapes: frontier uint32[F, W]; table uint32[C/D].
-        fcount = frontier.shape[0]
+    def go_flag(q_size, log_n, disc_hit, gen, ovf, xovf, steps,
+                target_remaining, grow_limit):
+        total_q = lax.psum(q_size, axis)
+        max_q = lax.pmax(q_size, axis)
+        max_log = lax.pmax(log_n, axis)
+        go = ((total_q > 0) & (steps > 0) & ~ovf & ~xovf
+              & (gen < target_remaining)
+              & (max_log < grow_limit)
+              & (max_q <= qloc - ring_headroom))
+        if prop_count:
+            go = go & ~disc_hit.all()
+        return go
+
+    def body(state):
+        c, target_remaining, grow_limit = state
         me = lax.axis_index(axis).astype(jnp.uint32)
+        q_head, q_size, log_n = c.q_head[0], c.q_size[0], c.log_n[0]
 
-        # shared check_block analog (ops/expand.py), on local rows
+        take = jnp.minimum(q_size, fmax)
+        idxs = (q_head + jnp.arange(fmax, dtype=jnp.int32)) & qmask
+        frontier = c.q_rows[idxs]
+        ebits = c.q_eb[idxs]
+        fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
+
+        # shared check_block analog (ops/expand.py) on local rows
         exp = expand_frontier(model, frontier, fvalid, ebits,
                               eventually_idx)
-        pbits, ebits = exp.pbits, exp.ebits
-        flat, cvalid = exp.flat, exp.cvalid
-        chi, clo, phi, plo = exp.chi, exp.clo, exp.phi, exp.plo
-        par_hi = jnp.repeat(phi, n_actions)
-        par_lo = jnp.repeat(plo, n_actions)
-        cebits = jnp.repeat(ebits, n_actions)
-        terminal = exp.terminal
-        gen_count = lax.psum(cvalid.sum(dtype=jnp.int32), axis)
-
-        # -- ownership routing over the ring ------------------------------
+        par_hi = jnp.repeat(exp.phi, n_actions)
+        par_lo = jnp.repeat(exp.plo, n_actions)
+        ceb = jnp.repeat(exp.ebits, n_actions)
         if kbits:
-            owner = chi >> jnp.uint32(32 - kbits)
+            owner = exp.chi >> jnp.uint32(32 - kbits)
         else:
-            owner = jnp.zeros_like(chi)
+            owner = jnp.zeros_like(exp.chi)
 
-        cap = out_mult * fcount * n_actions
-        bufs = (jnp.zeros((cap, width), dtype=jnp.uint32),
-                jnp.zeros((cap,), dtype=jnp.uint32),   # child hi
-                jnp.zeros((cap,), dtype=jnp.uint32),   # child lo
-                jnp.zeros((cap,), dtype=jnp.uint32),   # parent hi
-                jnp.zeros((cap,), dtype=jnp.uint32),   # parent lo
-                jnp.zeros((cap,), dtype=jnp.uint32))   # ebits
-        count = jnp.int32(0)
-        overflow = jnp.bool_(False)
-        ring = [(i, (i + 1) % D) for i in range(D)]
-        carry = (flat, chi, clo, par_hi, par_lo, cebits, cvalid, owner)
-        for _hop in range(D):
-            (flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c, val_c,
-             own_c) = carry
+        q_head = (q_head + take) & qmask
+        q_size = q_size - take
+        key_hi, key_lo = c.key_hi, c.key_lo
+        q_rows, q_eb = c.q_rows, c.q_eb
+        log_chi, log_clo = c.log_chi, c.log_clo
+        log_phi, log_plo = c.log_phi, c.log_plo
+        t_ovf = jnp.bool_(False)
+
+        # ownership routing: D hops around the ring; each shard claims and
+        # dedups the in-flight children it owns, then forwards the rest
+        rc = (exp.flat, exp.chi, exp.clo, par_hi, par_lo, ceb, exp.cvalid,
+              owner)
+        for hop in range(D):
+            flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c, val_c, own_c = rc
             mine = val_c & (own_c == me)
-            inserted, key_hi, key_lo, ovf = table_insert(
+            inserted, key_hi, key_lo, o = table_insert(
                 key_hi, key_lo, chi_c, clo_c, mine)
-            overflow = overflow | ovf
-            bufs, count, aovf = _append(
-                bufs, count,
-                (flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c), inserted)
-            overflow = overflow | aovf
-            if D > 1 and _hop < D - 1:
-                carry = tuple(
-                    lax.ppermute(x, axis, ring) for x in carry)
+            t_ovf = t_ovf | o
+            cnt = inserted.sum(dtype=jnp.int32)
+            pos = jnp.cumsum(inserted.astype(jnp.int32)) - 1
+            qidx = jnp.where(inserted, (q_head + q_size + pos) & qmask,
+                             qloc)
+            q_rows = q_rows.at[qidx].set(flat_c, mode="drop")
+            q_eb = q_eb.at[qidx].set(ceb_c, mode="drop")
+            lidx = jnp.where(inserted, log_n + pos, logcap)
+            log_chi = log_chi.at[lidx].set(chi_c, mode="drop")
+            log_clo = log_clo.at[lidx].set(clo_c, mode="drop")
+            log_phi = log_phi.at[lidx].set(phi_c, mode="drop")
+            log_plo = log_plo.at[lidx].set(plo_c, mode="drop")
+            q_size = q_size + cnt
+            log_n = log_n + cnt
+            if D > 1 and hop < D - 1:
+                rc = tuple(lax.ppermute(x, axis, ring) for x in rc)
 
-        next_valid = jnp.arange(cap, dtype=jnp.int32) < count
-        next_count = lax.psum(count, axis)
-        overflow = lax.psum(overflow.astype(jnp.int32), axis) > 0
-        return ShardedLevelOutputs(
+        # sticky discovery registers: pick the lowest-indexed shard with a
+        # local candidate, broadcast its fingerprint via psum
+        disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
+        if prop_count:
+            hit_l, cand_hi, cand_lo = discovery_candidates(
+                properties, exp, fvalid)
+            sel = jnp.where(hit_l, me, jnp.uint32(D))
+            min_shard = lax.pmin(sel, axis)
+            pick = hit_l & (me == min_shard)
+            g_hi = lax.psum(jnp.where(pick, cand_hi, jnp.uint32(0)), axis)
+            g_lo = lax.psum(jnp.where(pick, cand_lo, jnp.uint32(0)), axis)
+            g_hit = min_shard < D
+            keep = disc_hit | ~g_hit
+            disc_hi = jnp.where(keep, disc_hi, g_hi)
+            disc_lo = jnp.where(keep, disc_lo, g_lo)
+            disc_hit = disc_hit | g_hit
+
+        gen = c.gen + lax.psum(exp.cvalid.sum(dtype=jnp.int32), axis)
+        ovf = c.ovf | (lax.psum(t_ovf.astype(jnp.int32), axis) > 0)
+        xovf = c.xovf | (lax.psum(exp.xovf.astype(jnp.int32), axis) > 0)
+        steps = c.steps - 1
+        go = go_flag(q_size, log_n, disc_hit, gen, ovf, xovf, steps,
+                     target_remaining, grow_limit)
+        nc = ShardedCarry(
+            q_rows=q_rows, q_eb=q_eb,
+            q_head=q_head[None], q_size=q_size[None],
             key_hi=key_hi, key_lo=key_lo,
-            next_frontier=bufs[0], next_ebits=bufs[5],
-            next_valid=next_valid,
-            child_hi=bufs[1], child_lo=bufs[2],
-            parent_hi=bufs[3], parent_lo=bufs[4],
-            pbits=pbits, frontier_hi=phi, frontier_lo=plo,
-            ebits_cleared=ebits, terminal=terminal,
-            gen_count=gen_count, next_count=next_count,
-            overflow=overflow)
+            log_chi=log_chi, log_clo=log_clo,
+            log_phi=log_phi, log_plo=log_plo, log_n=log_n[None],
+            disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
+            gen=gen, ovf=ovf, xovf=xovf, steps=steps, go=go)
+        return (nc, target_remaining, grow_limit)
 
-    sharded = P(axis)
-    replicated = P()
-    out_specs = ShardedLevelOutputs(
-        key_hi=sharded, key_lo=sharded,
-        next_frontier=sharded, next_ebits=sharded, next_valid=sharded,
-        child_hi=sharded, child_lo=sharded,
-        parent_hi=sharded, parent_lo=sharded,
-        pbits=sharded, frontier_hi=sharded, frontier_lo=sharded,
-        ebits_cleared=sharded, terminal=sharded,
-        gen_count=replicated, next_count=replicated, overflow=replicated)
+    def local_chunk(carry, target_remaining, grow_limit):
+        go = go_flag(carry.q_size[0], carry.log_n[0], carry.disc_hit,
+                     carry.gen, carry.ovf, carry.xovf, carry.steps,
+                     target_remaining, grow_limit)
+        out, _, _ = lax.while_loop(
+            lambda s: s[0].go, body,
+            (carry._replace(go=go), target_remaining, grow_limit))
+        return out
+
+    specs = carry_specs(axis)
     fn = jax.shard_map(
-        level_local, mesh=mesh,
-        in_specs=(sharded, sharded, sharded, sharded, sharded),
-        out_specs=out_specs,
+        local_chunk, mesh=mesh,
+        in_specs=(specs, P(), P()), out_specs=specs,
         # the hash kernel's scan carry starts axis-invariant and becomes
         # varying; skip the varying-manual-axes check rather than thread
         # pcasts through shared kernels
         check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def build_sharded_insert(mesh: Mesh, axis: str):
+    """Jitted SPMD bulk insert: each shard inserts its block of the global
+    fingerprint arrays into its local table slice."""
+    def local(key_hi, key_lo, fhi, flo, valid):
+        _, khi, klo, ovf = table_insert(key_hi, key_lo, fhi, flo, valid)
+        return khi, klo, lax.psum(ovf.astype(jnp.int32), axis) > 0
+
+    s = P(axis)
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(s, s, s, s, s),
+                       out_specs=(s, s, P()), check_vma=False)
     return jax.jit(fn)
+
+
+def owner_of(fp: int, d: int) -> int:
+    """The shard owning a 64-bit fingerprint (top log2(d) bits)."""
+    kbits = _owner_bits(d)
+    return (fp >> (64 - kbits)) if kbits else 0
+
+
+def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
+                       capacity: int, init_rows, init_fps, full_ebits,
+                       prop_count: int) -> ShardedCarry:
+    """Host-side construction of the initial sharded carry: init states
+    routed to their owner shards' queues. The caller inserts the init
+    fingerprints into the table via :func:`build_sharded_insert`."""
+    D = mesh.shape[axis]
+    qloc = qcap // D
+    width = model.packed_width
+    q_rows = np.zeros((qcap, width), dtype=np.uint32)
+    q_eb = np.zeros((qcap,), dtype=np.uint32)
+    q_size = np.zeros((D,), dtype=np.int32)
+    for row, fp in zip(init_rows, init_fps):
+        s = owner_of(fp, D)
+        assert q_size[s] < qloc, "init states overflow a shard queue"
+        q_rows[s * qloc + q_size[s]] = row
+        q_eb[s * qloc + q_size[s]] = full_ebits
+        q_size[s] += 1
+
+    sh = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    def put(x, sharding):
+        return jax.device_put(x, sharding)
+
+    return ShardedCarry(
+        q_rows=put(q_rows, sh), q_eb=put(q_eb, sh),
+        q_head=put(np.zeros((D,), np.int32), sh),
+        q_size=put(q_size, sh),
+        key_hi=put(np.zeros((capacity,), np.uint32), sh),
+        key_lo=put(np.zeros((capacity,), np.uint32), sh),
+        log_chi=put(np.zeros((capacity,), np.uint32), sh),
+        log_clo=put(np.zeros((capacity,), np.uint32), sh),
+        log_phi=put(np.zeros((capacity,), np.uint32), sh),
+        log_plo=put(np.zeros((capacity,), np.uint32), sh),
+        log_n=put(np.zeros((D,), np.int32), sh),
+        disc_hit=put(np.zeros((prop_count,), bool), rep),
+        disc_hi=put(np.zeros((prop_count,), np.uint32), rep),
+        disc_lo=put(np.zeros((prop_count,), np.uint32), rep),
+        gen=put(np.int32(0), rep), ovf=put(np.bool_(False), rep),
+        xovf=put(np.bool_(False), rep),
+        steps=put(np.int32(0), rep), go=put(np.bool_(False), rep))

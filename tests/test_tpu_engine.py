@@ -241,3 +241,39 @@ def test_tpu_device_mode_grows_from_tiny_capacity():
                .spawn_tpu().join())
     host = TwoPhaseSys(4).checker().spawn_bfs().join()
     assert checker.unique_state_count() == host.unique_state_count()
+
+
+# --- model capacity overflow is fatal, never silent ------------------------
+
+class _OverflowingEquation(PackedLinearEquation):
+    """Reports encoding overflow once x exceeds a threshold — exercises the
+    optional third packed_step output (models/packed.py docstring)."""
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+        succ, valid = super().packed_step(words)
+        overflow = valid & (succ[:, 0] > 5)
+        return succ, valid & ~overflow, overflow
+
+
+class TestModelOverflowFatal:
+    def test_level_mode_raises(self):
+        model = _OverflowingEquation(2, 0, 10**9)  # unsatisfiable: must walk
+        with pytest.raises(RuntimeError, match="capacity overflow"):
+            (model.checker().tpu_options(capacity=1 << 12, mode="level")
+             .spawn_tpu().join())
+
+    def test_device_mode_raises(self):
+        model = _OverflowingEquation(2, 0, 10**9)
+        with pytest.raises(RuntimeError, match="capacity overflow"):
+            (model.checker().tpu_options(capacity=1 << 12, mode="device")
+             .spawn_tpu().join())
+
+    def test_paxos_starved_net_capacity_raises(self):
+        # the real scenario from actor/packed.py: more distinct in-flight
+        # envelopes than network slots must abort, not under-explore
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+        model = PackedPaxos(client_count=1, net_capacity=2)
+        with pytest.raises(RuntimeError, match="capacity overflow"):
+            (model.checker().tpu_options(capacity=1 << 14)
+             .spawn_tpu().join())
