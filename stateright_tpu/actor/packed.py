@@ -161,14 +161,6 @@ class PackedActorModel(ActorModel, PackedModel):
         timer = 0
         for i, set_ in enumerate(state.is_timer_set):
             timer |= int(bool(set_)) << i
-        # the device step enumerates Deliver actions only: a set timer (or a
-        # lossy network, checked in packed_step) would mean Timeout/Drop
-        # transitions the host model explores but the device silently
-        # wouldn't — refuse rather than under-explore
-        assert timer == 0, (
-            "PackedActorModel does not support timers on the device engine "
-            "(Timeout actions are not in the packed action axis); use the "
-            "host engines for timer-driven actors")
         out[self._timer_off] = timer
         if self.history_width:
             hwords = self.encode_history(state.history)
@@ -249,6 +241,18 @@ class PackedActorModel(ActorModel, PackedModel):
             jnp.where(do_write, updated, slots[target]))
         overflowed = valid & ~has_match & ~has_empty
         return slots, overflowed
+
+    def validate_device_state(self, state: ActorModelState) -> None:
+        """Refuse configurations whose transitions the packed action axis
+        cannot express (the device would silently under-explore what the
+        host model checks exhaustively). Called by ``spawn_tpu`` on every
+        init state; the device itself can never *create* a set timer since
+        ``packed_deliver`` has no timer interface."""
+        if any(state.is_timer_set):
+            raise NotImplementedError(
+                "PackedActorModel does not support timers on the device "
+                "engine (Timeout actions are not in the packed action "
+                "axis); use the host engines for timer-driven actors")
 
     def packed_step(self, words):
         import jax

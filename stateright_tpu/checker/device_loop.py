@@ -5,8 +5,12 @@ level — fatal when the device is remote (tunneled TPU) and wasteful even
 locally. This module compiles the *entire search loop* into one XLA
 computation: a ``lax.while_loop`` whose carry holds
 
-  * a FIFO **ring queue** of pending packed states (the device analog of the
-    reference's shared ``pending`` deques, `/root/reference/src/checker/bfs.rs:29-30`),
+  * an **append-only FIFO queue** of pending packed states (the device
+    analog of the reference's shared ``pending`` deques,
+    `/root/reference/src/checker/bfs.rs:29-30`). Every state is enqueued
+    exactly once (it enters the queue iff it won its visited-table slot),
+    so the queue never wraps: the head only advances, and appends are
+    contiguous block writes at the tail;
   * the open-addressed visited table (`ops/hashtable.py`),
   * an append-only **log** of (child fp, parent fp) pairs — the complete
     search record from which the host lazily mirrors its
@@ -21,6 +25,27 @@ evaluation, action expansion, fingerprinting, dedup-insert, enqueue. The
 host re-enters the loop only every ``steps`` iterations (one dispatch per
 chunk) to read a handful of scalars — progress, discoveries, growth/exit
 conditions.
+
+TPU performance notes (these shaped the design — every lane of a
+data-dependent scatter/gather/probe costs real time on TPU, so the body
+minimizes both scatter *count* and operating *lane width*):
+
+  * the expansion produces ``fmax * max_actions`` child slots of which
+    only the valid fraction matters. Valid children are immediately
+    **shrunk to a narrow static buffer of ``kmax`` lanes** with a
+    gather-only compaction (binary search over the validity prefix-sum —
+    the inverse of the usual cumsum+scatter), and every downstream op
+    (table probe, insert, second compaction) runs at ``kmax`` lanes, not
+    ``fmax * max_actions``. If a batch produces more valid children than
+    ``kmax``, the iteration aborts *before any mutation* and the host
+    rebuilds with a doubled ``kmax`` — no work is lost.
+  * the body performs **no row scatters at all**: freshly inserted
+    children are compacted to a dense prefix (same gather trick) and both
+    the queue append and the log append are contiguous
+    ``dynamic_update_slice`` block writes at the tail. The garbage rows
+    past ``count`` inside an appended block are never observed: the tail
+    advances only by ``count``, and the next block write starts there,
+    overwriting them.
 
 Queue order is FIFO, so expansion stays level-ordered (BFS) and discovered
 witness paths stay shortest, like ``spawn_bfs``.
@@ -39,10 +64,10 @@ from ..ops.hashtable import table_insert
 
 
 class ChunkCarry(NamedTuple):
-    q_rows: jax.Array   # uint32[qcap, W] ring queue of pending states
+    q_rows: jax.Array   # uint32[qcap, W] append-only queue of pending states
     q_eb: jax.Array     # uint32[qcap]    their eventually-bits
-    q_head: jax.Array   # int32[]         ring head index
-    q_size: jax.Array   # int32[]         pending count
+    q_head: jax.Array   # int32[]         next row to expand
+    q_tail: jax.Array   # int32[]         next free row (q_size = tail-head)
     key_hi: jax.Array   # uint32[cap]     visited table
     key_lo: jax.Array   # uint32[cap]
     log_chi: jax.Array  # uint32[logcap]  child fp (insertion order)
@@ -57,68 +82,98 @@ class ChunkCarry(NamedTuple):
     ovf: jax.Array      # bool[]   table probe overflow (should not happen
     #                              below the growth limit)
     xovf: jax.Array     # bool[]   model capacity overflow (fatal)
+    kovf: jax.Array     # bool[]   kmax candidate-buffer overflow (host
+    #                              rebuilds with doubled kmax; no data loss)
     steps: jax.Array    # int32[]  remaining step budget for this chunk
 
 
-def build_chunk_fn(model, qcap: int, capacity: int, fmax: int):
+def shrink_indices(mask, k: int):
+    """Gather-only compaction plan: ``src[j]`` is the index of the
+    ``j+1``-th set bit of ``mask`` (arbitrary clamped value for ``j >=
+    count``), found by binary search over the running count. Output has
+    ``k`` lanes — keep ``k`` small; the searches are the cheap side of the
+    cumsum/scatter dual."""
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    src = jnp.searchsorted(csum, jnp.arange(1, k + 1, dtype=jnp.int32),
+                           side="left")
+    return jnp.minimum(src, mask.shape[0] - 1)
+
+
+_CHUNK_CACHE: dict = {}
+_CACHE_LIMIT = 64
+
+
+def model_cache_key(model):
+    """Composite memoization key: the model's declared config key plus
+    everything else that changes the traced program — the concrete class
+    (subclasses override packed_step) and mutable flags like
+    ``lossy_network_``. None disables caching."""
+    mkey = model.cache_key()
+    if mkey is None:
+        return None
+    return (type(model), mkey, getattr(model, "lossy_network_", None))
+
+
+def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit) ->
     carry`` where ``target_remaining`` bounds ``gen`` (INT32_MAX when
     unbounded) and ``grow_limit`` is the log length at which the loop exits
-    so the host can grow the table.
+    so the host can grow the table. ``kmax`` bounds valid children per
+    iteration; exceeding it sets ``kovf`` and leaves the carry untouched.
+
+    Memoized on :func:`model_cache_key`: checker runs re-use the jitted
+    (and already-compiled) chunk across instances of the same model config.
     """
-    assert qcap & (qcap - 1) == 0, "qcap must be a power of two"
+    mkey = model_cache_key(model)
+    if mkey is not None:
+        cached = _CHUNK_CACHE.get((mkey, qcap, capacity, fmax, kmax))
+        if cached is not None:
+            return cached
+    fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax)
+    if mkey is not None:
+        if len(_CHUNK_CACHE) >= _CACHE_LIMIT:
+            _CHUNK_CACHE.clear()
+        _CHUNK_CACHE[(mkey, qcap, capacity, fmax, kmax)] = fn
+    return fn
+
+
+def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
     n_actions = model.max_actions
     properties = model.properties()
     prop_count = len(properties)
     eventually_idx = eventually_indices(properties)
-    logcap = capacity
-    qmask = qcap - 1
     fa = fmax * n_actions
+    kmax = min(kmax, fa)
 
     def cond(state):
         c, target_remaining, grow_limit = state
-        go = (c.q_size > 0) & (c.steps > 0) & ~c.ovf & ~c.xovf \
+        go = (c.q_tail > c.q_head) & (c.steps > 0) \
+            & ~c.ovf & ~c.xovf & ~c.kovf \
             & (c.gen < target_remaining) \
             & (c.log_n < grow_limit) \
-            & (c.q_size <= qcap - fa)
+            & (c.q_tail <= qcap - kmax)
         if prop_count:
             go = go & ~c.disc_hit.all()
         return go
 
     def body(state):
         c, target_remaining, grow_limit = state
-        idxs = (c.q_head + jnp.arange(fmax, dtype=jnp.int32)) & qmask
-        frontier = c.q_rows[idxs]
-        ebits = c.q_eb[idxs]
-        take = jnp.minimum(c.q_size, fmax)
+        frontier = jax.lax.dynamic_slice(
+            c.q_rows, (c.q_head, 0), (fmax, c.q_rows.shape[1]))
+        ebits = jax.lax.dynamic_slice(c.q_eb, (c.q_head,), (fmax,))
+        take = jnp.minimum(c.q_tail - c.q_head, fmax)
         fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
 
         # the shared check_block analog (ops/expand.py)
         exp = expand_frontier(model, frontier, fvalid, ebits,
                               eventually_idx)
-        inserted, key_hi, key_lo, t_ovf = table_insert(
-            c.key_hi, c.key_lo, exp.chi, exp.clo, exp.cvalid)
-        cnt = inserted.sum(dtype=jnp.int32)
-        pos = jnp.cumsum(inserted.astype(jnp.int32)) - 1
+        vcount = exp.cvalid.sum(dtype=jnp.int32)
+        kovf = vcount > kmax
 
-        # enqueue fresh children (ring append)
-        qidx = jnp.where(inserted, (c.q_head + c.q_size + pos) & qmask, qcap)
-        q_rows = c.q_rows.at[qidx].set(exp.flat, mode="drop")
-        ceb = jnp.repeat(exp.ebits, n_actions)
-        q_eb = c.q_eb.at[qidx].set(ceb, mode="drop")
-
-        # log (child, parent) fingerprints in insertion order
-        lidx = jnp.where(inserted, c.log_n + pos, logcap)
-        par_hi = jnp.repeat(exp.phi, n_actions)
-        par_lo = jnp.repeat(exp.plo, n_actions)
-        log_chi = c.log_chi.at[lidx].set(exp.chi, mode="drop")
-        log_clo = c.log_clo.at[lidx].set(exp.clo, mode="drop")
-        log_phi = c.log_phi.at[lidx].set(par_hi, mode="drop")
-        log_plo = c.log_plo.at[lidx].set(par_lo, mode="drop")
-
-        # sticky discovery registers
+        # sticky discovery registers (idempotent: safe even if the kovf
+        # branch re-expands this frontier after a kmax rebuild)
         disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
         if prop_count:
             new_hit, cand_hi, cand_lo = discovery_candidates(
@@ -128,19 +183,61 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int):
             disc_lo = jnp.where(keep, disc_lo, cand_lo)
             disc_hit = disc_hit | new_hit
 
-        nc = ChunkCarry(
-            q_rows=q_rows, q_eb=q_eb,
-            q_head=(c.q_head + take) & qmask,
-            q_size=c.q_size - take + cnt,
-            key_hi=key_hi, key_lo=key_lo,
-            log_chi=log_chi, log_clo=log_clo,
-            log_phi=log_phi, log_plo=log_plo,
-            log_n=c.log_n + cnt,
-            disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
-            gen=c.gen + exp.cvalid.sum(dtype=jnp.int32),
-            ovf=c.ovf | t_ovf,
-            xovf=c.xovf | exp.xovf,
-            steps=c.steps - 1)
+        def commit(c):
+            # shrink the valid children to kmax lanes (gathers only); all
+            # downstream ops run at kmax lanes
+            src = shrink_indices(exp.cvalid, kmax)
+            kvalid = jnp.arange(kmax, dtype=jnp.int32) < vcount
+            k_flat = exp.flat[src]
+            k_chi = exp.chi[src]
+            k_clo = exp.clo[src]
+            row = src // n_actions  # parent frontier row of each child
+            k_phi = exp.phi[row]
+            k_plo = exp.plo[row]
+            k_ceb = exp.ebits[row]
+
+            inserted, key_hi, key_lo, t_ovf = table_insert(
+                c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
+            cnt = inserted.sum(dtype=jnp.int32)
+
+            # compact the fresh rows and block-append to queue + log
+            src2 = shrink_indices(inserted, kmax)
+            n_flat = k_flat[src2]
+            n_eb = k_ceb[src2]
+            n_chi = k_chi[src2]
+            n_clo = k_clo[src2]
+            n_phi = k_phi[src2]
+            n_plo = k_plo[src2]
+            q_rows = jax.lax.dynamic_update_slice(c.q_rows, n_flat,
+                                                  (c.q_tail, 0))
+            q_eb = jax.lax.dynamic_update_slice(c.q_eb, n_eb, (c.q_tail,))
+            log_chi = jax.lax.dynamic_update_slice(c.log_chi, n_chi,
+                                                   (c.log_n,))
+            log_clo = jax.lax.dynamic_update_slice(c.log_clo, n_clo,
+                                                   (c.log_n,))
+            log_phi = jax.lax.dynamic_update_slice(c.log_phi, n_phi,
+                                                   (c.log_n,))
+            log_plo = jax.lax.dynamic_update_slice(c.log_plo, n_plo,
+                                                   (c.log_n,))
+            return c._replace(
+                q_rows=q_rows, q_eb=q_eb,
+                q_head=c.q_head + take,
+                q_tail=c.q_tail + cnt,
+                key_hi=key_hi, key_lo=key_lo,
+                log_chi=log_chi, log_clo=log_clo,
+                log_phi=log_phi, log_plo=log_plo,
+                log_n=c.log_n + cnt,
+                gen=c.gen + vcount,
+                ovf=c.ovf | t_ovf,
+                xovf=c.xovf | exp.xovf)
+
+        # kovf: abort BEFORE any mutation; the host doubles kmax and the
+        # rebuilt chunk re-expands the same frontier
+        nc = jax.lax.cond(kovf, lambda c: c, commit, c)
+        nc = nc._replace(disc_hit=disc_hit, disc_hi=disc_hi,
+                         disc_lo=disc_lo, kovf=c.kovf | kovf,
+                         xovf=nc.xovf | exp.xovf,
+                         steps=c.steps - 1)
         return (nc, target_remaining, grow_limit)
 
     def chunk(carry: ChunkCarry, target_remaining, grow_limit):
@@ -159,15 +256,19 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
 
     width = model.packed_width
     prop_count = len(model.properties())
-    q_rows = np.zeros((qcap, width), dtype=np.uint32)
-    q_eb = np.zeros((qcap,), dtype=np.uint32)
-    for i, row in enumerate(init_rows):
-        q_rows[i] = row
-        q_eb[i] = full_ebits
+    # allocate the big buffers ON DEVICE and transfer only the init rows:
+    # a host-zeros queue would ship qcap*width*4 bytes over the (possibly
+    # tunneled) host link for nothing
+    k = len(init_rows)
+    q_rows = jnp.zeros((qcap, width), jnp.uint32)
+    q_eb = jnp.zeros((qcap,), jnp.uint32)
+    if k:
+        q_rows = q_rows.at[:k].set(jnp.asarray(np.stack(init_rows)))
+        q_eb = q_eb.at[:k].set(jnp.full((k,), full_ebits, jnp.uint32))
     logcap = capacity
     return ChunkCarry(
-        q_rows=jnp.asarray(q_rows), q_eb=jnp.asarray(q_eb),
-        q_head=jnp.int32(0), q_size=jnp.int32(len(init_rows)),
+        q_rows=q_rows, q_eb=q_eb,
+        q_head=jnp.int32(0), q_tail=jnp.int32(k),
         key_hi=jnp.zeros((capacity,), jnp.uint32),
         key_lo=jnp.zeros((capacity,), jnp.uint32),
         log_chi=jnp.zeros((logcap,), jnp.uint32),
@@ -179,4 +280,4 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
         disc_hi=jnp.zeros((prop_count,), jnp.uint32),
         disc_lo=jnp.zeros((prop_count,), jnp.uint32),
         gen=jnp.int32(0), ovf=jnp.bool_(False), xovf=jnp.bool_(False),
-        steps=jnp.int32(steps))
+        kovf=jnp.bool_(False), steps=jnp.int32(steps))

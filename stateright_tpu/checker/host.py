@@ -35,6 +35,10 @@ class HostChecker(Checker):
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
 
+    def generated_fingerprints(self):
+        """All visited fingerprints (the dedup record)."""
+        return set(self._generated)
+
     # --- execution -------------------------------------------------------
     def _run(self) -> None:  # pragma: no cover - overridden
         raise NotImplementedError

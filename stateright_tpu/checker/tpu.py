@@ -45,7 +45,9 @@ are not treated as terminal.
 
 from __future__ import annotations
 
+import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -94,6 +96,22 @@ def _compact(mask, *columns):
     return (mask.sum(dtype=jnp.int32),) + out
 
 
+_LEVEL_CACHE: dict = {}
+_INSERT_JIT = None
+
+
+def _insert_jit():
+    """Process-wide jitted ``table_insert`` (shapes retrace within one
+    wrapper; a fresh ``jax.jit`` per run would recompile every time)."""
+    global _INSERT_JIT
+    if _INSERT_JIT is None:
+        import jax
+
+        from ..ops.hashtable import table_insert
+        _INSERT_JIT = jax.jit(table_insert)
+    return _INSERT_JIT
+
+
 def build_level_fn(model):
     """Build the jitted single-chip BFS level step for a packed model.
 
@@ -102,8 +120,24 @@ def build_level_fn(model):
     (`ops/expand.py`) plus visited-set insert and child compaction. Outputs
     are device-resident; everything the host must inspect is either a
     scalar or a compacted array whose prefix length is one of those
-    scalars.
+    scalars. Memoized on ``model.cache_key()``.
     """
+    from .device_loop import model_cache_key
+
+    mkey = model_cache_key(model)
+    if mkey is not None:
+        cached = _LEVEL_CACHE.get(mkey)
+        if cached is not None:
+            return cached
+    fn = _build_level_fn(model)
+    if mkey is not None:
+        if len(_LEVEL_CACHE) >= 64:
+            _LEVEL_CACHE.clear()
+        _LEVEL_CACHE[mkey] = fn
+    return fn
+
+
+def _build_level_fn(model):
     import jax
     import jax.numpy as jnp
 
@@ -139,6 +173,58 @@ def build_level_fn(model):
     return jax.jit(level_fn)
 
 
+_LEVEL_HELPERS = None
+
+
+def _level_helpers():
+    """Process-wide jitted helpers for the per-level engine (shapes retrace
+    within each wrapper)."""
+    global _LEVEL_HELPERS
+    if _LEVEL_HELPERS is None:
+        import jax
+        import jax.numpy as jnp
+
+        def slice_fn(rows, ebs, start, size):
+            # clipped gather: out-of-range rows are garbage but always land
+            # in the fvalid-masked tail, so no state is shifted or dropped
+            idx = jnp.minimum(start + jnp.arange(size),
+                              rows.shape[0] - 1)
+            return rows[idx], ebs[idx]
+
+        def take_fn(chi, clo, phi, plo, size):
+            return chi[:size], clo[:size], phi[:size], plo[:size]
+
+        def take_rows_fn(rows, size):
+            return rows[:size]
+
+        _LEVEL_HELPERS = (jax.jit(slice_fn, static_argnums=(3,)),
+                          jax.jit(take_fn, static_argnums=(4,)),
+                          jax.jit(take_rows_fn, static_argnums=(1,)))
+    return _LEVEL_HELPERS
+
+
+def _enable_compile_cache() -> None:
+    """Point JAX's persistent compilation cache somewhere sane (unless the
+    user already configured one). Engine shapes recur across processes —
+    without this every checker run repays ~10-30s of XLA compiles."""
+    import os
+
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        return
+    path = os.environ.get(
+        "STATERIGHT_TPU_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "stateright_tpu",
+                     "xla"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except OSError:
+        pass  # unwritable cache dir: compile uncached
+
+
 class TpuChecker(HostChecker):
     """Level-synchronous device BFS over a packed model."""
 
@@ -172,6 +258,11 @@ class TpuChecker(HostChecker):
                     "supported on the TPU engine; evaluate them with the "
                     "host engines")
         self._host_prop_cache: Dict[bytes, List[bool]] = {}
+        # wall-time per engine phase (seconds), for report()/bench tuning
+        self._prof: Dict[str, float] = {}
+        # device-resident search record, pulled lazily by _ensure_mirror
+        self._mirror_carry = None
+        _enable_compile_cache()
         # fingerprint -> parent fingerprint mirror (host side; the
         # checkpointable search record, also used for path reconstruction).
         self._generated: Dict[int, Optional[int]] = {}
@@ -180,6 +271,20 @@ class TpuChecker(HostChecker):
                 "symmetry reduction on the TPU engine requires a packed "
                 "canonicalization; use spawn_dfs() for symmetry or provide "
                 "packed_representative (planned).")
+
+    @contextmanager
+    def _timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._prof[name] = (self._prof.get(name, 0.0)
+                                + time.perf_counter() - t0)
+
+    def profile(self) -> Dict[str, float]:
+        """Wall-time spent per engine phase (seconds): seeding, chunk
+        dispatch+sync, growth, host mirror finalization."""
+        return dict(self._prof)
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
@@ -218,8 +323,11 @@ class TpuChecker(HostChecker):
         init_states = [s for s in model.init_states()
                        if model.within_boundary(s)]
         self._state_count = len(init_states)
+        validate = getattr(model, "validate_device_state", None)
         init_rows: List[np.ndarray] = []
         for s in init_states:
+            if validate is not None:
+                validate(s)
             fp = model.fingerprint(s)
             if fp not in self._generated:
                 self._generated[fp] = None
@@ -254,8 +362,10 @@ class TpuChecker(HostChecker):
         target = self._target_state_count
         opts = self._tpu_options
         fmax = int(opts.get("fmax", min(self._max_segment, 1 << 13)))
+        fa = fmax * model.max_actions
+        kmax = min(int(opts.get("kmax", max(1 << 12, fa // 2))), fa)
         k_steps = int(opts.get("chunk_steps", 64))
-        insert_fn = jax.jit(table_insert)
+        insert_fn = _insert_jit()
 
         # --- seed -------------------------------------------------------
         init_rows = self._seed_inits()
@@ -266,22 +376,25 @@ class TpuChecker(HostChecker):
             # (bfs.rs:121-128)
             return
 
-        # one while_loop iteration can insert up to fmax*max_actions new
-        # states; capacity must leave that headroom below the growth exit
-        headroom = fmax * model.max_actions
+        # one while_loop iteration inserts at most kmax new states (and at
+        # most fa once kmax has grown to its bound); capacity must keep
+        # that headroom below the growth exit
+        headroom = fa
         while self._grow_at * self._capacity <= headroom + n_init:
             self._capacity *= 4
 
-        qcap = int(opts.get("qcap", self._capacity))
-        assert qcap & (qcap - 1) == 0, "qcap must be a power of two"
-        while qcap < max(len(init_rows), 2 * headroom):
-            qcap *= 2
-        carry = seed_carry(model, qcap, self._capacity, init_rows,
-                           full_ebits)
-        key_hi, key_lo = self._bulk_insert(
-            insert_fn, carry.key_hi, carry.key_lo, list(generated.keys()))
-        carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
-        chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax)
+        # append-only queue: must hold every state enqueued before the next
+        # growth point (n_init + grow_limit) plus one iteration of appends
+        qcap = self._device_qcap(n_init, headroom)
+        with self._timed("seed"):
+            carry = seed_carry(model, qcap, self._capacity, init_rows,
+                               full_ebits)
+            key_hi, key_lo = self._bulk_insert(
+                insert_fn, carry.key_hi, carry.key_lo,
+                list(generated.keys()))
+            carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
+            jax.block_until_ready(carry)
+        chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax, kmax)
 
         # --- chunk loop -------------------------------------------------
         while True:
@@ -293,11 +406,15 @@ class TpuChecker(HostChecker):
                 if target is not None else 2**31 - 1)
             carry = carry._replace(gen=jnp.int32(0),
                                    steps=jnp.int32(k_steps))
-            carry = chunk_fn(carry, remaining, grow_limit)
-            (q_size, log_n, disc_hit, disc_hi, disc_lo, gen, ovf, xovf) = \
-                jax.device_get((carry.q_size, carry.log_n, carry.disc_hit,
-                                carry.disc_hi, carry.disc_lo, carry.gen,
-                                carry.ovf, carry.xovf))
+            with self._timed("chunk"):
+                carry = chunk_fn(carry, remaining, grow_limit)
+                (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo, gen,
+                 ovf, xovf, kovf) = jax.device_get(
+                    (carry.q_head, carry.q_tail, carry.log_n,
+                     carry.disc_hit, carry.disc_hi, carry.disc_lo,
+                     carry.gen, carry.ovf, carry.xovf, carry.kovf))
+            q_size = int(q_tail) - int(q_head)
+            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
             self._state_count += int(gen)
             self._unique_state_count = n_init + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
@@ -313,26 +430,50 @@ class TpuChecker(HostChecker):
                     "device hash table probe overflow below the growth "
                     f"limit (capacity {self._capacity}); raise via "
                     "checker_builder.tpu_options(capacity=...)")
-            done = (int(q_size) == 0
+            if bool(kovf):
+                # a batch produced more valid children than the candidate
+                # buffer; nothing was committed — double kmax and resume
+                kmax = min(kmax * 2, fa)
+                chunk_fn = build_chunk_fn(model, qcap, self._capacity,
+                                          fmax, kmax)
+                carry = carry._replace(kovf=jnp.bool_(False))
+                continue
+            done = (q_size == 0
                     or len(discoveries) == prop_count
                     or (target is not None
                         and self._state_count >= target))
             if done:
                 break
             need_grow = (int(log_n) >= int(grow_limit)
-                         or int(q_size) > qcap - fmax * model.max_actions)
+                         or int(q_tail) > qcap - headroom)
             if need_grow:
-                carry, qcap = self._grow_device(carry, qcap, insert_fn)
-                chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax)
+                with self._timed("grow"):
+                    carry, qcap = self._grow_device(carry, qcap, n_init,
+                                                    headroom, insert_fn)
+                chunk_fn = build_chunk_fn(model, qcap, self._capacity,
+                                          fmax, kmax)
 
-        self._finalize_mirror(carry)
+        # the mirror (fp -> parent fp) stays device-resident until someone
+        # needs it (path reconstruction, checkpointing): the log pull is
+        # pure host-link cost, pointless for count-only runs
+        self._mirror_carry = carry
         self._discovery_fps.update(discoveries)
 
+    def _device_qcap(self, n_init: int, headroom: int) -> int:
+        """Queue rows needed between growths: every enqueued state is
+        unique, so the tail never exceeds n_init + grow_limit + one
+        iteration's appends."""
+        grow_limit = int(min(self._grow_at * self._capacity,
+                             self._capacity - headroom))
+        return n_init + grow_limit + 2 * headroom
+
     # ------------------------------------------------------------------
-    def _grow_device(self, carry, qcap: int, insert_fn):
-        """Quadruple table+log capacity (and queue when pressed), re-insert
-        all known fingerprints from the device-resident log, and rebuild the
-        carry. No host round trip for the fingerprints themselves."""
+    def _grow_device(self, carry, qcap: int, n_init: int, headroom: int,
+                     insert_fn):
+        """Quadruple table+log capacity, relocate the live queue region to
+        the front of a correspondingly larger queue, and re-insert all
+        known fingerprints from the device-resident log. No host round trip
+        for the fingerprints themselves."""
         import jax
         import jax.numpy as jnp
 
@@ -340,41 +481,39 @@ class TpuChecker(HostChecker):
 
         old_capacity = self._capacity
         self._capacity = old_capacity * 4
-        new_qcap = qcap
-        if int(jax.device_get(carry.q_size)) > qcap // 2:
-            new_qcap = qcap * 4
+        new_qcap = self._device_qcap(n_init, headroom)
 
-        def rebuild(q_rows, q_eb, q_head,
+        def rebuild(q_rows, q_eb, q_head, q_tail,
                     log_chi, log_clo, log_phi, log_plo, log_n):
-            # relocate the ring to head=0 in the (possibly larger) queue
-            idx = (q_head + jnp.arange(qcap, dtype=jnp.int32)) & (qcap - 1)
-            nq_rows = jnp.zeros((new_qcap, q_rows.shape[1]), jnp.uint32)
-            nq_rows = nq_rows.at[:qcap].set(q_rows[idx])
-            nq_eb = jnp.zeros((new_qcap,), jnp.uint32)
-            nq_eb = nq_eb.at[:qcap].set(q_eb[idx])
+            # relocate [head, tail) to the front of the larger queue; rows
+            # past the live region are never observed
+            live = jnp.arange(new_qcap, dtype=jnp.int32)
+            src = jnp.minimum(q_head + live, qcap - 1)
+            nq_rows = q_rows[src]
+            nq_eb = q_eb[src]
             # bigger log
             nl_chi = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_chi = nl_chi.at[:old_capacity].set(log_chi)
+            nl_chi = jax.lax.dynamic_update_slice(nl_chi, log_chi, (0,))
             nl_clo = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_clo = nl_clo.at[:old_capacity].set(log_clo)
+            nl_clo = jax.lax.dynamic_update_slice(nl_clo, log_clo, (0,))
             nl_phi = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_phi = nl_phi.at[:old_capacity].set(log_phi)
+            nl_phi = jax.lax.dynamic_update_slice(nl_phi, log_phi, (0,))
             nl_plo = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_plo = nl_plo.at[:old_capacity].set(log_plo)
+            nl_plo = jax.lax.dynamic_update_slice(nl_plo, log_plo, (0,))
             # fresh table; re-insert every logged fingerprint
             key_hi = jnp.zeros((self._capacity,), jnp.uint32)
             key_lo = jnp.zeros((self._capacity,), jnp.uint32)
             valid = jnp.arange(old_capacity, dtype=jnp.int32) < log_n
             _, key_hi, key_lo, ovf = table_insert_local(
                 key_hi, key_lo, log_chi, log_clo, valid)
-            return (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo,
-                    nl_phi, nl_plo, ovf)
+            return (nq_rows, nq_eb, q_tail - q_head, key_hi, key_lo,
+                    nl_chi, nl_clo, nl_phi, nl_plo, ovf)
 
         rebuild = jax.jit(rebuild)
-        (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo, nl_phi, nl_plo,
-         ovf) = rebuild(carry.q_rows, carry.q_eb, carry.q_head,
-                        carry.log_chi, carry.log_clo, carry.log_phi,
-                        carry.log_plo, carry.log_n)
+        (nq_rows, nq_eb, new_tail, key_hi, key_lo, nl_chi, nl_clo, nl_phi,
+         nl_plo, ovf) = rebuild(carry.q_rows, carry.q_eb, carry.q_head,
+                                carry.q_tail, carry.log_chi, carry.log_clo,
+                                carry.log_phi, carry.log_plo, carry.log_n)
         if bool(jax.device_get(ovf)):
             raise RuntimeError("overflow while re-inserting during growth")
         # init fingerprints are not in the log; re-insert from the host
@@ -384,31 +523,36 @@ class TpuChecker(HostChecker):
                                            init_fps)
         carry = carry._replace(
             q_rows=nq_rows, q_eb=nq_eb, q_head=jnp.int32(0),
+            q_tail=new_tail,
             key_hi=key_hi, key_lo=key_lo,
             log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
             log_plo=nl_plo)
         return carry, new_qcap
 
-    def _finalize_mirror(self, carry) -> None:
-        """Pull the (child fp, parent fp) log and complete the host mirror
-        used for path reconstruction and checkpointing."""
+    def _ensure_mirror(self) -> None:
+        """Pull the device-resident (child fp, parent fp) log — lazily, on
+        first use — to complete the host mirror used for path
+        reconstruction and checkpointing."""
+        carry = getattr(self, "_mirror_carry", None)
+        if carry is None:
+            return
+        self._mirror_carry = None
         import jax
 
-        log_n = int(jax.device_get(carry.log_n))
-        if not log_n:
-            return
-        # pull only the live prefix (pow2-padded slice jitted on device)
-        n = _bucket(log_n)
-
-        def prefix(chi, clo, phi, plo):
-            return chi[:n], clo[:n], phi[:n], plo[:n]
-
-        chi, clo, phi, plo = jax.device_get(jax.jit(prefix)(
-            carry.log_chi, carry.log_clo, carry.log_phi, carry.log_plo))
-        child = _combine64(chi[:log_n], clo[:log_n])
-        parent = _combine64(phi[:log_n], plo[:log_n])
-        self._generated.update(zip(child.tolist(), parent.tolist()))
-        self._unique_state_count = len(self._generated)
+        with self._timed("mirror_pull"):
+            log_n = int(jax.device_get(carry.log_n))
+            if not log_n:
+                return
+            # pull only the live prefix (pow2-padded slice jitted on device)
+            n = min(_bucket(log_n), carry.log_chi.shape[0])
+            _slice, take_fn, _rows = _level_helpers()
+            chi, clo, phi, plo = jax.device_get(take_fn(
+                carry.log_chi, carry.log_clo, carry.log_phi, carry.log_plo,
+                n))
+            child = _combine64(chi[:log_n], clo[:log_n])
+            parent = _combine64(phi[:log_n], plo[:log_n])
+            self._generated.update(zip(child.tolist(), parent.tolist()))
+            self._unique_state_count = len(self._generated)
 
     # ------------------------------------------------------------------
     def _run_levels(self) -> None:
@@ -431,26 +575,8 @@ class TpuChecker(HostChecker):
         visitor = self._visitor
 
         level_fn = build_level_fn(model)
-        insert_fn = jax.jit(table_insert)
-
-        def slice_fn(rows, ebs, start, size):
-            # clipped gather: out-of-range rows are garbage but always land
-            # in the fvalid-masked tail, so no state is shifted or dropped
-            idx = jnp.minimum(start + jnp.arange(size),
-                              rows.shape[0] - 1)
-            return rows[idx], ebs[idx]
-
-        slice_fn = jax.jit(slice_fn, static_argnums=(3,))
-
-        def take_fn(chi, clo, phi, plo, size):
-            return chi[:size], clo[:size], phi[:size], plo[:size]
-
-        take_fn = jax.jit(take_fn, static_argnums=(4,))
-
-        def take_rows_fn(rows, size):
-            return rows[:size]
-
-        take_rows_fn = jax.jit(take_rows_fn, static_argnums=(1,))
+        insert_fn = _insert_jit()
+        slice_fn, take_fn, take_rows_fn = _level_helpers()
 
         # --- init -------------------------------------------------------
         init_rows = self._seed_inits()
@@ -620,7 +746,13 @@ class TpuChecker(HostChecker):
                     "device hash table overflow during bulk insert")
         return key_hi, key_lo
 
+    def generated_fingerprints(self):
+        """All visited fingerprints (pulls the device log if pending)."""
+        self._ensure_mirror()
+        return set(self._generated.keys())
+
     def _reconstruct_path(self, fp: int) -> Path:
+        self._ensure_mirror()
         fingerprints: deque = deque()
         next_fp = fp
         while next_fp in self._generated:

@@ -144,6 +144,10 @@ class PackedPaxos(PackedActorModel):
         self.host_property_indices = (0,)  # linearizable
         self.finalize_layout()
 
+    def cache_key(self):
+        return ("paxos", self.client_count, self.server_count,
+                self.net_capacity)
+
     # ------------------------------------------------------------------
     # actor state packing
     # ------------------------------------------------------------------

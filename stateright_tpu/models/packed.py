@@ -39,6 +39,14 @@ class PackedModel(Model):
     #: static upper bound on actions per state
     max_actions: int
 
+    def cache_key(self):
+        """Hashable identity of this model's *compiled program* — two
+        models with the same key must trace identically (same config, same
+        packed layout). Lets the engines reuse jitted step functions across
+        checker runs (compilation dwarfs execution for small state spaces).
+        Return ``None`` (the default) to disable cross-run reuse."""
+        return None
+
     def encode(self, state: Any) -> np.ndarray:
         """Canonical ``uint32[packed_width]`` encoding of ``state``."""
         raise NotImplementedError
@@ -171,6 +179,9 @@ class PackedLinearEquation(PackedModel):
 
     packed_width = 2
     max_actions = 2
+
+    def cache_key(self):
+        return ("lineq", self.a, self.b, self.c)
 
     def __init__(self, a: int, b: int, c: int):
         self.a, self.b, self.c = a, b, c

@@ -44,6 +44,9 @@ class TwoPhaseSys(PackedModel):
         self.n = n
         self.max_actions = 2 + 5 * n
 
+    def cache_key(self):
+        return ("twopc", self.n)
+
     # ------------------------------------------------------------------
     # Host side (2pc.rs:43-121)
     # ------------------------------------------------------------------

@@ -210,7 +210,6 @@ class ShardedTpuChecker(TpuChecker):
         log_clo = np.zeros((self._capacity,), dtype=np.uint32)
         log_phi = np.zeros((self._capacity,), dtype=np.uint32)
         log_plo = np.zeros((self._capacity,), dtype=np.uint32)
-        fps_to_insert: List[int] = list(init_fps)
         for s in range(D):
             size = int(h.q_size[s])
             head = int(h.q_head[s])
@@ -226,8 +225,6 @@ class ShardedTpuChecker(TpuChecker):
             log_clo[dst] = h.log_clo[src]
             log_phi[dst] = h.log_phi[src]
             log_plo[dst] = h.log_plo[src]
-            fps_to_insert.extend(_combine64(
-                h.log_chi[src], h.log_clo[src]).tolist())
 
         sh = NamedSharding(mesh, P(axis))
         rep = NamedSharding(mesh, P())
@@ -235,16 +232,25 @@ class ShardedTpuChecker(TpuChecker):
             np.zeros((self._capacity,), np.uint32), sh)
         key_lo = jax.device_put(
             np.zeros((self._capacity,), np.uint32), sh)
+        # rebuild the table device-side: each shard's log slice holds
+        # exactly the fps it owns; only the init fps need host routing
+        from .sharded import build_sharded_rebuild
+        d_log_chi = jax.device_put(log_chi, sh)
+        d_log_clo = jax.device_put(log_clo, sh)
+        d_log_n = jax.device_put(h.log_n, sh)
+        key_hi, key_lo, r_ovf = build_sharded_rebuild(mesh, axis)(
+            key_hi, key_lo, d_log_chi, d_log_clo, d_log_n)
+        if bool(jax.device_get(r_ovf)):
+            raise RuntimeError("overflow while re-inserting during growth")
         key_hi, key_lo = self._sharded_bulk_insert(
-            insert_fn, key_hi, key_lo, fps_to_insert, D)
+            insert_fn, key_hi, key_lo, init_fps, D)
         new_carry = ShardedCarry(
             q_rows=jax.device_put(q_rows, sh),
             q_eb=jax.device_put(q_eb, sh),
             q_head=jax.device_put(np.zeros((D,), np.int32), sh),
             q_size=jax.device_put(h.q_size, sh),
             key_hi=key_hi, key_lo=key_lo,
-            log_chi=jax.device_put(log_chi, sh),
-            log_clo=jax.device_put(log_clo, sh),
+            log_chi=d_log_chi, log_clo=d_log_clo,
             log_phi=jax.device_put(log_phi, sh),
             log_plo=jax.device_put(log_plo, sh),
             log_n=jax.device_put(h.log_n, sh),

@@ -99,6 +99,9 @@ def carry_specs(axis: str) -> ShardedCarry:
         steps=r, go=r)
 
 
+_SHARDED_CACHE: dict = {}
+
+
 def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                            capacity: int, fmax: int):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
@@ -108,7 +111,28 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     ``chunk(carry, target_remaining, grow_limit) -> carry`` where
     ``grow_limit`` bounds any single shard's log length (the host grows all
     buffers when a shard approaches its slice capacity).
+
+    Memoized like the single-chip chunk (`checker/device_loop.py`).
     """
+    from ..checker.device_loop import model_cache_key
+
+    mkey = model_cache_key(model)
+    key = None
+    if mkey is not None:
+        key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax)
+        cached = _SHARDED_CACHE.get(key)
+        if cached is not None:
+            return cached
+    fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity, fmax)
+    if key is not None:
+        if len(_SHARDED_CACHE) >= 64:
+            _SHARDED_CACHE.clear()
+        _SHARDED_CACHE[key] = fn
+    return fn
+
+
+def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
+                            capacity: int, fmax: int):
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
     qloc = qcap // D
@@ -250,6 +274,11 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 def build_sharded_insert(mesh: Mesh, axis: str):
     """Jitted SPMD bulk insert: each shard inserts its block of the global
     fingerprint arrays into its local table slice."""
+    key = ("insert", mesh, axis)
+    cached = _SHARDED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
     def local(key_hi, key_lo, fhi, flo, valid):
         _, khi, klo, ovf = table_insert(key_hi, key_lo, fhi, flo, valid)
         return khi, klo, lax.psum(ovf.astype(jnp.int32), axis) > 0
@@ -258,7 +287,33 @@ def build_sharded_insert(mesh: Mesh, axis: str):
     fn = jax.shard_map(local, mesh=mesh,
                        in_specs=(s, s, s, s, s),
                        out_specs=(s, s, P()), check_vma=False)
-    return jax.jit(fn)
+    fn = jax.jit(fn)
+    _SHARDED_CACHE[key] = fn
+    return fn
+
+
+def build_sharded_rebuild(mesh: Mesh, axis: str):
+    """Jitted SPMD table rebuild from the per-shard logs: each shard's log
+    slice holds exactly the fingerprints it owns, so after growth the fresh
+    table is rebuilt entirely on device — no host routing round trip."""
+    key = ("rebuild", mesh, axis)
+    cached = _SHARDED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def local(key_hi, key_lo, log_chi, log_clo, log_n):
+        valid = jnp.arange(log_chi.shape[0], dtype=jnp.int32) < log_n[0]
+        _, khi, klo, ovf = table_insert(key_hi, key_lo, log_chi, log_clo,
+                                        valid)
+        return khi, klo, lax.psum(ovf.astype(jnp.int32), axis) > 0
+
+    s = P(axis)
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(s, s, s, s, s),
+                       out_specs=(s, s, P()), check_vma=False)
+    fn = jax.jit(fn)
+    _SHARDED_CACHE[key] = fn
+    return fn
 
 
 def owner_of(fp: int, d: int) -> int:

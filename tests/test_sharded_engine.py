@@ -42,8 +42,8 @@ class TestShardedTwoPhase:
         sharded = _sharded_checker(model, n_shards,
                                    capacity=1 << 12, fmax=64)
         assert sharded.unique_state_count() == 288
-        assert (set(sharded._generated.keys())
-                == set(host._generated.keys()))
+        assert (sharded.generated_fingerprints()
+                == host.generated_fingerprints())
         # same verdicts: no "consistent" counterexample, both agreement
         # examples found
         assert set(sharded.discoveries()) == set(host.discoveries())
@@ -66,8 +66,8 @@ class TestShardedGrowth:
         sharded = _sharded_checker(model, 2, capacity=1 << 12, fmax=32)
         assert sharded.unique_state_count() == 8832
         host = model.checker().spawn_bfs().join()
-        assert (set(sharded._generated.keys())
-                == set(host._generated.keys()))
+        assert (sharded.generated_fingerprints()
+                == host.generated_fingerprints())
 
 
 class TestShardedEarlyExit:
@@ -107,7 +107,7 @@ class TestShardedValidation:
         from stateright_tpu.parallel import owner_of
         model = TwoPhaseSys(3)
         host = model.checker().spawn_bfs().join()
-        owners = {owner_of(fp, 8) for fp in host._generated}
+        owners = {owner_of(fp, 8) for fp in host.generated_fingerprints()}
         assert len(owners) == 8
 
 

@@ -149,7 +149,7 @@ def test_tpu_matches_host_visited_set():
     tpu = (model.checker().tpu_options(capacity=1 << 10)
            .spawn_tpu().join())
     # Set equality of visited fingerprints (order is engine-specific).
-    assert set(tpu._generated.keys()) == set(host._generated.keys())
+    assert tpu.generated_fingerprints() == host.generated_fingerprints()
 
 
 def test_tpu_linear_equation_full_enumeration():
@@ -198,7 +198,7 @@ def test_tpu_level_mode_grows_mid_level():
                .spawn_tpu().join())
     host = TwoPhaseSys(4).checker().spawn_bfs().join()
     assert checker.unique_state_count() == host.unique_state_count()
-    assert set(checker._generated.keys()) == set(host._generated.keys())
+    assert checker.generated_fingerprints() == host.generated_fingerprints()
 
 
 def test_tpu_visitor_with_device_mode_rejected():
@@ -276,4 +276,15 @@ class TestModelOverflowFatal:
         model = PackedPaxos(client_count=1, net_capacity=2)
         with pytest.raises(RuntimeError, match="capacity overflow"):
             (model.checker().tpu_options(capacity=1 << 14)
+             .spawn_tpu().join())
+
+    def test_cache_not_shared_across_subclasses(self):
+        # jit memoization must key on the concrete class: running the plain
+        # model first must not leak its compiled step to the subclass
+        plain = PackedLinearEquation(2, 0, 10**9)
+        (plain.checker().tpu_options(capacity=1 << 12, mode="device")
+         .target_state_count(200).spawn_tpu().join())
+        over = _OverflowingEquation(2, 0, 10**9)
+        with pytest.raises(RuntimeError, match="capacity overflow"):
+            (over.checker().tpu_options(capacity=1 << 12, mode="device")
              .spawn_tpu().join())
