@@ -84,6 +84,9 @@ class PackedActorModel(ActorModel, PackedModel):
         self._hist_off = self._timer_off + 1
         self.packed_width = self._hist_off + self.history_width
         self.max_actions = self.net_capacity
+        if self.history_width:
+            # host properties (e.g. consistency testers) read the history
+            self.host_property_cols = (self._hist_off, self.history_width)
 
     # --- subclass interface ----------------------------------------------
     def encode_actor(self, index: int, state: Any) -> List[int]:
@@ -195,16 +198,17 @@ class PackedActorModel(ActorModel, PackedModel):
     # --- device step -------------------------------------------------------
     def _sort_slots(self, slots):
         """Canonical slot order: lexicographic over slot words with
-        empties last (stable multi-pass argsort)."""
+        empties last. One fused multi-key ``lax.sort`` — this runs once
+        per (state, action) lane inside the engine's hot loop, where a
+        multi-pass argsort was the single most expensive op."""
         import jax.numpy as jnp
-        idx = jnp.arange(self.net_capacity)
-        for w in reversed(range(self._sw)):
-            keys = slots[idx, w]
-            if w == 0:
-                keys = jnp.where(keys == 0, jnp.uint32(_EMPTY_SORT_KEY),
-                                 keys)
-            idx = idx[jnp.argsort(keys, stable=True)]
-        return slots[idx]
+        from jax import lax
+        hdr = slots[:, 0]
+        key0 = jnp.where(hdr == 0, jnp.uint32(_EMPTY_SORT_KEY), hdr)
+        keys = (key0,) + tuple(slots[:, w] for w in range(1, self._sw))
+        out = lax.sort(keys + (hdr,), num_keys=self._sw, is_stable=False)
+        # re-assemble: sorted payload columns + the original hdr column
+        return jnp.stack((out[-1],) + out[1:self._sw], axis=1)
 
     def _net_consume(self, slots, e):
         """Deliver slot ``e``: decrement its count, freeing it at zero."""

@@ -140,9 +140,12 @@ class Checker:
         err = self.error()
         if err is not None:
             raise err
+        elapsed = time.monotonic() - start
+        rate = (f", rate={self.state_count() / elapsed:.0f}/s"
+                if elapsed > 0.1 else "")
         w.write(f"Done. states={self.state_count()}, "
                 f"unique={self.unique_state_count()}, "
-                f"sec={int(time.monotonic() - start)}\n")
+                f"sec={int(elapsed)}{rate}\n")
         for name, path in self.discoveries().items():
             w.write(f'Discovered "{name}" '
                     f"{self.discovery_classification(name)} {path}")

@@ -144,8 +144,21 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
     properties = model.properties()
     prop_count = len(properties)
     eventually_idx = eventually_indices(properties)
+    # host-evaluated properties are discovered between chunks (post-hoc),
+    # never by the in-loop registers — their placeholder bits must not
+    # stop (or worse, stall) the device loop
+    host_idx = frozenset(getattr(model, "host_property_indices", ()))
+    device_prop_idx = [i for i in range(prop_count) if i not in host_idx]
     fa = fmax * n_actions
     kmax = min(kmax, fa)
+    # thin BFS levels (a few hundred pending states) are common at the
+    # start and tail of every search, and for narrow models they dominate
+    # the iteration count; paying the full fmax*max_actions lane width for
+    # them wastes most of the machine. The body therefore carries TWO
+    # compiled expansion sizes and picks per iteration by pending count.
+    fmax_small = min(256, fmax)
+    kmax_small = min(fmax_small * n_actions, kmax)
+    two_size = fmax_small < fmax
 
     def cond(state):
         c, target_remaining, grow_limit = state
@@ -154,91 +167,110 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
             & (c.gen < target_remaining) \
             & (c.log_n < grow_limit) \
             & (c.q_tail <= qcap - kmax)
-        if prop_count:
-            go = go & ~c.disc_hit.all()
+        if device_prop_idx and not host_idx:
+            # stop once every device-evaluated property has a discovery —
+            # but only when no host properties remain: those need the
+            # reached set to keep growing between post-hoc passes
+            go = go & ~c.disc_hit[jnp.array(device_prop_idx)].all()
         return go
 
-    def body(state):
-        c, target_remaining, grow_limit = state
-        frontier = jax.lax.dynamic_slice(
-            c.q_rows, (c.q_head, 0), (fmax, c.q_rows.shape[1]))
-        ebits = jax.lax.dynamic_slice(c.q_eb, (c.q_head,), (fmax,))
-        take = jnp.minimum(c.q_tail - c.q_head, fmax)
-        fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
+    def make_step(fmax_b: int, kmax_b: int):
+        def step(state):
+            c, target_remaining, grow_limit = state
+            frontier = jax.lax.dynamic_slice(
+                c.q_rows, (c.q_head, 0), (fmax_b, c.q_rows.shape[1]))
+            ebits = jax.lax.dynamic_slice(c.q_eb, (c.q_head,), (fmax_b,))
+            take = jnp.minimum(c.q_tail - c.q_head, fmax_b)
+            fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
-        # the shared check_block analog (ops/expand.py)
-        exp = expand_frontier(model, frontier, fvalid, ebits,
-                              eventually_idx)
-        vcount = exp.cvalid.sum(dtype=jnp.int32)
-        kovf = vcount > kmax
+            # the shared check_block analog (ops/expand.py)
+            exp = expand_frontier(model, frontier, fvalid, ebits,
+                                  eventually_idx)
+            vcount = exp.cvalid.sum(dtype=jnp.int32)
+            kovf = vcount > kmax_b
 
-        # sticky discovery registers (idempotent: safe even if the kovf
-        # branch re-expands this frontier after a kmax rebuild)
-        disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
-        if prop_count:
-            new_hit, cand_hi, cand_lo = discovery_candidates(
-                properties, exp, fvalid)
-            keep = disc_hit | ~new_hit
-            disc_hi = jnp.where(keep, disc_hi, cand_hi)
-            disc_lo = jnp.where(keep, disc_lo, cand_lo)
-            disc_hit = disc_hit | new_hit
+            # sticky discovery registers (idempotent: safe even if the
+            # kovf branch re-expands this frontier after a kmax rebuild)
+            disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
+            if prop_count:
+                new_hit, cand_hi, cand_lo = discovery_candidates(
+                    properties, exp, fvalid)
+                keep = disc_hit | ~new_hit
+                disc_hi = jnp.where(keep, disc_hi, cand_hi)
+                disc_lo = jnp.where(keep, disc_lo, cand_lo)
+                disc_hit = disc_hit | new_hit
 
-        def commit(c):
-            # shrink the valid children to kmax lanes (gathers only); all
-            # downstream ops run at kmax lanes
-            src = shrink_indices(exp.cvalid, kmax)
-            kvalid = jnp.arange(kmax, dtype=jnp.int32) < vcount
-            k_flat = exp.flat[src]
-            k_chi = exp.chi[src]
-            k_clo = exp.clo[src]
-            row = src // n_actions  # parent frontier row of each child
-            k_phi = exp.phi[row]
-            k_plo = exp.plo[row]
-            k_ceb = exp.ebits[row]
+            def commit(c):
+                # shrink the valid children to kmax_b lanes (gathers
+                # only); all downstream ops run at kmax_b lanes
+                src = shrink_indices(exp.cvalid, kmax_b)
+                kvalid = jnp.arange(kmax_b, dtype=jnp.int32) < vcount
+                k_flat = exp.flat[src]
+                k_chi = exp.chi[src]
+                k_clo = exp.clo[src]
+                row = src // n_actions  # parent frontier row per child
+                k_phi = exp.phi[row]
+                k_plo = exp.plo[row]
+                k_ceb = exp.ebits[row]
 
-            inserted, key_hi, key_lo, t_ovf = table_insert(
-                c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
-            cnt = inserted.sum(dtype=jnp.int32)
+                inserted, key_hi, key_lo, t_ovf = table_insert(
+                    c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
+                cnt = inserted.sum(dtype=jnp.int32)
 
-            # compact the fresh rows and block-append to queue + log
-            src2 = shrink_indices(inserted, kmax)
-            n_flat = k_flat[src2]
-            n_eb = k_ceb[src2]
-            n_chi = k_chi[src2]
-            n_clo = k_clo[src2]
-            n_phi = k_phi[src2]
-            n_plo = k_plo[src2]
-            q_rows = jax.lax.dynamic_update_slice(c.q_rows, n_flat,
-                                                  (c.q_tail, 0))
-            q_eb = jax.lax.dynamic_update_slice(c.q_eb, n_eb, (c.q_tail,))
-            log_chi = jax.lax.dynamic_update_slice(c.log_chi, n_chi,
-                                                   (c.log_n,))
-            log_clo = jax.lax.dynamic_update_slice(c.log_clo, n_clo,
-                                                   (c.log_n,))
-            log_phi = jax.lax.dynamic_update_slice(c.log_phi, n_phi,
-                                                   (c.log_n,))
-            log_plo = jax.lax.dynamic_update_slice(c.log_plo, n_plo,
-                                                   (c.log_n,))
-            return c._replace(
-                q_rows=q_rows, q_eb=q_eb,
-                q_head=c.q_head + take,
-                q_tail=c.q_tail + cnt,
-                key_hi=key_hi, key_lo=key_lo,
-                log_chi=log_chi, log_clo=log_clo,
-                log_phi=log_phi, log_plo=log_plo,
-                log_n=c.log_n + cnt,
-                gen=c.gen + vcount,
-                ovf=c.ovf | t_ovf,
-                xovf=c.xovf | exp.xovf)
+                # compact the fresh rows; block-append to queue + log
+                src2 = shrink_indices(inserted, kmax_b)
+                n_flat = k_flat[src2]
+                n_eb = k_ceb[src2]
+                n_chi = k_chi[src2]
+                n_clo = k_clo[src2]
+                n_phi = k_phi[src2]
+                n_plo = k_plo[src2]
+                q_rows = jax.lax.dynamic_update_slice(
+                    c.q_rows, n_flat, (c.q_tail, 0))
+                q_eb = jax.lax.dynamic_update_slice(
+                    c.q_eb, n_eb, (c.q_tail,))
+                log_chi = jax.lax.dynamic_update_slice(
+                    c.log_chi, n_chi, (c.log_n,))
+                log_clo = jax.lax.dynamic_update_slice(
+                    c.log_clo, n_clo, (c.log_n,))
+                log_phi = jax.lax.dynamic_update_slice(
+                    c.log_phi, n_phi, (c.log_n,))
+                log_plo = jax.lax.dynamic_update_slice(
+                    c.log_plo, n_plo, (c.log_n,))
+                return c._replace(
+                    q_rows=q_rows, q_eb=q_eb,
+                    q_head=c.q_head + take,
+                    q_tail=c.q_tail + cnt,
+                    key_hi=key_hi, key_lo=key_lo,
+                    log_chi=log_chi, log_clo=log_clo,
+                    log_phi=log_phi, log_plo=log_plo,
+                    log_n=c.log_n + cnt,
+                    gen=c.gen + vcount,
+                    ovf=c.ovf | t_ovf,
+                    xovf=c.xovf | exp.xovf)
 
-        # kovf: abort BEFORE any mutation; the host doubles kmax and the
-        # rebuilt chunk re-expands the same frontier
-        nc = jax.lax.cond(kovf, lambda c: c, commit, c)
-        nc = nc._replace(disc_hit=disc_hit, disc_hi=disc_hi,
-                         disc_lo=disc_lo, kovf=c.kovf | kovf,
-                         xovf=nc.xovf | exp.xovf,
-                         steps=c.steps - 1)
-        return (nc, target_remaining, grow_limit)
+            # kovf: abort BEFORE any mutation; the host doubles kmax and
+            # the rebuilt chunk re-expands the same frontier
+            nc = jax.lax.cond(kovf, lambda c: c, commit, c)
+            return nc._replace(disc_hit=disc_hit, disc_hi=disc_hi,
+                               disc_lo=disc_lo, kovf=c.kovf | kovf,
+                               xovf=nc.xovf | exp.xovf,
+                               steps=c.steps - 1)
+        return step
+
+    step_large = make_step(fmax, kmax)
+    if two_size:
+        step_small = make_step(fmax_small, kmax_small)
+
+        def body(state):
+            c, _tr, _gl = state
+            avail = c.q_tail - c.q_head
+            nc = jax.lax.cond(avail > fmax_small, step_large, step_small,
+                              state)
+            return (nc, _tr, _gl)
+    else:
+        def body(state):
+            return (step_large(state), state[1], state[2])
 
     def chunk(carry: ChunkCarry, target_remaining, grow_limit):
         out, _, _ = jax.lax.while_loop(

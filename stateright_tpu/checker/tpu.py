@@ -303,17 +303,15 @@ class TpuChecker(HostChecker):
             # expanded state's fingerprint every level, so the per-level
             # orchestration is the natural fit
             mode = "level"
-        if self._host_props:
-            if mode == "device":
-                raise ValueError(
-                    "host-evaluated properties require the per-level "
-                    "engine (new states are pulled back each level); drop "
-                    "tpu_options(mode='device')")
-            mode = "level"
-        if mode == "level":
-            self._run_levels()
-        else:
+        # host-evaluated properties run on either engine: the per-level
+        # engine evaluates them on each level's new states; the device
+        # engine evaluates them post-hoc over the distinct host-property
+        # keys of the entire reached set (the append-only queue retains
+        # every unique state's packed row)
+        if mode in ("auto", "device"):
             self._run_device()
+        else:
+            self._run_levels()
 
 
     def _seed_inits(self) -> "List[np.ndarray]":
@@ -361,7 +359,12 @@ class TpuChecker(HostChecker):
         host_prop_idx = {i for i, _p in self._host_props}
         target = self._target_state_count
         opts = self._tpu_options
-        fmax = int(opts.get("fmax", min(self._max_segment, 1 << 13)))
+        # default expansion width targets ~350k child lane-words per
+        # iteration — empirically the knee of the lane-cost curve across
+        # model shapes (narrow 2pc, wide packed-actor states)
+        auto_fmax = max(256, min(
+            1 << 13, 350_000 // (model.max_actions * model.packed_width)))
+        fmax = int(opts.get("fmax", auto_fmax))
         fa = fmax * model.max_actions
         kmax = min(int(opts.get("kmax", max(1 << 12, fa // 2))), fa)
         k_steps = int(opts.get("chunk_steps", 64))
@@ -438,6 +441,16 @@ class TpuChecker(HostChecker):
                                           fmax, kmax)
                 carry = carry._replace(kovf=jnp.bool_(False))
                 continue
+            if self._host_props and any(
+                    p.name not in discoveries for _i, p in self._host_props):
+                # evaluate host properties over the reached-so-far set each
+                # chunk (memoized per distinct key), so a shallow host
+                # counterexample still exits early instead of waiting for
+                # full exhaustion
+                with self._timed("posthoc"):
+                    self._posthoc_eval(carry, qcap, n_init,
+                                       list(generated.keys())[:n_init],
+                                       discoveries)
             done = (q_size == 0
                     or len(discoveries) == prop_count
                     or (target is not None
@@ -453,10 +466,17 @@ class TpuChecker(HostChecker):
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax)
 
+        if self._host_props:
+            with self._timed("posthoc"):
+                self._posthoc_eval(carry, qcap, n_init,
+                                   list(generated.keys())[:n_init],
+                                   discoveries)
         # the mirror (fp -> parent fp) stays device-resident until someone
         # needs it (path reconstruction, checkpointing): the log pull is
-        # pure host-link cost, pointless for count-only runs
-        self._mirror_carry = carry
+        # pure host-link cost, pointless for count-only runs. Keep only
+        # the log fields so the table/queue HBM is freed promptly.
+        self._mirror_carry = (carry.log_chi, carry.log_clo, carry.log_phi,
+                              carry.log_plo, carry.log_n)
         self._discovery_fps.update(discoveries)
 
     def _device_qcap(self, n_init: int, headroom: int) -> int:
@@ -485,12 +505,14 @@ class TpuChecker(HostChecker):
 
         def rebuild(q_rows, q_eb, q_head, q_tail,
                     log_chi, log_clo, log_phi, log_plo, log_n):
-            # relocate [head, tail) to the front of the larger queue; rows
-            # past the live region are never observed
-            live = jnp.arange(new_qcap, dtype=jnp.int32)
-            src = jnp.minimum(q_head + live, qcap - 1)
-            nq_rows = q_rows[src]
-            nq_eb = q_eb[src]
+            # copy the whole queue prefix into the larger buffer at the
+            # same positions: the [0, tail) region doubles as the list of
+            # every unique state's packed row (post-hoc property eval,
+            # checkpointing), so consumed rows are retained
+            nq_rows = jnp.zeros((new_qcap, q_rows.shape[1]), jnp.uint32)
+            nq_rows = jax.lax.dynamic_update_slice(nq_rows, q_rows, (0, 0))
+            nq_eb = jnp.zeros((new_qcap,), jnp.uint32)
+            nq_eb = jax.lax.dynamic_update_slice(nq_eb, q_eb, (0,))
             # bigger log
             nl_chi = jnp.zeros((self._capacity,), jnp.uint32)
             nl_chi = jax.lax.dynamic_update_slice(nl_chi, log_chi, (0,))
@@ -506,11 +528,11 @@ class TpuChecker(HostChecker):
             valid = jnp.arange(old_capacity, dtype=jnp.int32) < log_n
             _, key_hi, key_lo, ovf = table_insert_local(
                 key_hi, key_lo, log_chi, log_clo, valid)
-            return (nq_rows, nq_eb, q_tail - q_head, key_hi, key_lo,
+            return (nq_rows, nq_eb, key_hi, key_lo,
                     nl_chi, nl_clo, nl_phi, nl_plo, ovf)
 
         rebuild = jax.jit(rebuild)
-        (nq_rows, nq_eb, new_tail, key_hi, key_lo, nl_chi, nl_clo, nl_phi,
+        (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo, nl_phi,
          nl_plo, ovf) = rebuild(carry.q_rows, carry.q_eb, carry.q_head,
                                 carry.q_tail, carry.log_chi, carry.log_clo,
                                 carry.log_phi, carry.log_plo, carry.log_n)
@@ -522,33 +544,122 @@ class TpuChecker(HostChecker):
         key_hi, key_lo = self._bulk_insert(insert_fn, key_hi, key_lo,
                                            init_fps)
         carry = carry._replace(
-            q_rows=nq_rows, q_eb=nq_eb, q_head=jnp.int32(0),
-            q_tail=new_tail,
+            q_rows=nq_rows, q_eb=nq_eb,
             key_hi=key_hi, key_lo=key_lo,
             log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
             log_plo=nl_plo)
         return carry, new_qcap
 
+    # ------------------------------------------------------------------
+    _POSTHOC_CACHE: dict = {}
+
+    def _posthoc_fn(self, qcap: int, capacity: int, hmax: int):
+        """Jitted device reduction for post-hoc host-property evaluation:
+        dedup the reached set (the queue prefix) by host-property columns
+        and emit one representative row + witness fingerprint per distinct
+        key."""
+        import jax
+        import jax.numpy as jnp
+
+        from .device_loop import model_cache_key, shrink_indices
+        from ..ops.hash_kernel import fp64_device
+        from ..ops.hashtable import table_insert
+
+        model = self._model
+        cols = getattr(model, "host_property_cols", None)
+        off, hw = cols if cols is not None else (0, model.packed_width)
+        mkey = model_cache_key(model)
+        ckey = (mkey, qcap, capacity, hmax)
+        if mkey is not None:
+            cached = self._POSTHOC_CACHE.get(ckey)
+            if cached is not None:
+                return cached
+
+        def fn(q_rows, q_tail, log_chi, log_clo, n_init):
+            key_cols = q_rows[:, off:off + hw]
+            hhi, hlo = fp64_device(key_cols)
+            valid = jnp.arange(qcap, dtype=jnp.int32) < q_tail
+            khi = jnp.zeros((capacity,), jnp.uint32)
+            klo = jnp.zeros((capacity,), jnp.uint32)
+            inserted, khi, klo, ovf = table_insert(khi, klo, hhi, hlo,
+                                                   valid)
+            hcount = inserted.sum(dtype=jnp.int32)
+            src = shrink_indices(inserted, hmax)
+            out_rows = q_rows[src]
+            # witness fp: queue row i >= n_init corresponds to log entry
+            # i - n_init (queue and log append in lockstep); init rows are
+            # resolved host-side from the seed order
+            li = jnp.maximum(src - n_init, 0)
+            w_hi = log_chi[li]
+            w_lo = log_clo[li]
+            return out_rows, src, w_hi, w_lo, hcount, ovf
+
+        fn = jax.jit(fn, static_argnums=())
+        if mkey is not None:
+            if len(self._POSTHOC_CACHE) >= 64:
+                self._POSTHOC_CACHE.clear()
+            self._POSTHOC_CACHE[ckey] = fn
+        return fn
+
+    def _posthoc_eval(self, carry, qcap: int, n_init: int,
+                      init_fps: List[int],
+                      discoveries: Dict[str, int]) -> None:
+        """Evaluate host properties once per distinct host-property key
+        over the entire reached set (device dedup, host predicates)."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self._model
+        hmax = int(self._tpu_options.get("hmax", 1 << 14))
+        while True:
+            fn = self._posthoc_fn(qcap, self._capacity, hmax)
+            (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf_d) = fn(
+                carry.q_rows, carry.q_tail, carry.log_chi, carry.log_clo,
+                jnp.int32(n_init))
+            hcount, tovf = jax.device_get((hcount_d, tovf_d))
+            if bool(tovf):
+                raise RuntimeError(
+                    "device hash table probe overflow during post-hoc "
+                    "host-property reduction; raise tpu_options("
+                    "capacity=...)")
+            if int(hcount) <= hmax:
+                break
+            hmax *= 2
+        hcount = int(hcount)
+        if not hcount:
+            return
+        n = min(_bucket(hcount), hmax)
+        rows_h, src_h, whi_h, wlo_h = jax.device_get((
+            rows_d[:n], src_d[:n], whi_d[:n], wlo_d[:n]))
+        wfp = _combine64(whi_h, wlo_h)
+        for j in range(hcount):
+            if all(p.name in discoveries for _i, p in self._host_props):
+                break
+            src_j = int(src_h[j])
+            fp = (init_fps[src_j] if src_j < n_init
+                  else int(wfp[j]))
+            self._eval_host_props_row(rows_h[j], fp, discoveries)
+
     def _ensure_mirror(self) -> None:
         """Pull the device-resident (child fp, parent fp) log — lazily, on
         first use — to complete the host mirror used for path
         reconstruction and checkpointing."""
-        carry = getattr(self, "_mirror_carry", None)
-        if carry is None:
+        mirror = getattr(self, "_mirror_carry", None)
+        if mirror is None:
             return
         self._mirror_carry = None
+        log_chi, log_clo, log_phi, log_plo, log_n_d = mirror
         import jax
 
         with self._timed("mirror_pull"):
-            log_n = int(jax.device_get(carry.log_n))
+            log_n = int(jax.device_get(log_n_d))
             if not log_n:
                 return
             # pull only the live prefix (pow2-padded slice jitted on device)
-            n = min(_bucket(log_n), carry.log_chi.shape[0])
+            n = min(_bucket(log_n), log_chi.shape[0])
             _slice, take_fn, _rows = _level_helpers()
             chi, clo, phi, plo = jax.device_get(take_fn(
-                carry.log_chi, carry.log_clo, carry.log_phi, carry.log_plo,
-                n))
+                log_chi, log_clo, log_phi, log_plo, n))
             child = _combine64(chi[:log_n], clo[:log_n])
             parent = _combine64(phi[:log_n], plo[:log_n])
             self._generated.update(zip(child.tolist(), parent.tolist()))

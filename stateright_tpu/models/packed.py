@@ -38,6 +38,10 @@ class PackedModel(Model):
     packed_width: int
     #: static upper bound on actions per state
     max_actions: int
+    #: (offset, width) of the packed columns host-evaluated properties
+    #: depend on (None = the whole row). Lets the device engine dedup
+    #: states by host-property key before the host evaluates them.
+    host_property_cols = None
 
     def cache_key(self):
         """Hashable identity of this model's *compiled program* — two

@@ -49,10 +49,20 @@ class TestPackedPaxosOnDevice:
         path = ck.discoveries()["value chosen"]
         assert len(path.into_actions()) >= 1
 
-    def test_host_property_requires_level_mode(self):
-        with pytest.raises(ValueError):
-            (PackedPaxos(1).checker().tpu_options(mode="device")
-             .spawn_tpu().join())
+    def test_level_mode_agrees_with_posthoc(self):
+        """The per-level engine (incremental host-prop eval) and the
+        device engine (post-hoc eval over distinct histories) reach the
+        same verdicts and counts."""
+        level = (PackedPaxos(1).checker()
+                 .tpu_options(capacity=1 << 12, mode="level")
+                 .spawn_tpu().join())
+        device = (PackedPaxos(1).checker()
+                  .tpu_options(capacity=1 << 12, mode="device")
+                  .spawn_tpu().join())
+        assert level.unique_state_count() == 265
+        assert device.unique_state_count() == 265
+        assert set(level.discoveries()) == set(device.discoveries())
+        device.assert_properties()
 
     @pytest.mark.slow
     def test_spawn_tpu_n2_16668(self):

@@ -288,3 +288,63 @@ class TestModelOverflowFatal:
         with pytest.raises(RuntimeError, match="capacity overflow"):
             (over.checker().tpu_options(capacity=1 << 12, mode="device")
              .spawn_tpu().join())
+
+
+class _HostPropEquation(PackedLinearEquation):
+    """Equation walk whose ONLY property is host-evaluated: an ALWAYS that
+    a shallow state violates — pins device-mode early exit via the
+    per-chunk post-hoc evaluation."""
+
+    host_property_indices = (0,)
+
+    def properties(self):
+        from stateright_tpu.core import Property
+
+        def x_small(_model, state):
+            return state[0] <= 3
+        return [Property.always("x small", x_small)]
+
+
+class _MixedPropEquation(_HostPropEquation):
+    """Host ALWAYS violation + an unsatisfiable device SOMETIMES: the
+    engine must run to exhaustion (the sometimes needs the whole space)
+    while still reporting the host counterexample."""
+
+    host_property_indices = (1,)
+
+    def properties(self):
+        from stateright_tpu.core import Property
+        return (PackedLinearEquation.properties(self)
+                + _HostPropEquation.properties(self))
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        bits = super().packed_properties(words)
+        # placeholder bit for the host-evaluated property (index 1)
+        return jnp.concatenate([bits, jnp.ones((1,), bool)])
+
+
+class TestPosthocHostProps:
+    def test_violation_exits_early(self):
+        model = _HostPropEquation(2, 0, 10**9)
+        # small chunks so the per-chunk post-hoc pass gets a chance to
+        # observe the shallow violation long before exhaustion
+        ck = (model.checker()
+              .tpu_options(capacity=1 << 12, mode="device", fmax=64,
+                           chunk_steps=4)
+              .spawn_tpu().join())
+        path = ck.assert_any_discovery("x small")
+        assert path.last_state()[0] > 3
+        # 65,536-state space; the violation is shallow, so the search must
+        # stop far short of exhaustion
+        assert ck.unique_state_count() < 20000
+
+    def test_undiscovered_sometimes_requires_exhaustion(self):
+        model = _MixedPropEquation(2, 0, 10**9)  # unsatisfiable sometimes
+        ck = (model.checker()
+              .tpu_options(capacity=1 << 12, mode="device", fmax=64,
+                           chunk_steps=4)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 65536
+        assert ck.discovery("x small") is not None
+        assert ck.discovery("solvable") is None
