@@ -40,6 +40,11 @@ class ActorModelState:
         )
 
 
+def _xml_escape(s: str) -> str:
+    from xml.sax.saxutils import escape
+    return escape(s, {"'": "&apos;"})
+
+
 # --- actions (`model.rs:43-51`) --------------------------------------------
 
 @dataclass(frozen=True)
@@ -235,6 +240,99 @@ class ActorModel(Model):
 
     def within_boundary(self, state: ActorModelState) -> bool:
         return self.within_boundary_(self.cfg, state)
+
+    def as_svg(self, path) -> Optional[str]:
+        """Sequence diagram for a path through the actor system: one
+        vertical lifeline per actor, an arrow per message delivery from
+        its send event, a circle per timeout (`model.rs:383-485`). Used by
+        the Explorer's states endpoint."""
+        def plot(x, y):
+            return x * 100, y * 30
+
+        actor_count = len(path.last_state().actor_states)
+        steps = path.into_vec()
+        svg_w, svg_h = plot(actor_count, len(steps))
+        svg_w += 300  # extra width for event labels
+        parts = [
+            f"<svg version='1.1' baseProfile='full' width='{svg_w}' "
+            f"height='{svg_h}' viewBox='-20 -20 {svg_w + 20} {svg_h + 20}'"
+            " xmlns='http://www.w3.org/2000/svg'>",
+            "<defs><marker class='svg-event-shape' id='arrow' "
+            "markerWidth='12' markerHeight='10' refX='12' refY='5' "
+            "orient='auto'><polygon points='0 0, 12 5, 0 10' />"
+            "</marker></defs>",
+        ]
+
+        for i in range(actor_count):
+            x1, y1 = plot(i, 0)
+            x2, y2 = plot(i, len(steps))
+            parts.append(
+                f"<line x1='{x1}' y1='{y1}' x2='{x2}' y2='{y2}' "
+                "class='svg-actor-timeline' />")
+            parts.append(f"<text x='{x1}' y='{y1}' "
+                         f"class='svg-actor-label'>{i}</text>")
+
+        def record_sends(state, index, run_handler):
+            """Re-run the handler to learn which sends this event emits
+            (so later deliveries can draw arrows from this row)."""
+            if index >= len(state.actor_states):
+                return
+            out = Out()
+            run_handler(state.actor_states[index], out)
+            for command in out:
+                if isinstance(command, Send):
+                    send_time[(Id(index), command.dst,
+                               _msg_key(command.msg))] = time
+
+        def _msg_key(msg):
+            try:
+                hash(msg)
+                return msg
+            except TypeError:
+                return repr(msg)
+
+        # arrows for deliveries, circles for timeouts
+        send_time: dict = {}
+        for t, (state, action) in enumerate(steps):
+            time = t + 1  # the action lands on the next row
+            if isinstance(action, Deliver):
+                src_time = send_time.get(
+                    (action.src, action.dst, _msg_key(action.msg)), 0)
+                x1, y1 = plot(int(action.src), src_time)
+                x2, y2 = plot(int(action.dst), time)
+                parts.append(
+                    f"<line x1='{x1}' x2='{x2}' y1='{y1}' y2='{y2}' "
+                    "marker-end='url(#arrow)' class='svg-event-line' />")
+                index = int(action.dst)
+                record_sends(
+                    state, index,
+                    lambda st, out: self.actors[index].on_msg(
+                        action.dst, st, action.src, action.msg, out))
+            elif isinstance(action, Timeout):
+                x, y = plot(int(action.id), time)
+                parts.append(f"<circle cx='{x}' cy='{y}' r='10' "
+                             "class='svg-event-shape' />")
+                index = int(action.id)
+                record_sends(
+                    state, index,
+                    lambda st, out: self.actors[index].on_timeout(
+                        action.id, st, out))
+
+        # labels last so they draw over the shapes
+        for t, (_state, action) in enumerate(steps):
+            time = t + 1
+            if isinstance(action, Deliver):
+                x, y = plot(int(action.dst), time)
+                label = _xml_escape(repr(action.msg))
+                parts.append(f"<text x='{x}' y='{y}' "
+                             f"class='svg-event-label'>{label}</text>")
+            elif isinstance(action, Timeout):
+                x, y = plot(int(action.id), time)
+                parts.append(f"<text x='{x}' y='{y}' "
+                             "class='svg-event-label'>Timeout</text>")
+
+        parts.append("</svg>")
+        return "".join(parts)
 
     def format_action(self, action: Any) -> str:
         if isinstance(action, Deliver):

@@ -25,6 +25,7 @@ class CheckerBuilder:
         self.thread_count_: int = 1
         self.visitor_ = None
         self.tpu_options_: dict = {}
+        self.resume_path_ = None
 
     def symmetry(self) -> "CheckerBuilder":
         """Enable symmetry reduction via ``state.representative()``
@@ -55,6 +56,13 @@ class CheckerBuilder:
     def tpu_options(self, **options) -> "CheckerBuilder":
         """Tuning knobs for ``spawn_tpu`` (table capacity, batch caps, ...)."""
         self.tpu_options_.update(options)
+        return self
+
+    def resume_from(self, path) -> "CheckerBuilder":
+        """Resume a ``spawn_tpu`` run from a checkpoint written by
+        ``Checker.save`` (the TLC-style fingerprint record + pending
+        frontier; SURVEY.md §5 checkpoint note)."""
+        self.resume_path_ = path
         return self
 
     def spawn_bfs(self) -> "Checker":

@@ -283,7 +283,9 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                steps: int = 0):
     """Host-side construction of the initial carry (init states enqueued;
-    the caller bulk-inserts their fingerprints into the table)."""
+    the caller bulk-inserts their fingerprints into the table).
+    ``full_ebits`` is a scalar for fresh runs or a per-row array when
+    resuming from a checkpointed frontier."""
     import numpy as np
 
     width = model.packed_width
@@ -296,7 +298,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     q_eb = jnp.zeros((qcap,), jnp.uint32)
     if k:
         q_rows = q_rows.at[:k].set(jnp.asarray(np.stack(init_rows)))
-        q_eb = q_eb.at[:k].set(jnp.full((k,), full_ebits, jnp.uint32))
+        eb = np.broadcast_to(np.asarray(full_ebits, np.uint32), (k,))
+        q_eb = q_eb.at[:k].set(jnp.asarray(eb))
     logcap = capacity
     return ChunkCarry(
         q_rows=q_rows, q_eb=q_eb,

@@ -1,0 +1,221 @@
+"""The Explorer: a web service for interactively browsing a state space.
+
+Port of the reference's actix-web service
+(`/root/reference/src/checker/explorer.rs:71-240`) on the stdlib HTTP
+server. The API is identical:
+
+* ``GET /``, ``/app.css``, ``/app.js`` — the single-page UI (served from
+  the package's ``ui/`` directory);
+* ``GET /.status`` — checking progress: done flag, counts, per-property
+  discoveries (as encoded fingerprint paths), and a recently visited path
+  sampled by a snapshot visitor re-armed every 4 seconds
+  (`explorer.rs:76-84`);
+* ``GET /.states/{fp}/{fp}/...`` — a state is addressed by the fingerprint
+  path from an init state (`explorer.rs:159-240`): the server replays the
+  model to the addressed state on every request and returns one
+  ``StateView`` per action — including "ignored" actions (``next_state ->
+  None``) with ``state: null``, which is useful for debugging.
+
+The server holds no per-state storage for the UI: everything is
+reconstructed by replay, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from .path import Path
+from .visitor import CheckerVisitor
+
+_UI_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "ui")
+_UI_FILES = {
+    "/": ("index.htm", "text/html; charset=utf-8"),
+    "/app.css": ("app.css", "text/css; charset=utf-8"),
+    "/app.js": ("app.js", "application/javascript; charset=utf-8"),
+}
+
+
+class NotFound(Exception):
+    """Maps to HTTP 404 (`explorer.rs:176-180`, `:234-238`)."""
+
+
+class Snapshot(CheckerVisitor):
+    """Records one recently visited path; re-armed periodically so the
+    status endpoint shows live progress (`explorer.rs:57-69`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed = True
+        self.actions: Optional[List[Any]] = None
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            if not self._armed:
+                return
+            self._armed = False
+            self.actions = path.into_actions()
+
+    def rearm(self) -> None:
+        with self._lock:
+            self._armed = True
+
+
+def status_view(checker, snapshot: Optional[Snapshot]) -> Dict[str, Any]:
+    """The ``/.status`` payload (`explorer.rs:133-157`)."""
+    model = checker.model()
+    recent = None
+    if snapshot is not None and snapshot.actions is not None:
+        recent = repr(snapshot.actions)
+    properties = []
+    for p in model.properties():
+        discovery = checker.discovery(p.name)
+        properties.append([
+            p.expectation.value, p.name,
+            discovery.encode(model) if discovery is not None else None])
+    return {
+        "model": type(model).__name__,
+        "done": checker.is_done(),
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "properties": properties,
+        "recent_path": recent,
+    }
+
+
+def parse_fingerprints(fingerprints_str: str) -> List[int]:
+    """Parse the `/`-joined fingerprint path suffix; raises NotFound on
+    junk (`explorer.rs:168-181`)."""
+    s = fingerprints_str.rstrip("/")
+    parts = [p for p in s.split("/") if p != ""]
+    fps = []
+    for p in parts:
+        try:
+            fps.append(int(p))
+        except ValueError:
+            raise NotFound(f"Unable to parse fingerprints {s}")
+    return fps
+
+
+def state_views(model, fingerprints: List[int]) -> List[Dict[str, Any]]:
+    """The ``/.states`` payload: init states for the empty path, else the
+    steps out of the addressed state (`explorer.rs:183-236`)."""
+    results: List[Dict[str, Any]] = []
+
+    def view(action: Optional[Any], last_state: Optional[Any],
+             state: Optional[Any], path_fps: List[int]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if action is not None:
+            out["action"] = model.format_action(action)
+            outcome = model.format_step(last_state, action)
+            if outcome is not None:
+                out["outcome"] = outcome
+        if state is not None:
+            out["state"] = repr(state)
+            out["fingerprint"] = str(model.fingerprint(state))
+            svg = model.as_svg(
+                Path.from_fingerprints(model, path_fps))
+            if svg is not None:
+                out["svg"] = svg
+        return out
+
+    if not fingerprints:
+        for state in model.init_states():
+            results.append(view(None, None, state,
+                                [model.fingerprint(state)]))
+        return results
+
+    last_state = Path.final_state(model, fingerprints)
+    if last_state is None:
+        raise NotFound("Unable to find state following fingerprints "
+                       + "/".join(str(fp) for fp in fingerprints))
+    actions: List[Any] = []
+    model.actions(last_state, actions)
+    for action in actions:
+        state = model.next_state(last_state, action)
+        if state is not None:
+            results.append(view(
+                action, last_state, state,
+                fingerprints + [model.fingerprint(state)]))
+        else:
+            # "Action ignored" is still returned for debugging
+            results.append({"action": model.format_action(action)})
+    return results
+
+
+def _make_handler(checker, snapshot: Optional[Snapshot]):
+    model = checker.model()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, payload) -> None:
+            self._send(code, json.dumps(payload).encode(),
+                       "application/json")
+
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/.status":
+                    self._send_json(200, status_view(checker, snapshot))
+                elif path == "/.states" or path.startswith("/.states/"):
+                    fps = parse_fingerprints(path[len("/.states"):])
+                    self._send_json(200, state_views(model, fps))
+                elif path in _UI_FILES:
+                    name, ctype = _UI_FILES[path]
+                    with open(os.path.join(_UI_DIR, name), "rb") as f:
+                        self._send(200, f.read(), ctype)
+                else:
+                    self._send(404, b"not found", "text/plain")
+            except NotFound as exc:
+                self._send(404, str(exc).encode(), "text/plain")
+            except Exception as exc:  # pragma: no cover - defensive
+                self._send_json(500, {"error": str(exc)})
+
+    return Handler
+
+
+def serve(checker_builder, address: Tuple[str, int] | str,
+          block: bool = True):
+    """Start checking in the background and serve the Explorer
+    (`explorer.rs:71-89`). ``address`` is ``(host, port)`` or
+    ``"host:port"``. With ``block=False`` returns ``(checker, server)``
+    and serves on a daemon thread (used by tests and ``explore``
+    subcommands that poll)."""
+    if isinstance(address, str):
+        host, _, port = address.rpartition(":")
+        address = (host or "localhost", int(port))
+
+    snapshot = Snapshot()
+    checker = checker_builder.visitor(snapshot).spawn_bfs()
+    checker._start_background()
+
+    def rearm_loop():
+        while True:
+            time.sleep(4)
+            snapshot.rearm()
+
+    threading.Thread(target=rearm_loop, daemon=True).start()
+
+    server = ThreadingHTTPServer(address, _make_handler(checker, snapshot))
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+        return checker
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return checker, server

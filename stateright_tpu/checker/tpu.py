@@ -262,6 +262,9 @@ class TpuChecker(HostChecker):
         self._prof: Dict[str, float] = {}
         # device-resident search record, pulled lazily by _ensure_mirror
         self._mirror_carry = None
+        self._resume_path = builder.resume_path_
+        self._resume_frontier = None
+        self._base_fps: List[int] = []
         _enable_compile_cache()
         # fingerprint -> parent fingerprint mirror (host side; the
         # checkpointable search record, also used for path reconstruction).
@@ -308,6 +311,10 @@ class TpuChecker(HostChecker):
         # engine evaluates them post-hoc over the distinct host-property
         # keys of the entire reached set (the append-only queue retains
         # every unique state's packed row)
+        if self._resume_path is not None and mode == "level":
+            raise NotImplementedError(
+                "resume_from() requires the device engine; drop the "
+                "visitor / tpu_options(mode='level')")
         if mode in ("auto", "device"):
             self._run_device()
         else:
@@ -371,8 +378,18 @@ class TpuChecker(HostChecker):
         insert_fn = _insert_jit()
 
         # --- seed -------------------------------------------------------
-        init_rows = self._seed_inits()
-        n_init = len(generated)
+        if self._resume_path is not None:
+            init_rows, seed_ebits, seed_fps = self._load_checkpoint(
+                discoveries)
+        else:
+            init_rows = self._seed_inits()
+            seed_ebits = full_ebits
+            seed_fps = list(generated.keys())
+        n_init = len(init_rows)
+        base_unique = len(generated)
+        # everything known at seed time must be re-inserted on growth (the
+        # device log only records states found since)
+        self._base_fps = list(generated.keys())
         if prop_count == 0:
             # nothing to search for: mirror the reference's immediate stop
             # once discoveries (vacuously) cover all properties
@@ -391,7 +408,7 @@ class TpuChecker(HostChecker):
         qcap = self._device_qcap(n_init, headroom)
         with self._timed("seed"):
             carry = seed_carry(model, qcap, self._capacity, init_rows,
-                               full_ebits)
+                               seed_ebits)
             key_hi, key_lo = self._bulk_insert(
                 insert_fn, carry.key_hi, carry.key_lo,
                 list(generated.keys()))
@@ -419,7 +436,7 @@ class TpuChecker(HostChecker):
             q_size = int(q_tail) - int(q_head)
             self._prof["chunks"] = self._prof.get("chunks", 0) + 1
             self._state_count += int(gen)
-            self._unique_state_count = n_init + int(log_n)
+            self._unique_state_count = base_unique + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:
@@ -448,8 +465,7 @@ class TpuChecker(HostChecker):
                 # counterexample still exits early instead of waiting for
                 # full exhaustion
                 with self._timed("posthoc"):
-                    self._posthoc_eval(carry, qcap, n_init,
-                                       list(generated.keys())[:n_init],
+                    self._posthoc_eval(carry, qcap, n_init, seed_fps,
                                        discoveries)
             done = (q_size == 0
                     or len(discoveries) == prop_count
@@ -466,11 +482,19 @@ class TpuChecker(HostChecker):
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax)
 
-        if self._host_props:
+        if self._host_props and any(
+                p.name not in discoveries for _i, p in self._host_props):
             with self._timed("posthoc"):
-                self._posthoc_eval(carry, qcap, n_init,
-                                   list(generated.keys())[:n_init],
+                self._posthoc_eval(carry, qcap, n_init, seed_fps,
                                    discoveries)
+        if self._tpu_options.get("resumable"):
+            # pull the pending frontier eagerly so save() needs no pinned
+            # device buffers
+            head = int(jax.device_get(carry.q_head))
+            tail = int(jax.device_get(carry.q_tail))
+            self._resume_frontier = (
+                np.asarray(jax.device_get(carry.q_rows[head:tail])),
+                np.asarray(jax.device_get(carry.q_eb[head:tail])))
         # the mirror (fp -> parent fp) stays device-resident until someone
         # needs it (path reconstruction, checkpointing): the log pull is
         # pure host-link cost, pointless for count-only runs. Keep only
@@ -538,11 +562,10 @@ class TpuChecker(HostChecker):
                                 carry.log_phi, carry.log_plo, carry.log_n)
         if bool(jax.device_get(ovf)):
             raise RuntimeError("overflow while re-inserting during growth")
-        # init fingerprints are not in the log; re-insert from the host
-        init_fps = [fp for fp, parent in self._generated.items()
-                    if parent is None]
+        # fingerprints known at seed time (inits, or a resumed snapshot)
+        # are not in the device log; re-insert them from the host
         key_hi, key_lo = self._bulk_insert(insert_fn, key_hi, key_lo,
-                                           init_fps)
+                                           self._base_fps)
         carry = carry._replace(
             q_rows=nq_rows, q_eb=nq_eb,
             key_hi=key_hi, key_lo=key_lo,
@@ -861,6 +884,72 @@ class TpuChecker(HostChecker):
         """All visited fingerprints (pulls the device log if pending)."""
         self._ensure_mirror()
         return set(self._generated.keys())
+
+    # ------------------------------------------------------------------
+    def save(self, path) -> None:
+        """Checkpoint a finished (typically ``target_state_count``-bounded)
+        run: the complete (fingerprint -> parent) search record plus the
+        pending frontier rows, from which ``CheckerBuilder.resume_from``
+        continues the search (SURVEY.md §5; the record is the TLC
+        technique, `bfs.rs:314-342`)."""
+        if not self.is_done():
+            raise RuntimeError(
+                "save() requires a finished run; bound it with "
+                "target_state_count(...) to checkpoint mid-search")
+        if self._resume_frontier is None:
+            raise RuntimeError(
+                "save() needs the pending frontier: run with "
+                "tpu_options(resumable=True) on the device engine")
+        self._ensure_mirror()
+        rows, ebits = self._resume_frontier
+        child = np.fromiter(self._generated.keys(), np.uint64,
+                            len(self._generated))
+        parent = np.fromiter(
+            (p if p is not None else 0 for p in self._generated.values()),
+            np.uint64, len(self._generated))
+        import json
+
+        meta = json.dumps({
+            "model": self._model_tag(),
+            "discoveries": {n: int(fp)
+                            for n, fp in self._discovery_fps.items()},
+        })
+        np.savez_compressed(
+            path, child=child, parent=parent, rows=rows, ebits=ebits,
+            state_count=np.int64(self._state_count),
+            meta=np.asarray(meta))
+
+    def _model_tag(self) -> str:
+        """Identity check for resume: a checkpoint only makes sense for
+        the same model config (same packed layout, same transitions)."""
+        model = self._model
+        return (f"{type(model).__module__}.{type(model).__qualname__}"
+                f"|{model.cache_key()!r}|w={model.packed_width}")
+
+    def _load_checkpoint(self, discoveries: Dict[str, int]):
+        """Seed state from a ``save()`` file: the mirror, the saved
+        discoveries, and the pending frontier (whose rows become the seed
+        'inits' — their parents are already in the mirror)."""
+        import json
+
+        data = np.load(self._resume_path)
+        meta = json.loads(str(data["meta"]))
+        if meta["model"] != self._model_tag():
+            raise RuntimeError(
+                "checkpoint was written by a different model config: "
+                f"saved {meta['model']!r}, resuming {self._model_tag()!r}")
+        child = data["child"].tolist()
+        parent = [None if p == 0 else int(p)
+                  for p in data["parent"].tolist()]
+        self._generated.update(zip(child, parent))
+        self._state_count = int(data["state_count"])
+        self._unique_state_count = len(self._generated)
+        for name, fp in meta["discoveries"].items():
+            discoveries[name] = int(fp)
+        from ..fingerprint import fp64_words
+        rows = [np.asarray(r, np.uint32) for r in data["rows"]]
+        fps = [fp64_words(r.tolist()) for r in rows]
+        return rows, np.asarray(data["ebits"], np.uint32), fps
 
     def _reconstruct_path(self, fp: int) -> Path:
         self._ensure_mirror()

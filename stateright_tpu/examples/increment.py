@@ -128,6 +128,11 @@ def main(argv=None) -> None:
         print(f"Model checking increment with {thread_count} threads "
               "on the TPU engine.")
         Increment(thread_count).checker().spawn_tpu().report(sys.stdout)
+    elif cmd == "explore":
+        address = args[2] if len(args) > 2 else "localhost:3000"
+        print(f"Exploring state space for increment with {thread_count} "
+              f"threads on http://{address}.")
+        Increment(thread_count).checker().serve(address)
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.increment "
@@ -136,6 +141,8 @@ def main(argv=None) -> None:
               "check-sym [THREAD_COUNT]")
         print("  python -m stateright_tpu.examples.increment "
               "check-tpu [THREAD_COUNT]")
+        print("  python -m stateright_tpu.examples.increment "
+              "explore [THREAD_COUNT] [ADDRESS]")
 
 
 if __name__ == "__main__":

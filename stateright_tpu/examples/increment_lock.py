@@ -151,6 +151,11 @@ def main(argv=None) -> None:
         print(f"Model checking increment_lock with {thread_count} threads "
               "on the TPU engine.")
         IncrementLock(thread_count).checker().spawn_tpu().report(sys.stdout)
+    elif cmd == "explore":
+        address = args[2] if len(args) > 2 else "localhost:3000"
+        print(f"Exploring state space for increment_lock with "
+              f"{thread_count} threads on http://{address}.")
+        IncrementLock(thread_count).checker().serve(address)
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.increment_lock "

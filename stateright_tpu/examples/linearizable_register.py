@@ -221,10 +221,20 @@ def main(argv=None) -> None:
         (AbdModelCfg(client_count=client_count, server_count=3,
                      network=network)
          .into_model().checker().spawn_dfs().report(sys.stdout))
+    elif cmd == "explore":
+        client_count = int(args[1]) if len(args) > 1 else 2
+        address = args[2] if len(args) > 2 else "localhost:3000"
+        print(f"Exploring state space for a linearizable register with "
+              f"{client_count} clients on http://{address}.")
+        (AbdModelCfg(client_count=client_count, server_count=3,
+                     network=Network.new_unordered_nonduplicating())
+         .into_model().checker().serve(address))
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.linearizable_register "
               "check [CLIENT_COUNT] [NETWORK]")
+        print("  python -m stateright_tpu.examples.linearizable_register "
+              "explore [CLIENT_COUNT] [ADDRESS]")
         print(f"NETWORK: {' | '.join(Network.names())}")
 
 

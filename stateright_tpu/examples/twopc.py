@@ -34,6 +34,11 @@ def main(argv=None) -> None:
         print(f"Model checking two phase commit with {rm_count} resource "
               "managers on the TPU engine.")
         TwoPhaseSys(rm_count).checker().spawn_tpu().report(sys.stdout)
+    elif cmd == "explore":
+        address = args[2] if len(args) > 2 else "localhost:3000"
+        print(f"Exploring state space for two phase commit with {rm_count} "
+              f"resource managers on http://{address}.")
+        TwoPhaseSys(rm_count).checker().serve(address)
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.twopc check [RM_COUNT]")
@@ -41,6 +46,8 @@ def main(argv=None) -> None:
               "[RM_COUNT]")
         print("  python -m stateright_tpu.examples.twopc check-tpu "
               "[RM_COUNT]")
+        print("  python -m stateright_tpu.examples.twopc explore "
+              "[RM_COUNT] [ADDRESS]")
 
 
 if __name__ == "__main__":

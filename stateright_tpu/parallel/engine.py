@@ -53,6 +53,10 @@ class ShardedTpuChecker(TpuChecker):
             raise NotImplementedError(
                 "host-evaluated properties are not supported on the "
                 "sharded engine; use single-chip spawn_tpu")
+        if builder.resume_path_ is not None:
+            raise NotImplementedError(
+                "checkpoint resume is not supported on the sharded "
+                "engine; use single-chip spawn_tpu")
 
     # ------------------------------------------------------------------
     def _run(self) -> None:

@@ -1,0 +1,85 @@
+/* Explorer SPA: a state is addressed by the fingerprint path from an init
+ * state (e.g. "#/123/456"); every view is fetched lazily from the
+ * server's replay endpoints. */
+"use strict";
+
+function currentPath() {
+  const h = location.hash.replace(/^#\/?/, "");
+  return h ? h.split("/").filter(Boolean) : [];
+}
+
+function link(fps) { return "#/" + fps.join("/"); }
+
+function esc(s) {
+  const d = document.createElement("span");
+  d.textContent = s;
+  return d.innerHTML;
+}
+
+async function renderStatus() {
+  try {
+    const r = await fetch("/.status");
+    const s = await r.json();
+    let html = `${s.model} &mdash; ${s.done ? "done" : "checking"}, ` +
+      `states=${s.state_count}, unique=${s.unique_state_count}`;
+    for (const [expectation, name, discovery] of s.properties) {
+      const cls = discovery ? "discovered" : "";
+      const label = `${expectation} ${esc(name)}`;
+      html += `<span class="prop ${cls}">` +
+        (discovery ? `<a href="#/${discovery}">${label} &#9733;</a>`
+                   : label) + `</span>`;
+    }
+    document.getElementById("status").innerHTML = html;
+  } catch (e) {
+    document.getElementById("status").textContent = "status unavailable";
+  }
+}
+
+function renderCrumbs(fps) {
+  let html = `<a href="#/">init</a>`;
+  for (let i = 0; i < fps.length; i++) {
+    html += `&rsaquo; <a href="${link(fps.slice(0, i + 1))}">` +
+      `${fps[i].slice(0, 8)}&hellip;</a>`;
+  }
+  document.getElementById("crumbs").innerHTML = html;
+}
+
+async function renderStates() {
+  const fps = currentPath();
+  renderCrumbs(fps);
+  const main = document.getElementById("states");
+  const r = await fetch("/.states/" + fps.join("/"));
+  if (!r.ok) {
+    main.innerHTML = `<p>${esc(await r.text())}</p>`;
+    return;
+  }
+  const views = await r.json();
+  main.innerHTML = "";
+  for (const v of views) {
+    const div = document.createElement("div");
+    const ignored = !("state" in v);
+    div.className = "state" + (ignored ? " ignored" : " clickable");
+    let html = "";
+    if (v.action) html += `<div class="action">${esc(v.action)}</div>`;
+    if (v.outcome) html += `<div class="outcome">${esc(v.outcome)}</div>`;
+    if (ignored) {
+      html += `<div class="outcome">action ignored (no-op)</div>`;
+    } else {
+      html += `<pre>${esc(v.state)}</pre>` +
+        `<div class="fp">fingerprint ${esc(v.fingerprint)}</div>`;
+      if (v.svg) html += v.svg;
+    }
+    div.innerHTML = html;
+    if (!ignored) {
+      div.addEventListener("click", () => {
+        location.hash = link(fps.concat([v.fingerprint]));
+      });
+    }
+    main.appendChild(div);
+  }
+}
+
+window.addEventListener("hashchange", renderStates);
+renderStatus();
+setInterval(renderStatus, 5000);
+renderStates();

@@ -1,0 +1,89 @@
+"""Checkpoint/resume tests (SURVEY.md §5): stop a bounded device run
+mid-search, save, resume in a fresh checker, and converge to the same
+reached set as an uninterrupted run."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.packed import PackedLinearEquation  # noqa: E402
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+
+
+class TestCheckpointResume:
+    def test_resume_converges_to_same_set(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        model = TwoPhaseSys(5)  # 8,832 states (2pc.rs:133)
+        partial = (model.checker()
+                   .tpu_options(capacity=1 << 14, resumable=True, fmax=64,
+                                chunk_steps=4)
+                   .target_state_count(2000)
+                   .spawn_tpu().join())
+        assert partial.state_count() >= 2000
+        assert partial.unique_state_count() < 8832
+        partial.save(path)
+
+        resumed = (TwoPhaseSys(5).checker()
+                   .tpu_options(capacity=1 << 14)
+                   .resume_from(path)
+                   .spawn_tpu().join())
+        assert resumed.unique_state_count() == 8832
+        full = TwoPhaseSys(5).checker().spawn_bfs().join()
+        assert (resumed.generated_fingerprints()
+                == full.generated_fingerprints())
+        # resumed counts continue from the checkpoint
+        assert resumed.state_count() >= partial.state_count()
+
+    def test_resumed_paths_replay(self, tmp_path):
+        # discoveries found after a resume reconstruct valid paths through
+        # the stitched mirror (parents from both run segments)
+        path = tmp_path / "ckpt.npz"
+        model = PackedLinearEquation(3, 5, 81)
+        partial = (model.checker()
+                   .tpu_options(capacity=1 << 14, resumable=True, fmax=32,
+                                chunk_steps=2)
+                   .target_state_count(300)
+                   .spawn_tpu().join())
+        if partial.discovery("solvable") is None:
+            partial.save(path)
+            resumed = (PackedLinearEquation(3, 5, 81).checker()
+                       .tpu_options(capacity=1 << 14)
+                       .resume_from(path)
+                       .spawn_tpu().join())
+            found = resumed.assert_any_discovery("solvable")
+        else:
+            found = partial.assert_any_discovery("solvable")
+        x, y = found.last_state()
+        assert (3 * x + 5 * y) & 0xFF == 81
+
+    def test_save_requires_resumable(self):
+        ck = (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+              .spawn_tpu().join())
+        with pytest.raises(RuntimeError, match="resumable"):
+            ck.save("/tmp/nope.npz")
+
+    def test_save_roundtrip_preserves_discoveries(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(capacity=1 << 12, resumable=True)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 288
+        ck.save(path)
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12)
+                   .resume_from(path)
+                   .spawn_tpu().join())
+        # nothing left to search; counts and discoveries carry over
+        assert resumed.unique_state_count() == 288
+        assert set(resumed.discoveries()) == set(ck.discoveries())
+
+    def test_resume_rejects_different_model(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(capacity=1 << 12, resumable=True)
+              .spawn_tpu().join())
+        ck.save(path)
+        with pytest.raises(RuntimeError, match="different model"):
+            (TwoPhaseSys(4).checker().tpu_options(capacity=1 << 12)
+             .resume_from(path).spawn_tpu().join())
