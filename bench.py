@@ -48,11 +48,14 @@ def tpu_paxos_rate() -> float:
 
 
 def host_paxos_rate() -> float:
+    import os
+
     from stateright_tpu.examples.paxos_packed import PackedPaxos
 
     model = PackedPaxos(3)
     t0 = time.perf_counter()
     ck = (model.checker()
+          .threads(os.cpu_count() or 1)  # all host cores, like bench.sh
           .target_state_count(40_000)
           .spawn_bfs()
           .join())

@@ -43,9 +43,10 @@ class CheckerBuilder:
         return self
 
     def threads(self, thread_count: int) -> "CheckerBuilder":
-        """Host-engine thread count. The pure-Python engines execute on one
-        worker (the GIL serializes model code); parallelism comes from the
-        native host engine and the TPU engine."""
+        """Host-engine worker count (`src/checker.rs:171-173`). With
+        ``thread_count > 1``, ``spawn_bfs`` runs the level-synchronous
+        multi-process engine (the GIL rules out shared-memory threads);
+        ``spawn_dfs`` stays sequential, as symmetry requires."""
         self.thread_count_ = thread_count
         return self
 
@@ -66,7 +67,11 @@ class CheckerBuilder:
         return self
 
     def spawn_bfs(self) -> "Checker":
-        """Breadth-first host engine (`src/checker.rs:116-130`)."""
+        """Breadth-first host engine (`src/checker.rs:116-130`); with
+        ``threads(n > 1)``, multi-process over frontier blocks."""
+        if self.thread_count_ > 1 and self.visitor_ is None:
+            from .parallel_bfs import ParallelBfsChecker
+            return ParallelBfsChecker(self)
         from .bfs import BfsChecker
         return BfsChecker(self)
 

@@ -72,9 +72,10 @@ def status_view(checker, snapshot: Optional[Snapshot]) -> Dict[str, Any]:
     recent = None
     if snapshot is not None and snapshot.actions is not None:
         recent = repr(snapshot.actions)
+    discovered = checker.discoveries()  # one reconstruction pass
     properties = []
     for p in model.properties():
-        discovery = checker.discovery(p.name)
+        discovery = discovered.get(p.name)
         properties.append([
             p.expectation.value, p.name,
             discovery.encode(model) if discovery is not None else None])
@@ -106,6 +107,10 @@ def state_views(model, fingerprints: List[int]) -> List[Dict[str, Any]]:
     """The ``/.states`` payload: init states for the empty path, else the
     steps out of the addressed state (`explorer.rs:183-236`)."""
     results: List[Dict[str, Any]] = []
+    # building the replay Path per successor is only worthwhile when the
+    # model actually renders diagrams; the base as_svg is a constant None
+    from ..core import Model
+    renders_svg = type(model).as_svg is not Model.as_svg
 
     def view(action: Optional[Any], last_state: Optional[Any],
              state: Optional[Any], path_fps: List[int]) -> Dict[str, Any]:
@@ -118,10 +123,11 @@ def state_views(model, fingerprints: List[int]) -> List[Dict[str, Any]]:
         if state is not None:
             out["state"] = repr(state)
             out["fingerprint"] = str(model.fingerprint(state))
-            svg = model.as_svg(
-                Path.from_fingerprints(model, path_fps))
-            if svg is not None:
-                out["svg"] = svg
+            if renders_svg:
+                svg = model.as_svg(
+                    Path.from_fingerprints(model, path_fps))
+                if svg is not None:
+                    out["svg"] = svg
         return out
 
     if not fingerprints:

@@ -1,0 +1,185 @@
+"""Multi-core host BFS: ``spawn_bfs()`` honoring ``threads(n)``.
+
+The reference's host engines scale with shared-memory worker threads and a
+condvar job market (`/root/reference/src/checker/bfs.rs:29-30`, sharing at
+`:138-150`). Python threads serialize on the GIL, so the host-parallel
+analog here is **level-synchronous multiprocessing over frontier blocks**:
+the master keeps the ``generated`` dedup map and the frontier; each BFS
+level is split into blocks that forked workers expand independently
+(property evaluation, action enumeration, fingerprinting, boundary
+filtering — everything the reference does per state in ``check_block``,
+`bfs.rs:165-274`); the master merges children, dedups, and records
+discoveries first-wins.
+
+Workers inherit the model by ``fork`` (models hold lambdas, which do not
+pickle); only states cross process boundaries. Like the reference's
+multithreaded runs, which worker wins a discovery (and which parent a
+state records) is nondeterministic; full-enumeration unique counts match
+exactly.
+
+The ``eventually`` semantics ride per-frontier-entry bit sets with the
+same documented caveats as the sequential engines (`bfs.rs:239-256`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from ..core import Expectation
+from .builder import CheckerBuilder
+from .host import HostChecker
+from .path import Path
+
+# worker globals, populated in the parent immediately before the fork so
+# the children inherit them (lambda-laden models cannot pickle). _FORK_LOCK
+# serializes (set globals -> fork pool -> clear globals) so concurrently
+# constructed checkers cannot hand a worker the wrong model.
+_WORK_MODEL = None
+_WORK_PROPS = None
+_FORK_LOCK = threading.Lock()
+
+
+def _expand_block(batch: List[Tuple[Any, int, FrozenSet[int]]]):
+    """Expand one frontier block: returns (generated_count, discoveries,
+    children) where children are (child_fp, parent_fp, child_state,
+    ebits)."""
+    model, properties = _WORK_MODEL, _WORK_PROPS
+    discoveries: Dict[str, int] = {}
+    children: List[Tuple[int, int, Any, FrozenSet[int]]] = []
+    gen_count = 0
+    for state, state_fp, ebits in batch:
+        # property evaluation (bfs.rs:192-226)
+        for i, prop in enumerate(properties):
+            if prop.name in discoveries:
+                continue
+            if prop.expectation == Expectation.ALWAYS:
+                if not prop.condition(model, state):
+                    discoveries.setdefault(prop.name, state_fp)
+            elif prop.expectation == Expectation.SOMETIMES:
+                if prop.condition(model, state):
+                    discoveries.setdefault(prop.name, state_fp)
+            else:  # EVENTUALLY: clear satisfied bits
+                if prop.condition(model, state):
+                    ebits = ebits - {i}
+
+        # expansion (bfs.rs:229-264)
+        actions: List = []
+        model.actions(state, actions)
+        is_terminal = True
+        for action in actions:
+            next_state = model.next_state(state, action)
+            if next_state is None:
+                continue
+            if not model.within_boundary(next_state):
+                continue
+            gen_count += 1
+            is_terminal = False
+            next_fp = model.fingerprint(next_state)
+            children.append((next_fp, state_fp, next_state, ebits))
+        if is_terminal:
+            for i, prop in enumerate(properties):
+                if i in ebits:
+                    discoveries.setdefault(prop.name, state_fp)
+    return gen_count, discoveries, children
+
+
+class ParallelBfsChecker(HostChecker):
+    """Level-synchronous multi-process BFS (`threads(n)`, n > 1)."""
+
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        if builder.visitor_ is not None:
+            raise ValueError(
+                "per-state visitors require the sequential engine; drop "
+                "threads(...) or the visitor")
+        self._workers = max(2, builder.thread_count_)
+        self._generated: Dict[int, Optional[int]] = {}
+        # fork the worker pool at CONSTRUCTION, on the caller's thread:
+        # forking from the engine's background thread — or after other
+        # checkers spin up native (e.g. XLA) threads — is the classic
+        # fork+threads deadlock. The workers inherit the model via the
+        # fork; only states cross process boundaries afterwards.
+        import multiprocessing as mp
+
+        global _WORK_MODEL, _WORK_PROPS
+        with _FORK_LOCK:
+            _WORK_MODEL = self._model
+            _WORK_PROPS = self._properties
+            try:
+                self._pool = mp.get_context("fork").Pool(self._workers)
+            finally:
+                _WORK_MODEL = _WORK_PROPS = None
+
+    def _run(self) -> None:
+        model = self._model
+        properties = self._properties
+        generated = self._generated
+        discoveries = self._discovery_fps
+        target = self._target_state_count
+        eventually_idx = frozenset(
+            i for i, p in enumerate(properties)
+            if p.expectation == Expectation.EVENTUALLY)
+        awaiting = {p.name for p in properties}
+
+        init_states = [s for s in model.init_states()
+                       if model.within_boundary(s)]
+        self._state_count = len(init_states)
+        frontier: List[Tuple[Any, int, FrozenSet[int]]] = []
+        for s in init_states:
+            fp = model.fingerprint(s)
+            if fp not in generated:
+                generated[fp] = None
+                frontier.append((s, fp, eventually_idx))
+        self._unique_state_count = len(generated)
+        if not properties:
+            return
+
+        try:
+            while frontier:
+                n_blocks = min(len(frontier), self._workers * 4)
+                size = -(-len(frontier) // n_blocks)
+                blocks = [frontier[i:i + size]
+                          for i in range(0, len(frontier), size)]
+                results = self._pool.map(_expand_block, blocks)
+                frontier = []
+                for gen_count, block_disc, children in results:
+                    self._state_count += gen_count
+                    for name, fp in block_disc.items():
+                        discoveries.setdefault(name, fp)
+                    for fp, parent_fp, child, ebits in children:
+                        if fp in generated:
+                            continue
+                        generated[fp] = parent_fp
+                        frontier.append((child, fp, ebits))
+                self._unique_state_count = len(generated)
+                if len(discoveries) == len(properties):
+                    return
+                if target is not None and self._state_count >= target:
+                    return
+        finally:
+            self._pool.terminate()
+            self._pool.join()
+
+    def _reconstruct_path(self, fp: int) -> Path:
+        fingerprints: list = []
+        next_fp = fp
+        while next_fp in self._generated:
+            parent = self._generated[next_fp]
+            fingerprints.insert(0, next_fp)
+            if parent is None:
+                break
+            next_fp = parent
+        return Path.from_fingerprints(self._model, fingerprints)
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: self._reconstruct_path(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }
+
+
+def default_thread_count() -> int:
+    """``num_cpus`` analog for example CLIs (`examples/paxos.rs:336`)."""
+    return os.cpu_count() or 1

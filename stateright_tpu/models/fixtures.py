@@ -136,3 +136,92 @@ class LinearEquation(Model):
             x, y = solution
             return (model.a * x + model.b * y) & 0xFF == model.c
         return [Property.sometimes("solvable", solvable)]
+
+
+class PackedDGraph(DGraph):
+    """A :class:`DGraph` with a packed device encoding, used to pin the
+    ``eventually``-property semantics (including the reference's accepted
+    unsoundness, `src/checker.rs:350-415` / `bfs.rs:239-256`) on the TPU
+    engines.
+
+    The node set is finite and known up front, so the device side is pure
+    table lookup: a sorted node-value array, an out-edge matrix, and
+    property bits PRE-EVALUATED on the host per node — which lets any
+    host predicate ride along unchanged.
+    """
+
+    packed_width = 1
+
+    @staticmethod
+    def with_property(prop: Property) -> "PackedDGraph":
+        return PackedDGraph(prop)
+
+    def with_path(self, path: List[int]) -> "PackedDGraph":
+        g = PackedDGraph(self.prop)
+        g.inits = set(self.inits)
+        g.edges = {k: set(v) for k, v in self.edges.items()}
+        src = path[0]
+        g.inits.add(src)
+        for dst in path[1:]:
+            g.edges.setdefault(src, set()).add(dst)
+            src = dst
+        return g
+
+    _SENTINEL = 0xFFFFFFFF
+
+    def _tables(self):
+        import numpy as np
+
+        nodes = sorted(self.inits | set(self.edges)
+                       | {d for ds in self.edges.values() for d in ds})
+        max_deg = max((len(v) for v in self.edges.values()), default=0)
+        n = len(nodes)
+        edge = np.full((n, max(max_deg, 1)), self._SENTINEL, np.uint32)
+        for i, node in enumerate(nodes):
+            for j, dst in enumerate(sorted(self.edges.get(node, ()))):
+                edge[i, j] = dst
+        pbits = np.array([[bool(p.condition(self, node))
+                           for p in self.properties()]
+                          for node in nodes], bool)
+        return np.asarray(nodes, np.uint32), edge, pbits
+
+    @property
+    def max_actions(self) -> int:
+        return max((len(v) for v in self.edges.values()), default=1)
+
+    def cache_key(self):
+        return ("pdgraph",
+                tuple(sorted(self.inits)),
+                tuple(sorted((k, tuple(sorted(v)))
+                             for k, v in self.edges.items())),
+                self.prop.name, self.prop.expectation)
+
+    def encode(self, state):
+        import numpy as np
+        return np.asarray([state], np.uint32)
+
+    def decode(self, words):
+        return int(words[0])
+
+    def packed_step(self, words):
+        import jax.numpy as jnp
+        nodes, edge, _ = self._tables()
+        nodes_d = jnp.asarray(nodes)
+        edge_d = jnp.asarray(edge)
+        idx = jnp.searchsorted(nodes_d, words[0])
+        idx = jnp.minimum(idx, len(nodes) - 1)
+        succ = edge_d[idx][:, None]
+        valid = succ[:, 0] != jnp.uint32(self._SENTINEL)
+        return succ, valid
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        nodes, _, pbits = self._tables()
+        nodes_d = jnp.asarray(nodes)
+        idx = jnp.searchsorted(nodes_d, words[0])
+        idx = jnp.minimum(idx, len(nodes) - 1)
+        return jnp.asarray(pbits)[idx]
+
+    def fingerprint(self, state) -> int:
+        from ..fingerprint import fp64_words
+        return fp64_words(self.encode(state).tolist())

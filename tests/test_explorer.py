@@ -55,16 +55,27 @@ class TestStateViews:
             assert int(v["fingerprint"]) != 0
 
     def test_ignored_action_rows(self):
-        # LinearEquation init (0,0) with a=2,b=0: IncreaseY loops to a new
-        # state; use a model where next_state returns None: the fixtures'
-        # LinearEquation never no-ops, so craft one via max wraparound —
-        # instead assert the contract on a state whose action leads
-        # somewhere (shape check only; the no-op path is covered by the
-        # actor-model explorer usage below)
-        model = LinearEquation(2, 10, 14)
+        # an actor that ignores a message makes its Deliver a no-op
+        # (next_state -> None): the server must still return the action
+        # row, without "state" (`explorer.rs:224-231`)
+        from stateright_tpu.actor import ActorModel, Id, Out
+        from stateright_tpu.actor.core import Actor, ScriptedActor
+
+        class DeafActor(Actor):
+            def on_start(self, id: Id, o: Out):
+                return 0
+
+            def on_msg(self, id, state, src, msg, o):
+                return None  # ignore everything
+
+        model = (ActorModel(cfg=None)
+                 .actor(DeafActor())
+                 .actor(ScriptedActor([(Id(0), "hello")])))
         init = model.init_states()[0]
         views = state_views(model, [model.fingerprint(init)])
-        assert all("action" in v for v in views)
+        ignored = [v for v in views if "state" not in v]
+        assert ignored, "expected the ignored delivery row"
+        assert all("action" in v for v in ignored)
 
     def test_unknown_fingerprint_404(self):
         model = TwoPhaseSys(2)

@@ -1,0 +1,63 @@
+"""Multi-process host BFS tests: set-equality and verdict parity with the
+sequential engine across model families (the reference's multithreaded
+runs promise the same — `bfs.rs:29-30`, `:138-150`)."""
+
+import pytest
+
+from stateright_tpu.actor.test_util import PingPongCfg
+from stateright_tpu.models.fixtures import DGraph, LinearEquation
+from stateright_tpu.core import Property
+from stateright_tpu.models.twopc import TwoPhaseSys
+
+
+def par(model, n=4):
+    return model.checker().threads(n).spawn_bfs().join()
+
+
+class TestParallelBfs:
+    def test_full_enumeration_matches_sequential(self):
+        model = TwoPhaseSys(5)  # 8,832 (2pc.rs:133)
+        p = par(model)
+        s = TwoPhaseSys(5).checker().spawn_bfs().join()
+        assert p.unique_state_count() == 8832
+        assert p.generated_fingerprints() == s.generated_fingerprints()
+
+    def test_discovery_replays(self):
+        p = par(LinearEquation(2, 10, 14))
+        found = p.assert_any_discovery("solvable")
+        x, y = found.last_state()
+        assert (2 * x + 10 * y) & 0xFF == 14
+
+    def test_actor_model_counts(self):
+        # ping_pong lossless nondup max 5 = 11 states (model.rs:642); the
+        # fixture deliberately includes falsifiable properties, so compare
+        # verdicts with the sequential engine rather than asserting clean
+        model = PingPongCfg(maintains_history=False, max_nat=5).into_model()
+        p = par(model)
+        s = (PingPongCfg(maintains_history=False, max_nat=5).into_model()
+             .checker().spawn_bfs().join())
+        assert p.unique_state_count() == 11
+        assert set(p.discoveries()) == set(s.discoveries())
+
+    def test_eventually_semantics_match(self):
+        def eventually_odd():
+            return Property.eventually("odd", lambda _, s: s % 2 == 1)
+        g = (DGraph.with_property(eventually_odd())
+             .with_path([0, 1]).with_path([0, 2]))
+        p = par(g)
+        assert p.discovery("odd").into_states() == [0, 2]
+        # the fixme pin holds in parallel too (accepted unsoundness)
+        g2 = DGraph.with_property(eventually_odd()).with_path([0, 2, 4, 2])
+        assert par(g2).discovery("odd") is None
+
+    def test_target_state_count(self):
+        p = (LinearEquation(2, 4, 7).checker().threads(2)
+             .target_state_count(500).spawn_bfs().join())
+        assert p.state_count() >= 500
+
+    def test_visitor_falls_back_to_sequential(self):
+        from stateright_tpu.checker.bfs import BfsChecker
+        from stateright_tpu.checker.visitor import StateRecorder
+        ck = (LinearEquation(2, 10, 14).checker().threads(4)
+              .visitor(StateRecorder()).spawn_bfs())
+        assert isinstance(ck, BfsChecker)

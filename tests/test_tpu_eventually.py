@@ -1,0 +1,74 @@
+"""Eventually-property semantics on the TPU engines: the DGraph pins
+(`/root/reference/src/checker.rs:350-415`) — including the documented
+unsoundness for cycles/DAG-rejoins (`bfs.rs:239-256`) — must hold
+identically on both device modes."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.core import Property  # noqa: E402
+from stateright_tpu.models.fixtures import PackedDGraph  # noqa: E402
+
+
+def eventually_odd():
+    return Property.eventually("odd", lambda _, s: s % 2 == 1)
+
+
+def check_tpu(graph, mode):
+    return (graph.checker()
+            .tpu_options(capacity=1 << 10, mode=mode, fmax=16)
+            .spawn_tpu().join())
+
+
+MODES = ["device", "level"]
+
+
+@pytest.mark.parametrize("mode", MODES)
+class TestTpuEventually:
+    def test_can_validate(self, mode):
+        g = (PackedDGraph.with_property(eventually_odd())
+             .with_path([1])
+             .with_path([2, 3])
+             .with_path([2, 6, 7])
+             .with_path([4, 9, 10]))
+        check_tpu(g, mode).assert_properties()
+        check_tpu(PackedDGraph.with_property(eventually_odd())
+                  .with_path([2, 6, 7]), mode).assert_properties()
+
+    def test_can_discover_counterexample(self, mode):
+        c = check_tpu(PackedDGraph.with_property(eventually_odd())
+                      .with_path([0, 1]).with_path([0, 2]), mode)
+        assert c.discovery("odd").into_states() == [0, 2]
+
+        c = check_tpu(PackedDGraph.with_property(eventually_odd())
+                      .with_path([0, 1]).with_path([2, 4]), mode)
+        assert c.discovery("odd").into_states() == [2, 4]
+
+        c = check_tpu(PackedDGraph.with_property(eventually_odd())
+                      .with_path([0, 1, 4, 6]).with_path([2, 4, 8]), mode)
+        # two even terminals (6 via 4, 8 via 4); the engine reports one —
+        # both witnesses are valid (the reference's multithreaded engines
+        # are similarly nondeterministic)
+        states = c.discovery("odd").into_states()
+        assert states in ([2, 4, 6], [2, 4, 8], [0, 1, 4, 6], [0, 1, 4, 8])
+
+    def test_fixme_can_miss_counterexample_when_revisiting_a_state(
+            self, mode):
+        # cycles / DAG rejoins are not treated as terminal — replicate the
+        # reference's accepted unsoundness exactly (checker.rs:402-414)
+        c = check_tpu(PackedDGraph.with_property(eventually_odd())
+                      .with_path([0, 2, 4, 2]), mode)
+        assert c.discovery("odd") is None
+        c = check_tpu(PackedDGraph.with_property(eventually_odd())
+                      .with_path([0, 2, 4]).with_path([1, 4, 6]), mode)
+        assert c.discovery("odd") is None
+
+    def test_differential_with_host(self, mode):
+        # same graph family: device reached set == host reached set
+        g = (PackedDGraph.with_property(eventually_odd())
+             .with_path([0, 1, 4, 6]).with_path([2, 4, 8]))
+        host = g.check()
+        dev = check_tpu(g, mode)
+        assert (dev.generated_fingerprints()
+                == host.generated_fingerprints())
