@@ -22,7 +22,6 @@ from typing import Dict, List, Optional
 from ..core import Expectation
 from .builder import CheckerBuilder
 from .host import HostChecker
-from .path import Path
 
 
 class BfsChecker(HostChecker):
@@ -103,21 +102,3 @@ class BfsChecker(HostChecker):
             if target is not None and self._state_count >= target:
                 return
 
-    def _reconstruct_path(self, fp: int) -> Path:
-        """Walk parent pointers to an init state, then replay forward
-        (`bfs.rs:314-342`)."""
-        fingerprints: deque = deque()
-        next_fp = fp
-        while next_fp in self._generated:
-            parent = self._generated[next_fp]
-            fingerprints.appendleft(next_fp)
-            if parent is None:
-                break
-            next_fp = parent
-        return Path.from_fingerprints(self._model, fingerprints)
-
-    def discoveries(self) -> Dict[str, Path]:
-        return {
-            name: self._reconstruct_path(fp)
-            for name, fp in list(self._discovery_fps.items())
-        }

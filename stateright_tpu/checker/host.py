@@ -39,6 +39,30 @@ class HostChecker(Checker):
         """All visited fingerprints (the dedup record)."""
         return set(self._generated)
 
+    def _reconstruct_path(self, fp: int):
+        """Walk parent pointers to an init state, then replay forward
+        (`bfs.rs:314-342`). Engines whose ``_generated`` maps fingerprint
+        -> parent fingerprint share this."""
+        from collections import deque
+
+        from .path import Path
+
+        fingerprints: deque = deque()
+        next_fp = fp
+        while next_fp in self._generated:
+            parent = self._generated[next_fp]
+            fingerprints.appendleft(next_fp)
+            if parent is None:
+                break
+            next_fp = parent
+        return Path.from_fingerprints(self._model, fingerprints)
+
+    def discoveries(self):
+        return {
+            name: self._reconstruct_path(fp)
+            for name, fp in list(self._discovery_fps.items())
+        }
+
     # --- execution -------------------------------------------------------
     def _run(self) -> None:  # pragma: no cover - overridden
         raise NotImplementedError

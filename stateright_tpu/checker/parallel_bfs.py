@@ -17,20 +17,25 @@ multithreaded runs, which worker wins a discovery (and which parent a
 state records) is nondeterministic; full-enumeration unique counts match
 exactly.
 
+CAVEAT — fork + native threads: the pool forks at checker construction on
+the caller's thread, which avoids forking from the engine's background
+thread; but a process that has already started native threads (e.g. any
+``spawn_tpu`` run initializes XLA) is still fundamentally fork-unsafe per
+POSIX. Construct ``threads(n)`` checkers before touching the device
+engines, or keep host-parallel checking in its own process.
+
 The ``eventually`` semantics ride per-frontier-entry bit sets with the
 same documented caveats as the sequential engines (`bfs.rs:239-256`).
 """
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Any, Dict, FrozenSet, List, Optional, Tuple
 
 from ..core import Expectation
 from .builder import CheckerBuilder
 from .host import HostChecker
-from .path import Path
 
 # worker globals, populated in the parent immediately before the fork so
 # the children inherit them (lambda-laden models cannot pickle). _FORK_LOCK
@@ -121,22 +126,21 @@ class ParallelBfsChecker(HostChecker):
         eventually_idx = frozenset(
             i for i, p in enumerate(properties)
             if p.expectation == Expectation.EVENTUALLY)
-        awaiting = {p.name for p in properties}
-
-        init_states = [s for s in model.init_states()
-                       if model.within_boundary(s)]
-        self._state_count = len(init_states)
-        frontier: List[Tuple[Any, int, FrozenSet[int]]] = []
-        for s in init_states:
-            fp = model.fingerprint(s)
-            if fp not in generated:
-                generated[fp] = None
-                frontier.append((s, fp, eventually_idx))
-        self._unique_state_count = len(generated)
-        if not properties:
-            return
 
         try:
+            init_states = [s for s in model.init_states()
+                           if model.within_boundary(s)]
+            self._state_count = len(init_states)
+            frontier: List[Tuple[Any, int, FrozenSet[int]]] = []
+            for s in init_states:
+                fp = model.fingerprint(s)
+                if fp not in generated:
+                    generated[fp] = None
+                    frontier.append((s, fp, eventually_idx))
+            self._unique_state_count = len(generated)
+            if not properties:
+                return
+
             while frontier:
                 n_blocks = min(len(frontier), self._workers * 4)
                 size = -(-len(frontier) // n_blocks)
@@ -162,24 +166,3 @@ class ParallelBfsChecker(HostChecker):
             self._pool.terminate()
             self._pool.join()
 
-    def _reconstruct_path(self, fp: int) -> Path:
-        fingerprints: list = []
-        next_fp = fp
-        while next_fp in self._generated:
-            parent = self._generated[next_fp]
-            fingerprints.insert(0, next_fp)
-            if parent is None:
-                break
-            next_fp = parent
-        return Path.from_fingerprints(self._model, fingerprints)
-
-    def discoveries(self) -> Dict[str, Path]:
-        return {
-            name: self._reconstruct_path(fp)
-            for name, fp in list(self._discovery_fps.items())
-        }
-
-
-def default_thread_count() -> int:
-    """``num_cpus`` analog for example CLIs (`examples/paxos.rs:336`)."""
-    return os.cpu_count() or 1

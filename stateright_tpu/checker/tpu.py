@@ -953,18 +953,4 @@ class TpuChecker(HostChecker):
 
     def _reconstruct_path(self, fp: int) -> Path:
         self._ensure_mirror()
-        fingerprints: deque = deque()
-        next_fp = fp
-        while next_fp in self._generated:
-            parent = self._generated[next_fp]
-            fingerprints.appendleft(next_fp)
-            if parent is None:
-                break
-            next_fp = parent
-        return Path.from_fingerprints(self._model, fingerprints)
-
-    def discoveries(self) -> Dict[str, Path]:
-        return {
-            name: self._reconstruct_path(fp)
-            for name, fp in list(self._discovery_fps.items())
-        }
+        return super()._reconstruct_path(fp)

@@ -190,11 +190,16 @@ class PackedDGraph(DGraph):
         return max((len(v) for v in self.edges.values()), default=1)
 
     def cache_key(self):
+        # the predicate itself must key the compiled program (its bits are
+        # baked into the pbits table); the cache entry's closure keeps the
+        # condition object alive, so its id cannot be recycled while the
+        # entry exists
         return ("pdgraph",
                 tuple(sorted(self.inits)),
                 tuple(sorted((k, tuple(sorted(v)))
                              for k, v in self.edges.items())),
-                self.prop.name, self.prop.expectation)
+                self.prop.name, self.prop.expectation,
+                id(self.prop.condition))
 
     def encode(self, state):
         import numpy as np

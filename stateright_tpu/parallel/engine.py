@@ -10,10 +10,12 @@ approaches its slice capacity, and finally pulls the per-shard
 reconstruction by replay (TLC-style,
 `/root/reference/src/checker/bfs.rs:314-342`).
 
-Not supported on the sharded engine (use single-chip ``spawn_tpu`` or the
-host engines): per-state visitors and host-evaluated properties — both
-require pulling every new state back each level, defeating the point of a
-device-resident multi-chip loop.
+Host-evaluated properties (e.g. the linearizability search) work like the
+single-chip device engine: each shard's append-only queue prefix is its
+list of owned states, so every chunk each shard dedups its prefix by the
+model's host-property columns on device and the host evaluates each
+distinct key once (merging across shards by key bytes). Per-state visitors
+remain unsupported (a host feature; use the per-level engine).
 """
 
 from __future__ import annotations
@@ -49,10 +51,6 @@ class ShardedTpuChecker(TpuChecker):
             raise ValueError(
                 "visitors are a host feature; use single-chip spawn_tpu "
                 "(per-level mode) or the host engines")
-        if self._host_props:
-            raise NotImplementedError(
-                "host-evaluated properties are not supported on the "
-                "sharded engine; use single-chip spawn_tpu")
         if builder.resume_path_ is not None:
             raise NotImplementedError(
                 "checkpoint resume is not supported on the sharded "
@@ -89,10 +87,13 @@ class ShardedTpuChecker(TpuChecker):
         # below the growth limit (same invariant as the single-chip loop)
         while self._grow_at * (self._capacity // D) <= headroom + n_init:
             self._capacity *= 4
-        qcap = int(opts.get("qcap", self._capacity))
-        qloc = max(qcap // D, n_init, 2 * headroom)
-        qloc = 1 << (qloc - 1).bit_length()  # round up to a power of two
-        qcap = qloc * D
+        qcap = self._sharded_qcap(n_init, headroom, D)
+        # per-shard init fps in queue order (post-hoc witness mapping)
+        init_by_shard: List[List[int]] = [[] for _ in range(D)]
+        for fp in init_fps:
+            init_by_shard[owner_of(fp, D)].append(fp)
+        self._init_by_shard = init_by_shard
+        n_init_arr = np.asarray([len(b) for b in init_by_shard], np.int32)
 
         insert_fn = build_sharded_insert(mesh, axis)
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
@@ -106,6 +107,7 @@ class ShardedTpuChecker(TpuChecker):
 
         import jax.numpy as jnp
 
+        host_prop_idx = {i for i, _p in self._host_props}
         while True:
             closc = self._capacity // D
             grow_limit = np.int32(min(self._grow_at * closc,
@@ -116,15 +118,17 @@ class ShardedTpuChecker(TpuChecker):
             carry = carry._replace(gen=jnp.int32(0),
                                    steps=jnp.int32(k_steps))
             carry = chunk_fn(carry, remaining, grow_limit)
-            (q_size, log_n, disc_hit, disc_hi, disc_lo, gen, ovf,
+            (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo, gen, ovf,
              xovf) = jax.device_get(
-                (carry.q_size, carry.log_n, carry.disc_hit,
+                (carry.q_head, carry.q_tail, carry.log_n, carry.disc_hit,
                  carry.disc_hi, carry.disc_lo, carry.gen, carry.ovf,
                  carry.xovf))
             self._state_count += int(gen)
             self._unique_state_count = n_init + int(log_n.sum())
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
+                if i in host_prop_idx:
+                    continue  # device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
             if bool(xovf):
@@ -135,22 +139,35 @@ class ShardedTpuChecker(TpuChecker):
                     "device hash table probe overflow below the growth "
                     f"limit (capacity {self._capacity}); raise via "
                     "checker_builder.tpu_options(capacity=...)")
-            done = (int(q_size.sum()) == 0
+            if self._host_props and any(
+                    p.name not in discoveries
+                    for _i, p in self._host_props):
+                with self._timed("posthoc"):
+                    self._posthoc_sharded(carry, qcap, n_init_arr,
+                                          discoveries)
+            done = (int((q_tail - q_head).sum()) == 0
                     or len(discoveries) == prop_count
                     or (target is not None
                         and self._state_count >= target))
             if done:
                 break
             need_grow = (int(log_n.max()) >= int(grow_limit)
-                         or int(q_size.max()) > qcap // D - headroom)
+                         or int(q_tail.max()) > qcap // D - headroom)
             if need_grow:
                 carry, qcap = self._grow_sharded(
-                    carry, qcap, headroom, init_fps, insert_fn)
+                    carry, qcap, n_init, headroom, init_fps, insert_fn)
                 chunk_fn = build_sharded_chunk_fn(
                     model, mesh, axis, qcap, self._capacity, fmax)
 
         self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
+
+    def _sharded_qcap(self, n_init: int, headroom: int, d: int) -> int:
+        """Append-only per-shard queues: a shard's tail never exceeds its
+        seed count plus its log growth limit plus one iteration."""
+        closc = self._capacity // d
+        grow_limit = int(min(self._grow_at * closc, closc - headroom))
+        return (n_init + grow_limit + 2 * headroom) * d
 
     # ------------------------------------------------------------------
     def _sharded_bulk_insert(self, insert_fn, key_hi, key_lo,
@@ -178,11 +195,13 @@ class ShardedTpuChecker(TpuChecker):
         return key_hi, key_lo
 
     # ------------------------------------------------------------------
-    def _grow_sharded(self, carry: ShardedCarry, qcap: int, headroom: int,
-                      init_fps: List[int], insert_fn):
-        """Quadruple the sharded table/log (and the queues under pressure):
-        pull the carry, rebuild the host way, re-insert every logged
-        fingerprint into the fresh table slices."""
+    def _grow_sharded(self, carry: ShardedCarry, qcap: int, n_init: int,
+                      headroom: int, init_fps: List[int], insert_fn):
+        """Quadruple the sharded table/log (and resize the queues): pull
+        the carry, rebuild host-side preserving each shard's [0, tail)
+        prefix at its positions (the prefix doubles as the shard's
+        reached-set rows), re-insert every logged fingerprint into the
+        fresh table slices device-side."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -194,16 +213,14 @@ class ShardedTpuChecker(TpuChecker):
             key_hi=None, key_lo=None, ovf=None, go=None)._replace(
             **jax.device_get({
                 f: getattr(carry, f)
-                for f in ("q_rows", "q_eb", "q_head", "q_size",
+                for f in ("q_rows", "q_eb", "q_head", "q_tail",
                           "log_chi", "log_clo", "log_phi", "log_plo",
                           "log_n", "disc_hit", "disc_hi", "disc_lo",
                           "gen", "xovf", "steps")}))
         old_qloc = qcap // D
         old_closc = self._capacity // D
         self._capacity *= 4
-        new_qcap = qcap
-        if int(h.q_size.max()) > old_qloc // 2:
-            new_qcap = qcap * 4
+        new_qcap = self._sharded_qcap(n_init, headroom, D)
         qloc = new_qcap // D
         closc = self._capacity // D
         width = self._model.packed_width
@@ -215,13 +232,11 @@ class ShardedTpuChecker(TpuChecker):
         log_phi = np.zeros((self._capacity,), dtype=np.uint32)
         log_plo = np.zeros((self._capacity,), dtype=np.uint32)
         for s in range(D):
-            size = int(h.q_size[s])
-            head = int(h.q_head[s])
-            idx = (head + np.arange(size)) & (old_qloc - 1)
-            q_rows[s * qloc:s * qloc + size] = \
-                h.q_rows[s * old_qloc:(s + 1) * old_qloc][idx]
-            q_eb[s * qloc:s * qloc + size] = \
-                h.q_eb[s * old_qloc:(s + 1) * old_qloc][idx]
+            tail = int(h.q_tail[s])
+            q_rows[s * qloc:s * qloc + tail] = \
+                h.q_rows[s * old_qloc:s * old_qloc + tail]
+            q_eb[s * qloc:s * qloc + tail] = \
+                h.q_eb[s * old_qloc:s * old_qloc + tail]
             ln = int(h.log_n[s])
             src = slice(s * old_closc, s * old_closc + ln)
             dst = slice(s * closc, s * closc + ln)
@@ -251,8 +266,8 @@ class ShardedTpuChecker(TpuChecker):
         new_carry = ShardedCarry(
             q_rows=jax.device_put(q_rows, sh),
             q_eb=jax.device_put(q_eb, sh),
-            q_head=jax.device_put(np.zeros((D,), np.int32), sh),
-            q_size=jax.device_put(h.q_size, sh),
+            q_head=jax.device_put(h.q_head, sh),
+            q_tail=jax.device_put(h.q_tail, sh),
             key_hi=key_hi, key_lo=key_lo,
             log_chi=d_log_chi, log_clo=d_log_clo,
             log_phi=jax.device_put(log_phi, sh),
@@ -267,6 +282,56 @@ class ShardedTpuChecker(TpuChecker):
             steps=jax.device_put(h.steps, rep),
             go=jax.device_put(np.bool_(False), rep))
         return new_carry, new_qcap
+
+    # ------------------------------------------------------------------
+    def _posthoc_sharded(self, carry: ShardedCarry, qcap: int,
+                         n_init_arr, discoveries: Dict[str, int]) -> None:
+        """Host-property evaluation over each shard's reached set: local
+        device dedup by host-property key, host merge across shards by
+        key bytes (memoized), witness fps from the per-shard queue/log
+        lockstep."""
+        import jax
+
+        from .sharded import build_sharded_posthoc
+
+        mesh, axis = self._mesh, self._axis
+        D = mesh.shape[axis]
+        model = self._model
+        hmax = int(self._tpu_options.get("hmax", 1 << 13))
+        n_init_d = jax.device_put(
+            n_init_arr, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(axis)))
+        while True:
+            fn = build_sharded_posthoc(model, mesh, axis, qcap,
+                                       self._capacity, hmax)
+            (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf, over) = fn(
+                carry.q_rows, carry.q_tail, carry.log_chi, carry.log_clo,
+                n_init_d)
+            hcount, tovf, over = jax.device_get((hcount_d, tovf, over))
+            if bool(tovf):
+                raise RuntimeError(
+                    "device hash table probe overflow during post-hoc "
+                    "reduction; raise tpu_options(capacity=...)")
+            if not bool(over):
+                break
+            hmax *= 2
+        rows_h, src_h, whi_h, wlo_h = jax.device_get(
+            (rows_d, src_d, whi_d, wlo_d))
+        for s in range(D):
+            hc = int(hcount[s])
+            if not hc:
+                continue
+            wfp = _combine64(whi_h[s][:hc], wlo_h[s][:hc])
+            inits = self._init_by_shard[s]
+            for j in range(hc):
+                if all(p.name in discoveries
+                       for _i, p in self._host_props):
+                    return
+                src_j = int(src_h[s][j])
+                fp = (inits[src_j] if src_j < len(inits)
+                      else int(wfp[j]))
+                self._eval_host_props_row(rows_h[s * hmax + j], fp,
+                                          discoveries)
 
     # ------------------------------------------------------------------
     def _finalize_sharded(self, carry: ShardedCarry) -> None:

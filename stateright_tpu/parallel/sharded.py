@@ -59,14 +59,18 @@ class ShardedCarry(NamedTuple):
     """Search state, sharded over the mesh axis unless marked replicated.
 
     Shapes are global; each shard holds the ``1/D`` row-slice. Per-shard
-    scalars (head, size, log length) are length-``D`` vectors whose local
-    view is a one-element array.
+    scalars (head, tail, log length) are length-``D`` vectors whose local
+    view is a one-element array. Queues are **append-only** like the
+    single-chip engine: every state enqueues exactly once on its owner
+    shard, the head only advances, and the per-shard ``[0, tail)`` prefix
+    doubles as the shard's list of every owned state's packed row
+    (post-hoc host-property evaluation, checkpointing).
     """
 
-    q_rows: jax.Array   # uint32[D*qcap, W] per-shard ring queues
-    q_eb: jax.Array     # uint32[D*qcap]    their eventually-bits
-    q_head: jax.Array   # int32[D]          per-shard ring head
-    q_size: jax.Array   # int32[D]          per-shard pending count
+    q_rows: jax.Array   # uint32[D*qloc, W] per-shard append-only queues
+    q_eb: jax.Array     # uint32[D*qloc]    their eventually-bits
+    q_head: jax.Array   # int32[D]          per-shard next row to expand
+    q_tail: jax.Array   # int32[D]          per-shard next free row
     key_hi: jax.Array   # uint32[C]         visited table (C/D per shard)
     key_lo: jax.Array   # uint32[C]
     log_chi: jax.Array  # uint32[C]         child fp, insertion order
@@ -93,7 +97,7 @@ def carry_specs(axis: str) -> ShardedCarry:
     """PartitionSpecs for each carry field."""
     s, r = P(axis), P()
     return ShardedCarry(
-        q_rows=s, q_eb=s, q_head=s, q_size=s, key_hi=s, key_lo=s,
+        q_rows=s, q_eb=s, q_head=s, q_tail=s, key_hi=s, key_lo=s,
         log_chi=s, log_clo=s, log_phi=s, log_plo=s, log_n=s,
         disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
         steps=r, go=r)
@@ -136,41 +140,41 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
     qloc = qcap // D
-    assert qloc & (qloc - 1) == 0, "per-shard queue must be a power of two"
     closc = capacity // D
     assert closc & (closc - 1) == 0, "per-shard table must be a power of two"
-    qmask = qloc - 1
     n_actions = model.max_actions
     properties = model.properties()
     prop_count = len(properties)
     eventually_idx = eventually_indices(properties)
+    host_idx = frozenset(getattr(model, "host_property_indices", ()))
+    device_prop_idx = [i for i in range(prop_count) if i not in host_idx]
     logcap = closc
     # worst case: every child generated machine-wide lands on one shard
     ring_headroom = D * fmax * n_actions
     ring = [(i, (i + 1) % D) for i in range(D)]
 
-    def go_flag(q_size, log_n, disc_hit, gen, ovf, xovf, steps,
+    def go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf, steps,
                 target_remaining, grow_limit):
-        total_q = lax.psum(q_size, axis)
-        max_q = lax.pmax(q_size, axis)
+        total_q = lax.psum(q_tail - q_head, axis)
+        max_tail = lax.pmax(q_tail, axis)
         max_log = lax.pmax(log_n, axis)
         go = ((total_q > 0) & (steps > 0) & ~ovf & ~xovf
               & (gen < target_remaining)
               & (max_log < grow_limit)
-              & (max_q <= qloc - ring_headroom))
-        if prop_count:
-            go = go & ~disc_hit.all()
+              & (max_tail <= qloc - ring_headroom))
+        if device_prop_idx and not host_idx:
+            go = go & ~disc_hit[jnp.array(device_prop_idx)].all()
         return go
 
     def body(state):
         c, target_remaining, grow_limit = state
         me = lax.axis_index(axis).astype(jnp.uint32)
-        q_head, q_size, log_n = c.q_head[0], c.q_size[0], c.log_n[0]
+        q_head, q_tail, log_n = c.q_head[0], c.q_tail[0], c.log_n[0]
 
-        take = jnp.minimum(q_size, fmax)
-        idxs = (q_head + jnp.arange(fmax, dtype=jnp.int32)) & qmask
-        frontier = c.q_rows[idxs]
-        ebits = c.q_eb[idxs]
+        take = jnp.minimum(q_tail - q_head, fmax)
+        frontier = lax.dynamic_slice(c.q_rows, (q_head, 0),
+                                     (fmax, c.q_rows.shape[1]))
+        ebits = lax.dynamic_slice(c.q_eb, (q_head,), (fmax,))
         fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
 
         # shared check_block analog (ops/expand.py) on local rows
@@ -184,8 +188,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         else:
             owner = jnp.zeros_like(exp.chi)
 
-        q_head = (q_head + take) & qmask
-        q_size = q_size - take
+        q_head = q_head + take
         key_hi, key_lo = c.key_hi, c.key_lo
         q_rows, q_eb = c.q_rows, c.q_eb
         log_chi, log_clo = c.log_chi, c.log_clo
@@ -204,8 +207,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             t_ovf = t_ovf | o
             cnt = inserted.sum(dtype=jnp.int32)
             pos = jnp.cumsum(inserted.astype(jnp.int32)) - 1
-            qidx = jnp.where(inserted, (q_head + q_size + pos) & qmask,
-                             qloc)
+            qidx = jnp.where(inserted, q_tail + pos, qloc)
             q_rows = q_rows.at[qidx].set(flat_c, mode="drop")
             q_eb = q_eb.at[qidx].set(ceb_c, mode="drop")
             lidx = jnp.where(inserted, log_n + pos, logcap)
@@ -213,7 +215,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             log_clo = log_clo.at[lidx].set(clo_c, mode="drop")
             log_phi = log_phi.at[lidx].set(phi_c, mode="drop")
             log_plo = log_plo.at[lidx].set(plo_c, mode="drop")
-            q_size = q_size + cnt
+            q_tail = q_tail + cnt
             log_n = log_n + cnt
             if D > 1 and hop < D - 1:
                 rc = tuple(lax.ppermute(x, axis, ring) for x in rc)
@@ -239,11 +241,11 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         ovf = c.ovf | (lax.psum(t_ovf.astype(jnp.int32), axis) > 0)
         xovf = c.xovf | (lax.psum(exp.xovf.astype(jnp.int32), axis) > 0)
         steps = c.steps - 1
-        go = go_flag(q_size, log_n, disc_hit, gen, ovf, xovf, steps,
-                     target_remaining, grow_limit)
+        go = go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf,
+                     steps, target_remaining, grow_limit)
         nc = ShardedCarry(
             q_rows=q_rows, q_eb=q_eb,
-            q_head=q_head[None], q_size=q_size[None],
+            q_head=q_head[None], q_tail=q_tail[None],
             key_hi=key_hi, key_lo=key_lo,
             log_chi=log_chi, log_clo=log_clo,
             log_phi=log_phi, log_plo=log_plo, log_n=log_n[None],
@@ -252,9 +254,9 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         return (nc, target_remaining, grow_limit)
 
     def local_chunk(carry, target_remaining, grow_limit):
-        go = go_flag(carry.q_size[0], carry.log_n[0], carry.disc_hit,
-                     carry.gen, carry.ovf, carry.xovf, carry.steps,
-                     target_remaining, grow_limit)
+        go = go_flag(carry.q_head[0], carry.q_tail[0], carry.log_n[0],
+                     carry.disc_hit, carry.gen, carry.ovf, carry.xovf,
+                     carry.steps, target_remaining, grow_limit)
         out, _, _ = lax.while_loop(
             lambda s: s[0].go, body,
             (carry._replace(go=go), target_remaining, grow_limit))
@@ -322,6 +324,59 @@ def owner_of(fp: int, d: int) -> int:
     return (fp >> (64 - kbits)) if kbits else 0
 
 
+def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
+                          capacity: int, hmax: int):
+    """Per-shard post-hoc reduction for host-evaluated properties: each
+    shard dedups its own queue prefix by the model's host-property
+    columns and emits up to ``hmax`` representative rows plus witness
+    fingerprints. Distinct keys may repeat across shards (each shard
+    dedups locally); the host merges by key bytes — at most a D-fold
+    overcount on the wire for a cross-shard-popular history."""
+    from ..checker.device_loop import model_cache_key, shrink_indices
+    from ..ops.hash_kernel import fp64_device
+
+    D = mesh.shape[axis]
+    qloc = qcap // D
+    closc = capacity // D
+    cols = getattr(model, "host_property_cols", None)
+    off, hw = cols if cols is not None else (0, model.packed_width)
+    mkey = model_cache_key(model)
+    key = None
+    if mkey is not None:
+        key = ("posthoc", mkey, mesh, axis, qcap, capacity, hmax)
+        cached = _SHARDED_CACHE.get(key)
+        if cached is not None:
+            return cached
+
+    def local(q_rows, q_tail, log_chi, log_clo, n_init):
+        key_cols = q_rows[:, off:off + hw]
+        hhi, hlo = fp64_device(key_cols)
+        valid = jnp.arange(qloc, dtype=jnp.int32) < q_tail[0]
+        khi = jnp.zeros((closc,), jnp.uint32)
+        klo = jnp.zeros((closc,), jnp.uint32)
+        inserted, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
+        hcount = inserted.sum(dtype=jnp.int32)
+        src = shrink_indices(inserted, hmax)
+        out_rows = q_rows[src]
+        li = jnp.maximum(src - n_init[0], 0)
+        w_hi = log_chi[li]
+        w_lo = log_clo[li]
+        tovf = lax.psum(ovf.astype(jnp.int32), axis) > 0
+        over = lax.psum((hcount > hmax).astype(jnp.int32), axis) > 0
+        return (out_rows, src[None, :], w_hi[None, :], w_lo[None, :],
+                hcount[None], tovf, over)
+
+    s = P(axis)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(s, s, s, s, s),
+        out_specs=(s, s, s, s, s, P(), P()), check_vma=False)
+    fn = jax.jit(fn)
+    if key is not None:
+        _SHARDED_CACHE[key] = fn
+    return fn
+
+
 def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                        capacity: int, init_rows, init_fps, full_ebits,
                        prop_count: int) -> ShardedCarry:
@@ -333,13 +388,13 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
     width = model.packed_width
     q_rows = np.zeros((qcap, width), dtype=np.uint32)
     q_eb = np.zeros((qcap,), dtype=np.uint32)
-    q_size = np.zeros((D,), dtype=np.int32)
+    q_tail = np.zeros((D,), dtype=np.int32)
     for row, fp in zip(init_rows, init_fps):
         s = owner_of(fp, D)
-        assert q_size[s] < qloc, "init states overflow a shard queue"
-        q_rows[s * qloc + q_size[s]] = row
-        q_eb[s * qloc + q_size[s]] = full_ebits
-        q_size[s] += 1
+        assert q_tail[s] < qloc, "init states overflow a shard queue"
+        q_rows[s * qloc + q_tail[s]] = row
+        q_eb[s * qloc + q_tail[s]] = full_ebits
+        q_tail[s] += 1
 
     sh = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
@@ -350,7 +405,7 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
     return ShardedCarry(
         q_rows=put(q_rows, sh), q_eb=put(q_eb, sh),
         q_head=put(np.zeros((D,), np.int32), sh),
-        q_size=put(q_size, sh),
+        q_tail=put(q_tail, sh),
         key_hi=put(np.zeros((capacity,), np.uint32), sh),
         key_lo=put(np.zeros((capacity,), np.uint32), sh),
         log_chi=put(np.zeros((capacity,), np.uint32), sh),

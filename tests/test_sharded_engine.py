@@ -117,3 +117,37 @@ class TestShardedModelOverflowFatal:
         model = _OverflowingEquation(2, 0, 10**9)
         with pytest.raises(RuntimeError, match="capacity overflow"):
             _sharded_checker(model, 2, capacity=1 << 12, fmax=32)
+
+
+class TestShardedHostProps:
+    """Host-evaluated properties on the multi-chip engine: paxos — the
+    flagship combination (linearizability checked over distinct histories
+    per shard, merged on the host)."""
+
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_paxos_n1_265(self, n_shards):
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+        ck = (PackedPaxos(1).checker()
+              .tpu_options(mesh=_mesh(n_shards), capacity=1 << 12,
+                           fmax=64)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 265
+        ck.assert_properties()
+        assert ck.discovery("value chosen") is not None
+        # witness replays through the host model
+        path = ck.discoveries()["value chosen"]
+        assert len(path.into_actions()) >= 1
+
+    def test_host_prop_violation_found(self):
+        # the 2-server single-copy register linearizability violation must
+        # surface on the sharded engine too (packed via paxos machinery is
+        # unavailable; use the synthetic host-prop model)
+        from test_tpu_engine import _HostPropEquation
+        model = _HostPropEquation(2, 0, 10**9)
+        ck = (model.checker()
+              .tpu_options(mesh=_mesh(2), capacity=1 << 12, fmax=16,
+                           chunk_steps=4)
+              .spawn_tpu().join())
+        path = ck.assert_any_discovery("x small")
+        assert path.last_state()[0] > 3
+        assert ck.unique_state_count() < 20000  # early exit
