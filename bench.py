@@ -72,7 +72,7 @@ def context_2pc() -> None:
     def run():
         t0 = time.perf_counter()
         ck = (TwoPhaseSys(7).checker()
-              .tpu_options(capacity=1 << 22, fmax=1 << 11)
+              .tpu_options(capacity=1 << 22)
               .spawn_tpu().join())
         return time.perf_counter() - t0, ck.unique_state_count()
 

@@ -211,18 +211,23 @@ class PackedActorModel(ActorModel, PackedModel):
         return jnp.stack((out[-1],) + out[1:self._sw], axis=1)
 
     def _net_consume(self, slots, e):
-        """Deliver slot ``e``: decrement its count, freeing it at zero."""
+        """Deliver slot ``e``: decrement its count, freeing it at zero.
+
+        Mask arithmetic only — under ``vmap`` inside the engine's device
+        loop, dynamic-index row updates are the expensive primitive."""
         import jax.numpy as jnp
-        count = slots[e, 1]
+        rowsel = jnp.arange(self.net_capacity) == e
+        count = jnp.where(rowsel, slots[:, 1], 0).sum()
         emptied = count <= 1
-        new_slot = jnp.where(emptied,
-                             jnp.zeros((self._sw,), jnp.uint32),
-                             slots[e].at[1].set(count - 1))
-        return slots.at[e].set(new_slot)
+        col1 = jnp.where(rowsel, slots[:, 1] - 1, slots[:, 1])
+        slots = slots.at[:, 1].set(col1)  # static column: cheap
+        return jnp.where((rowsel & emptied)[:, None],
+                         jnp.uint32(0), slots)
 
     def _net_send(self, slots, src, dst, msg, valid):
         """Send one envelope: bump the matching slot's count or claim the
-        first empty slot. Returns (slots, overflowed)."""
+        first empty slot. Returns (slots, overflowed). Mask arithmetic
+        only (see ``_net_consume``)."""
         import jax.numpy as jnp
         hdr = jnp.uint32(_OCC) | (src.astype(jnp.uint32) << 8) \
             | dst.astype(jnp.uint32)
@@ -236,13 +241,14 @@ class PackedActorModel(ActorModel, PackedModel):
         new_slot = jnp.concatenate(
             [jnp.stack([hdr, jnp.uint32(1)]), msg.astype(jnp.uint32)])
         target = jnp.where(has_match, match_idx, empty_idx)
-        updated = jnp.where(
-            has_match,
-            slots[target].at[1].set(slots[target, 1] + 1),
-            new_slot)
         do_write = valid & (has_match | has_empty)
-        slots = slots.at[target].set(
-            jnp.where(do_write, updated, slots[target]))
+        rowsel = (jnp.arange(self.net_capacity) == target) & do_write
+        # matched: bump the count column; fresh: write the whole row
+        col1 = jnp.where(rowsel & has_match, slots[:, 1] + 1,
+                         slots[:, 1])
+        slots = slots.at[:, 1].set(col1)
+        slots = jnp.where((rowsel & ~has_match)[:, None],
+                          new_slot[None, :], slots)
         overflowed = valid & ~has_match & ~has_empty
         return slots, overflowed
 
@@ -276,12 +282,15 @@ class PackedActorModel(ActorModel, PackedModel):
         def one_action(e):
             # the action axis is vmapped (not unrolled): one traced copy
             # of the delivery body serves all E slots, which keeps the
-            # XLA graph — and compile time — independent of net_capacity
-            hdr = slots[e, 0]
+            # XLA graph — and compile time — independent of net_capacity.
+            # The slot row is read by masked sum, not dynamic gather.
+            rowsel = (jnp.arange(e_cap) == e).astype(jnp.uint32)
+            row = (slots * rowsel[:, None]).sum(axis=0)
+            hdr = row[0]
             occupied = (hdr & _OCC) != 0
             src = (hdr >> 8) & 0xFF
             dst = hdr & 0xFF
-            msg = slots[e, 2:]
+            msg = row[2:]
             new_actors, changed, sends = self.packed_deliver(
                 actors, src, dst, msg)
             assert len(sends) == self.max_sends

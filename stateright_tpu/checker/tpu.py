@@ -366,11 +366,13 @@ class TpuChecker(HostChecker):
         host_prop_idx = {i for i, _p in self._host_props}
         target = self._target_state_count
         opts = self._tpu_options
-        # default expansion width targets ~350k child lane-words per
+        # default expansion width targets ~8M child lane-words per
         # iteration — empirically the knee of the lane-cost curve across
-        # model shapes (narrow 2pc, wide packed-actor states)
-        auto_fmax = max(256, min(
-            1 << 13, 350_000 // (model.max_actions * model.packed_width)))
+        # model shapes (narrow 2pc, wide packed-actor states) now that
+        # handlers are mask-arithmetic rather than dynamic-indexed
+        auto_fmax = max(1 << 10, min(
+            1 << 13,
+            (1 << 23) // (model.max_actions * model.packed_width)))
         fmax = int(opts.get("fmax", auto_fmax))
         fa = fmax * model.max_actions
         kmax = min(int(opts.get("kmax", max(1 << 12, fa // 2))), fa)

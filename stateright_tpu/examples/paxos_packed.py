@@ -466,7 +466,8 @@ class PackedPaxos(PackedActorModel):
         nw0 = jnp.where(put_ok, nb, nw0)  # accepts/decided bits cleared
         nw1 = jnp.where(put_ok, put_proposal, nw1)
         self_entry = (jnp.uint32(1) << 27) | (accepted & 0x7FFFFFF)
-        put_preps = jnp.zeros((s,), jnp.uint32).at[sid].set(self_entry)
+        put_preps = jnp.where(jnp.arange(s, dtype=jnp.uint32) == sid,
+                              self_entry, jnp.uint32(0))
         npreps = jnp.where(put_ok, put_preps, npreps)
         prepare_msg = jnp.stack([(jnp.uint32(T_PREPARE) << 24) | nb,
                                  jnp.uint32(0)])
@@ -482,8 +483,8 @@ class PackedPaxos(PackedActorModel):
         # --- Prepared (paxos.rs:116-138) --------------------------------
         prpd_ok = live & (mtype == T_PREPARED) & (b == ballot)
         entry = (jnp.uint32(1) << 27) | (c & 0x7FFFFFF)
-        npreps = jnp.where(
-            prpd_ok, npreps.at[srv_src].set(entry), npreps)
+        src_sel = jnp.arange(s, dtype=jnp.uint32) == srv_src
+        npreps = jnp.where(prpd_ok & src_sel, entry, npreps)
         present = (npreps >> 27) & 1
         count = present.sum()
         la_all = jnp.where(present.astype(bool), npreps & 0x7FFFFFF,
@@ -585,28 +586,39 @@ class PackedPaxos(PackedActorModel):
     def packed_deliver(self, actors, src, dst, msg):
         """Dynamic dispatch on the traced ``dst``: one server-handler and
         one client-handler instance in the graph, with the destination's
-        state gathered/scattered by dynamic slice."""
-        import jax
+        state read and written via one-hot mask arithmetic (dynamic
+        slices are the expensive primitive under vmap in the engine's
+        device loop)."""
         import jax.numpy as jnp
         s = self.server_count
         sw = self._server_w
         dst = dst.astype(jnp.uint32)
         is_server = dst < s
+        iota = jnp.arange(self._aw, dtype=jnp.int32)
 
         sidx = jnp.minimum(dst, s - 1)
         s_off = (sidx * sw).astype(jnp.int32)
-        s_words = jax.lax.dynamic_slice(actors, (s_off,), (sw,))
+        # one (aw, sw) one-hot encodes the server span mapping for both
+        # the read (gather) and the write-back (scatter) below
+        onehot = iota[:, None] == (s_off + jnp.arange(sw)[None, :])
+        s_words = (jnp.where(onehot, actors[:, None], 0)
+                   .sum(axis=0).astype(jnp.uint32))
         n_sw, s_ch, s_snds = self._server_step(sidx, s_words, src, msg)
 
         cidx = jnp.clip(dst.astype(jnp.int32) - s, 0,
                         self.client_count - 1)
         c_off = (s * sw + cidx).astype(jnp.int32)
-        c_words = jax.lax.dynamic_slice(actors, (c_off,), (1,))
+        c_words = jnp.where(iota == c_off, actors, 0).sum()[None].astype(
+            jnp.uint32)
         n_cw, c_ch, c_snds = self._client_step(cidx + s, c_words, src,
                                                msg)
 
-        upd_server = jax.lax.dynamic_update_slice(actors, n_sw, (s_off,))
-        upd_client = jax.lax.dynamic_update_slice(actors, n_cw, (c_off,))
+        # write-back via the same one-hot: position i takes n_sw[i - s_off]
+        # inside the server span (resp. n_cw at c_off), else keeps its word
+        span = onehot.any(axis=1)
+        scatter_sw = (jnp.where(onehot, n_sw[None, :], 0)).sum(axis=1)
+        upd_server = jnp.where(span, scatter_sw, actors)
+        upd_client = jnp.where(iota == c_off, n_cw[0], actors)
         new_actors = jnp.where(is_server, upd_server, upd_client)
         changed = jnp.where(is_server, s_ch, c_ch)
         sends = []
