@@ -203,6 +203,17 @@ def _level_helpers():
     return _LEVEL_HELPERS
 
 
+def auto_fmax(model, shards: int = 1) -> int:
+    """Default expansion width: ~8M child lane-words per iteration
+    (divided across shards) — empirically the knee of the lane-cost curve
+    across model shapes (narrow 2pc, wide packed-actor states) with
+    mask-arithmetic handlers. Shared by the single-chip and sharded
+    engines so the knee is tuned in one place."""
+    return max(1 << 8, min(
+        1 << 13,
+        (1 << 23) // (model.max_actions * model.packed_width * shards)))
+
+
 def _enable_compile_cache() -> None:
     """Point JAX's persistent compilation cache somewhere sane (unless the
     user already configured one). Engine shapes recur across processes —
@@ -366,14 +377,7 @@ class TpuChecker(HostChecker):
         host_prop_idx = {i for i, _p in self._host_props}
         target = self._target_state_count
         opts = self._tpu_options
-        # default expansion width targets ~8M child lane-words per
-        # iteration — empirically the knee of the lane-cost curve across
-        # model shapes (narrow 2pc, wide packed-actor states) now that
-        # handlers are mask-arithmetic rather than dynamic-indexed
-        auto_fmax = max(1 << 10, min(
-            1 << 13,
-            (1 << 23) // (model.max_actions * model.packed_width)))
-        fmax = int(opts.get("fmax", auto_fmax))
+        fmax = int(opts.get("fmax", auto_fmax(model)))
         fa = fmax * model.max_actions
         kmax = min(int(opts.get("kmax", max(1 << 12, fa // 2))), fa)
         k_steps = int(opts.get("chunk_steps", 64))

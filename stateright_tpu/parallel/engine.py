@@ -81,7 +81,8 @@ class ShardedTpuChecker(TpuChecker):
         if prop_count == 0:
             return  # vacuously done (bfs.rs:121-128)
 
-        fmax = int(opts.get("fmax", max(256, (1 << 13) // D)))
+        from ..checker.tpu import auto_fmax
+        fmax = int(opts.get("fmax", auto_fmax(model, shards=D)))
         headroom = D * fmax * n_actions
         # per-shard slice must keep one worst-case iteration of headroom
         # below the growth limit (same invariant as the single-chip loop)

@@ -151,3 +151,24 @@ class TestShardedHostProps:
         path = ck.assert_any_discovery("x small")
         assert path.last_state()[0] > 3
         assert ck.unique_state_count() < 20000  # early exit
+
+
+class TestShardedEventually:
+    def test_eventually_pins_on_mesh(self):
+        from stateright_tpu.core import Property
+        from stateright_tpu.models.fixtures import PackedDGraph
+
+        def eventually_odd():
+            return Property.eventually("odd", lambda _, s: s % 2 == 1)
+
+        c = (PackedDGraph.with_property(eventually_odd())
+             .with_path([0, 1]).with_path([0, 2]).checker()
+             .tpu_options(mesh=_mesh(2), capacity=1 << 10, fmax=16)
+             .spawn_tpu().join())
+        assert c.discovery("odd").into_states() == [0, 2]
+        # the accepted cycle unsoundness holds SPMD too
+        c2 = (PackedDGraph.with_property(eventually_odd())
+              .with_path([0, 2, 4, 2]).checker()
+              .tpu_options(mesh=_mesh(2), capacity=1 << 10, fmax=16)
+              .spawn_tpu().join())
+        assert c2.discovery("odd") is None

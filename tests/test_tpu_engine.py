@@ -348,3 +348,11 @@ class TestPosthocHostProps:
         assert ck.unique_state_count() == 65536
         assert ck.discovery("x small") is not None
         assert ck.discovery("solvable") is None
+
+
+@pytest.mark.slow
+def test_packed_contract_2pc_n5_full():
+    """Full 8,832-state contract check (2pc.rs:133): every reachable
+    state's encode/decode round-trip, device fingerprint, and packed
+    successors against the host model."""
+    assert validate_packed_model(TwoPhaseSys(5), max_states=10_000) == 8832
