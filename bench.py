@@ -99,12 +99,40 @@ def context_counterexample() -> None:
           file=sys.stderr)
 
 
+def context_remaining_configs() -> None:
+    """The rest of BASELINE.md's tracked configs, one line each."""
+    from stateright_tpu.actor.network import Network
+    from stateright_tpu.examples.increment_lock import IncrementLock
+    from stateright_tpu.examples.linearizable_register import AbdModelCfg
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        ck = fn()
+        return time.perf_counter() - t0, ck
+
+    timed(lambda: IncrementLock(3).checker()
+          .tpu_options(capacity=1 << 14).spawn_tpu().join())
+    dt, ck = timed(lambda: IncrementLock(3).checker()
+                   .tpu_options(capacity=1 << 14).spawn_tpu().join())
+    print(f"# tpu increment_lock 3: {ck.unique_state_count()} states in "
+          f"{dt:.2f}s", file=sys.stderr)
+
+    dt, ck = timed(lambda: AbdModelCfg(
+        client_count=2, server_count=3,
+        network=Network.new_ordered()).into_model()
+        .checker().spawn_bfs().join())
+    print(f"# host linearizable-register check 2 ordered: "
+          f"{ck.unique_state_count()} states in {dt:.2f}s",
+          file=sys.stderr)
+
+
 def main() -> None:
     host_rate = host_paxos_rate()
     tpu_rate = tpu_paxos_rate()
     try:
         context_2pc()
         context_counterexample()
+        context_remaining_configs()
     except Exception as exc:  # context only; never break the contract line
         print(f"# context benches failed: {exc}", file=sys.stderr)
     print(json.dumps({

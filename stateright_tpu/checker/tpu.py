@@ -208,8 +208,11 @@ def auto_fmax(model, shards: int = 1) -> int:
     (divided across shards) — empirically the knee of the lane-cost curve
     across model shapes (narrow 2pc, wide packed-actor states) with
     mask-arithmetic handlers. Shared by the single-chip and sharded
-    engines so the knee is tuned in one place."""
-    return max(1 << 8, min(
+    engines so the knee is tuned in one place. The floor (1024 rows on a
+    single chip, divided across shards down to 256) keeps enough frontier
+    rows per iteration to amortize the fixed per-iteration cost on very
+    wide models."""
+    return max(max(256, (1 << 10) // shards), min(
         1 << 13,
         (1 << 23) // (model.max_actions * model.packed_width * shards)))
 

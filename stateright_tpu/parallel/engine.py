@@ -25,7 +25,7 @@ from typing import Dict, List
 import numpy as np
 
 from ..checker.builder import CheckerBuilder
-from ..checker.tpu import TpuChecker, _combine64
+from ..checker.tpu import TpuChecker, _combine64, auto_fmax
 from .sharded import (ShardedCarry, build_sharded_chunk_fn,
                       build_sharded_insert, owner_of, seed_sharded_carry)
 
@@ -81,7 +81,6 @@ class ShardedTpuChecker(TpuChecker):
         if prop_count == 0:
             return  # vacuously done (bfs.rs:121-128)
 
-        from ..checker.tpu import auto_fmax
         fmax = int(opts.get("fmax", auto_fmax(model, shards=D)))
         headroom = D * fmax * n_actions
         # per-shard slice must keep one worst-case iteration of headroom
