@@ -1,0 +1,422 @@
+"""Shared packing for register-protocol actor systems on the device
+engine.
+
+Every reference register workload (paxos, ABD, single-copy) combines the
+same three ingredients: ``RegisterClient`` test clients
+(`/root/reference/src/actor/register.rs:127-216`), the
+``Put``/``Get``/``PutOk``/``GetOk`` message vocabulary, and a
+``LinearizabilityTester`` history over a ``Register``. This base class
+packs all three once — client state slots, register message codecs, the
+tester's packed word layout with its device-side record hooks, the
+one-hot ``packed_deliver`` dispatch, and the shared device properties
+(host-evaluated ``linearizable`` + device-scanned ``value chosen``) — so
+a protocol only supplies its server packing and its masked server-step
+kernel. ``PackedPaxos`` and ``PackedAbd`` are the in-tree instances.
+
+Clients are ``put_count=1`` (one put then one get), matching the
+reference examples; history packing relies on the resulting <=2
+completed ops per thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..core import Expectation
+from ..semantics import LinearizabilityTester, Register
+from ..semantics.register import Read as ReadOp, ReadOk, Write as WriteOp, \
+    WriteOk
+from .core import Id
+from .network import Network
+from .packed import PackedActorModel
+from .register import (Get, GetOk, Internal, Put, PutOk, RegisterClient,
+                       RegisterServer, record_invocations, record_returns)
+
+# register message type tags; protocol-internal tags start at T_INTERNAL0
+T_PUT, T_GET, T_PUTOK, T_GETOK = 1, 2, 3, 4
+T_INTERNAL0 = 5
+
+
+def val_code(value: Any) -> int:
+    if value == '\0':
+        return 0
+    code = ord(value) - ord('A') + 1
+    assert 1 <= code <= 15, f"value out of packed range: {value!r}"
+    return code
+
+
+def val_char(code: int) -> str:
+    return '\0' if code == 0 else chr(ord('A') + code - 1)
+
+
+class PackedRegisterModel(PackedActorModel):
+    """Base for packed register-protocol systems.
+
+    Subclasses implement: ``encode_server(state) -> List[int]`` /
+    ``decode_server(words)`` (the unwrapped server actor state),
+    ``encode_internal(msg) -> List[int]`` / ``decode_internal(words)``
+    (protocol messages, 2 words), ``_server_step(sid, words, src, msg)``
+    (the masked JAX kernel), and ``cache_key``.
+    """
+
+    def _init_register(self, client_count: int, server_count: int,
+                       server_actor, server_width: int,
+                       net_capacity: int, max_sends: int) -> None:
+        """``server_actor`` is a factory ``(index) -> Actor`` (protocols
+        typically pass each server its peer list)."""
+        assert server_count <= 4, "accepts masks pack up to 4 servers"
+        assert client_count <= 7, "last-completed codes pack up to 7 peers"
+        super().__init__(cfg=self,
+                         init_history=LinearizabilityTester(Register('\0')))
+        self.client_count = client_count
+        self.server_count = server_count
+        self._server_w = server_width
+        for i in range(server_count):
+            self.actor(RegisterServer(server_actor(i)))
+        for _ in range(client_count):
+            self.actor(RegisterClient(put_count=1,
+                                      server_count=server_count))
+        self.init_network(Network.new_unordered_nonduplicating())
+
+        def value_chosen(_model, state):
+            for env in state.network.iter_deliverable():
+                if isinstance(env.msg, GetOk) and env.msg.value != '\0':
+                    return True
+            return False
+
+        self.property(Expectation.ALWAYS, "linearizable",
+                      lambda _, state:
+                      state.history.serialized_history() is not None)
+        self.property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        self.record_msg_in(record_returns)
+        self.record_msg_out(record_invocations)
+
+        self.actor_widths = [server_width] * server_count \
+            + [1] * client_count
+        self.msg_width = 2
+        self.net_capacity = net_capacity
+        self.history_width = 1 + 3 * client_count
+        self.max_sends = max_sends
+        self.host_property_indices = (0,)  # linearizable
+        self.finalize_layout()
+
+    # --- subclass interface ----------------------------------------------
+    def encode_server(self, state: Any) -> List[int]:
+        raise NotImplementedError
+
+    def decode_server(self, words: List[int]) -> Any:
+        raise NotImplementedError
+
+    def encode_internal(self, msg: Any) -> List[int]:
+        raise NotImplementedError
+
+    def decode_internal(self, words: List[int]) -> Any:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # actor state packing (client part shared)
+    # ------------------------------------------------------------------
+    def encode_actor(self, index: int, state: Any) -> List[int]:
+        if index < self.server_count:
+            return self.encode_server(state.state)  # unwrap ServerState
+        c = state  # ClientState
+        w = (c.op_count & 0xF)
+        if c.awaiting is not None:
+            w |= (1 << 31) | (c.awaiting << 8)
+        return [w]
+
+    def decode_actor(self, index: int, words: List[int]) -> Any:
+        from .register import ClientState, ServerState
+        if index < self.server_count:
+            return ServerState(self.decode_server(words))
+        w = words[0]
+        awaiting = (w >> 8) & 0xFF if (w >> 31) & 1 else None
+        return ClientState(awaiting=awaiting, op_count=w & 0xF)
+
+    # ------------------------------------------------------------------
+    # message packing: [type<<24 | request_id<<12 | b, c]
+    # ------------------------------------------------------------------
+    def encode_msg(self, msg: Any) -> List[int]:
+        if isinstance(msg, Put):
+            return [(T_PUT << 24) | (msg.request_id << 12)
+                    | val_code(msg.value), 0]
+        if isinstance(msg, Get):
+            return [(T_GET << 24) | (msg.request_id << 12), 0]
+        if isinstance(msg, PutOk):
+            return [(T_PUTOK << 24) | (msg.request_id << 12), 0]
+        if isinstance(msg, GetOk):
+            return [(T_GETOK << 24) | (msg.request_id << 12)
+                    | val_code(msg.value), 0]
+        assert isinstance(msg, Internal)
+        return self.encode_internal(msg.msg)
+
+    def decode_msg(self, words: List[int]) -> Any:
+        w0 = words[0]
+        mtype = w0 >> 24
+        a = (w0 >> 12) & 0xFFF
+        b = w0 & 0xFFF
+        if mtype == T_PUT:
+            return Put(a, val_char(b & 0xF))
+        if mtype == T_GET:
+            return Get(a)
+        if mtype == T_PUTOK:
+            return PutOk(a)
+        if mtype == T_GETOK:
+            return GetOk(a, val_char(b & 0xF))
+        return Internal(self.decode_internal(words))
+
+    # ------------------------------------------------------------------
+    # history packing (LinearizabilityTester over Register)
+    # ------------------------------------------------------------------
+    def _lc_bits(self, thread: int, lc: dict) -> int:
+        """2-bit completed-count codes for each peer of ``thread``."""
+        bits = 0
+        pos = 0
+        s = self.server_count
+        for peer in range(self.client_count):
+            if peer == thread:
+                continue
+            idx = lc.get(Id(s + peer))
+            code = 0 if idx is None else idx + 1
+            bits |= code << (2 * pos)
+            pos += 1
+        return bits
+
+    def _lc_dict(self, thread: int, bits: int) -> dict:
+        lc = {}
+        pos = 0
+        s = self.server_count
+        for peer in range(self.client_count):
+            if peer == thread:
+                continue
+            code = (bits >> (2 * pos)) & 3
+            if code:
+                lc[Id(s + peer)] = code - 1
+            pos += 1
+        return lc
+
+    @staticmethod
+    def _entry_word(lc_bits: int, op, ret) -> int:
+        kind = int(isinstance(op, ReadOp))
+        opval = 0 if kind else val_code(op.value)
+        retval = val_code(ret.value) if isinstance(ret, ReadOk) else 0
+        return (1 << 31) | (kind << 30) | (opval << 26) | (retval << 22) \
+            | lc_bits
+
+    def encode_history(self, history: LinearizabilityTester) -> List[int]:
+        words = [int(history._valid)]
+        s = self.server_count
+        for t in range(self.client_count):
+            tid = Id(s + t)
+            entries = history._history.get(tid, [])
+            assert len(entries) <= 2, "put_count=1 clients do <=2 ops"
+            e = [0, 0]
+            for k, (lc, op, ret) in enumerate(entries):
+                e[k] = self._entry_word(self._lc_bits(t, lc), op, ret)
+            inflight = 0
+            if tid in history._in_flight:
+                lc, op = history._in_flight[tid]
+                kind = int(isinstance(op, ReadOp))
+                opval = 0 if kind else val_code(op.value)
+                inflight = (1 << 31) | (kind << 30) | (opval << 26) \
+                    | self._lc_bits(t, lc)
+            words.extend([e[0], e[1], inflight])
+        return words
+
+    def decode_history(self, words: List[int]) -> LinearizabilityTester:
+        tester = LinearizabilityTester(Register('\0'))
+        tester._valid = bool(words[0] & 1)
+        s = self.server_count
+        for t in range(self.client_count):
+            tid = Id(s + t)
+            e0, e1, inflight = words[1 + 3 * t: 4 + 3 * t]
+            entries = []
+            for w in (e0, e1):
+                if not (w >> 31) & 1:
+                    continue
+                kind = (w >> 30) & 1
+                opval = (w >> 26) & 0xF
+                retval = (w >> 22) & 0xF
+                op = ReadOp() if kind else WriteOp(val_char(opval))
+                ret = ReadOk(val_char(retval)) if kind else WriteOk()
+                entries.append((self._lc_dict(t, w & 0x3FFF), op, ret))
+            if entries:
+                tester._history[tid] = entries
+            if (inflight >> 31) & 1:
+                kind = (inflight >> 30) & 1
+                opval = (inflight >> 26) & 0xF
+                op = ReadOp() if kind else WriteOp(val_char(opval))
+                tester._in_flight[tid] = (
+                    self._lc_dict(t, inflight & 0x3FFF), op)
+                tester._history.setdefault(tid, [])
+        return tester
+
+    def host_property_key(self, row) -> bytes:
+        """The linearizable property depends only on the history words."""
+        import numpy as np
+        return np.asarray(row[self._hist_off:], dtype=np.uint32).tobytes()
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        # index 0 "linearizable" is host-evaluated: neutral True
+        chosen = jnp.bool_(False)
+        for e in range(self.net_capacity):
+            off = self._net_off + e * self._sw
+            hdr = words[off]
+            m0 = words[off + 2]
+            occupied = (hdr >> 16) & 1
+            is_getok = (m0 >> 24) == T_GETOK
+            has_value = (m0 & 0xF) != 0
+            chosen = chosen | (occupied.astype(bool) & is_getok
+                               & has_value)
+        return jnp.stack([jnp.bool_(True), chosen])
+
+    # ------------------------------------------------------------------
+    # device kernels (history record hooks, client FSM, dispatch)
+    # ------------------------------------------------------------------
+    def _peer_counts(self, hist, thread: int):
+        """Packed last-completed codes for ``thread`` from current
+        per-peer completed counts (mirrors ``on_invoke``,
+        `linearizability.rs:102-125`)."""
+        import jax.numpy as jnp
+        bits = jnp.uint32(0)
+        pos = 0
+        for peer in range(self.client_count):
+            if peer == thread:
+                continue
+            e0 = hist[1 + 3 * peer]
+            e1 = hist[2 + 3 * peer]
+            count = ((e0 >> 31) & 1) + ((e1 >> 31) & 1)
+            bits = bits | (count.astype(jnp.uint32) << (2 * pos))
+            pos += 1
+        return bits
+
+    def packed_record_out(self, hist, src, dst, msg):
+        """``record_invocations``: Put -> Write invoke, Get -> Read."""
+        import jax.numpy as jnp
+        mtype = msg[0] >> 24
+        is_put = mtype == T_PUT
+        applies = is_put | (mtype == T_GET)
+        valid = (hist[0] & 1).astype(bool)
+        s = self.server_count
+        new = hist
+        for t in range(self.client_count):
+            sel = applies & (src == (s + t))
+            inflight = hist[3 + 3 * t]
+            has_inflight = ((inflight >> 31) & 1).astype(bool)
+            # double-invoke invalidates the history (on_invoke raising
+            # after setting _valid=False; the record hook swallows it)
+            invalidate = sel & valid & has_inflight
+            kind = jnp.where(is_put, jnp.uint32(0), jnp.uint32(1))
+            opval = jnp.where(is_put, msg[0] & 0xF, jnp.uint32(0))
+            word = (jnp.uint32(1) << 31) | (kind << 30) | (opval << 26) \
+                | self._peer_counts(hist, t)
+            do_set = sel & valid & ~has_inflight
+            new = jnp.where(do_set, new.at[3 + 3 * t].set(word), new)
+            new = jnp.where(invalidate,
+                            new.at[0].set(hist[0] & ~jnp.uint32(1)), new)
+        return new
+
+    def packed_record_in(self, hist, src, dst, msg):
+        """``record_returns``: GetOk -> ReadOk, PutOk -> WriteOk."""
+        import jax.numpy as jnp
+        mtype = msg[0] >> 24
+        is_getok = mtype == T_GETOK
+        applies = is_getok | (mtype == T_PUTOK)
+        valid = (hist[0] & 1).astype(bool)
+        s = self.server_count
+        new = hist
+        for t in range(self.client_count):
+            sel = applies & (dst == (s + t))
+            inflight = hist[3 + 3 * t]
+            has_inflight = ((inflight >> 31) & 1).astype(bool)
+            invalidate = sel & valid & ~has_inflight
+            retval = jnp.where(is_getok, msg[0] & 0xF, jnp.uint32(0))
+            entry = inflight | (retval << 22)
+            count0 = ~((hist[1 + 3 * t] >> 31) & 1).astype(bool)
+            slot = jnp.where(count0, 1 + 3 * t, 2 + 3 * t)
+            do_set = sel & valid & has_inflight
+            completed = new.at[slot].set(entry).at[3 + 3 * t].set(
+                jnp.uint32(0))  # entry appended, in-flight cleared
+            new = jnp.where(do_set, completed, new)
+            new = jnp.where(invalidate,
+                            new.at[0].set(hist[0] & ~jnp.uint32(1)), new)
+        return new
+
+    def _client_step(self, index, w, src, msg):
+        """Register client ``on_msg`` (`register.rs:127-216`).
+
+        ``index`` is a traced actor index (>= server_count)."""
+        import jax.numpy as jnp
+        s = self.server_count
+        index = index.astype(jnp.uint32)
+        word = w[0]
+        has_awaiting = ((word >> 31) & 1).astype(bool)
+        awaiting = (word >> 8) & 0xFF
+        opc = word & 0xF
+        mtype = msg[0] >> 24
+        a = (msg[0] >> 12) & 0xFFF
+
+        putok = (mtype == T_PUTOK) & has_awaiting & (a == awaiting)
+        getok = (mtype == T_GETOK) & has_awaiting & (a == awaiting)
+        new_req = ((opc + 1) * index).astype(jnp.uint32)
+        get_dst = ((index + opc) % s).astype(jnp.uint32)
+        get_msg = jnp.stack([(jnp.uint32(T_GET) << 24) | (new_req << 12),
+                             jnp.uint32(0)])
+        new_word = jnp.where(
+            putok,
+            (jnp.uint32(1) << 31) | (new_req << 8) | (opc + 1),
+            jnp.where(getok, (opc + 1) & 0xF, word))
+        zmsg = jnp.zeros((2,), jnp.uint32)
+        sends = [[jnp.uint32(0), zmsg, jnp.bool_(False)]
+                 for _ in range(self.max_sends)]
+        sends[0][0] = jnp.where(putok, get_dst, sends[0][0])
+        sends[0][1] = jnp.where(putok, get_msg, sends[0][1])
+        sends[0][2] = putok
+        return new_word[None].astype(jnp.uint32), putok | getok, sends
+
+    def packed_deliver(self, actors, src, dst, msg):
+        """Dynamic dispatch on the traced ``dst``: one server-handler and
+        one client-handler instance in the graph, with the destination's
+        state read and written via one-hot mask arithmetic (dynamic
+        slices are the expensive primitive under vmap in the engine's
+        device loop)."""
+        import jax.numpy as jnp
+        s = self.server_count
+        sw = self._server_w
+        dst = dst.astype(jnp.uint32)
+        is_server = dst < s
+        iota = jnp.arange(self._aw, dtype=jnp.int32)
+
+        sidx = jnp.minimum(dst, s - 1)
+        s_off = (sidx * sw).astype(jnp.int32)
+        # one (aw, sw) one-hot encodes the server span mapping for both
+        # the read (gather) and the write-back (scatter) below
+        onehot = iota[:, None] == (s_off + jnp.arange(sw)[None, :])
+        s_words = (jnp.where(onehot, actors[:, None], 0)
+                   .sum(axis=0).astype(jnp.uint32))
+        n_sw, s_ch, s_snds = self._server_step(sidx, s_words, src, msg)
+
+        cidx = jnp.clip(dst.astype(jnp.int32) - s, 0,
+                        self.client_count - 1)
+        c_off = (s * sw + cidx).astype(jnp.int32)
+        c_words = jnp.where(iota == c_off, actors, 0).sum()[None].astype(
+            jnp.uint32)
+        n_cw, c_ch, c_snds = self._client_step(cidx + s, c_words, src,
+                                               msg)
+
+        # write-back via the same one-hot: position i takes n_sw[i - s_off]
+        # inside the server span (resp. n_cw at c_off), else keeps its word
+        span = onehot.any(axis=1)
+        scatter_sw = (jnp.where(onehot, n_sw[None, :], 0)).sum(axis=1)
+        upd_server = jnp.where(span, scatter_sw, actors)
+        upd_client = jnp.where(iota == c_off, n_cw[0], actors)
+        new_actors = jnp.where(is_server, upd_server, upd_client)
+        changed = jnp.where(is_server, s_ch, c_ch)
+        sends = []
+        for k in range(self.max_sends):
+            sends.append((
+                jnp.where(is_server, s_snds[k][0], c_snds[k][0]),
+                jnp.where(is_server, s_snds[k][1], c_snds[k][1]),
+                jnp.where(is_server, s_snds[k][2], c_snds[k][2])))
+        return new_actors, changed, sends

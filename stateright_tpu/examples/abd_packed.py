@@ -1,0 +1,309 @@
+"""Packed ABD linearizable register: quorum replication on the TPU engine.
+
+The same protocol as :mod:`stateright_tpu.examples.linearizable_register`
+(a behavioral port of `/root/reference/examples/linearizable-register.rs`),
+expressed through :class:`~stateright_tpu.actor.packed_register.PackedRegisterModel`
+so ``spawn_tpu`` checks it on device — the second consistency-tested actor
+family on the device engine after paxos. Host BFS on this model agrees
+state-for-state with the plain model (544 for 2 clients + 2 servers,
+`linearizable-register.rs:258`).
+
+Packed layout (integer comparison of a packed seq equals the host's tuple
+comparison, since the server id is the low component and ids are unique):
+
+* seq ``(clock, sid)`` = ``clock<<4 | sid`` (12 bits);
+* server state = 2+S words:
+  - w0: ``seq | val<<12 | phase_tag<<16 | request_id<<18 | requester<<26``
+    (tag: 0 = idle, 1 = phase 1 query, 2 = phase 2 record);
+  - w1: ``write_present | write_val<<1 | read_present<<5 | read_val<<6 |
+    acks_mask<<10`` (phase payload);
+  - resp[k]: ``present<<16 | seq<<4 | val`` (phase-1 responses, by server);
+* internal message = 2 words:
+  ``[type<<24 | request_id<<12 | seq, value]``.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, List
+
+from ..actor import Id
+from ..actor.packed_register import (PackedRegisterModel, T_INTERNAL0,
+                                     val_char as _val_char,
+                                     val_code as _val_code)
+from .linearizable_register import (AbdActor, AbdState, AckQuery,
+                                    AckRecord, Phase1, Phase2, Query,
+                                    Record)
+
+T_QUERY, T_ACKQUERY, T_RECORD, T_ACKRECORD = range(
+    T_INTERNAL0, T_INTERNAL0 + 4)
+
+
+def _seq_word(seq) -> int:
+    clock, sid = seq
+    assert clock <= 0xFF and sid <= 0xF
+    return (clock << 4) | sid
+
+
+def _seq_tuple(word: int):
+    return (word >> 4, word & 0xF)
+
+
+class PackedAbd(PackedRegisterModel):
+    """ABD with S replicas + C put-once register clients, packed."""
+
+    def __init__(self, client_count: int, server_count: int = 2,
+                 net_capacity: int = 16):
+        self._init_register(
+            client_count, server_count,
+            server_actor=lambda i: AbdActor(
+                [Id(j) for j in range(server_count) if j != i]),
+            server_width=2 + server_count,
+            net_capacity=net_capacity,
+            max_sends=max(server_count - 1, 1))  # broadcasts to peers
+
+    def cache_key(self):
+        return ("abd", self.client_count, self.server_count,
+                self.net_capacity)
+
+    # ------------------------------------------------------------------
+    # server state packing
+    # ------------------------------------------------------------------
+    def encode_server(self, p: AbdState) -> List[int]:
+        s = self.server_count
+        w0 = _seq_word(p.seq) | (_val_code(p.val) << 12)
+        w1 = 0
+        resp = [0] * s
+        if isinstance(p.phase, Phase1):
+            w0 |= (1 << 16) | (p.phase.request_id << 18) \
+                | (p.phase.requester_id << 26)
+            if p.phase.write is not None:
+                w1 |= 1 | (_val_code(p.phase.write) << 1)
+            for sid, (seq, val) in p.phase.responses:
+                resp[sid] = (1 << 16) | (_seq_word(seq) << 4) \
+                    | _val_code(val)
+        elif isinstance(p.phase, Phase2):
+            w0 |= (2 << 16) | (p.phase.request_id << 18) \
+                | (p.phase.requester_id << 26)
+            if p.phase.read is not None:
+                w1 |= (1 << 5) | (_val_code(p.phase.read) << 6)
+            for a in p.phase.acks:
+                w1 |= 1 << (10 + a)
+        return [w0, w1] + resp
+
+    def decode_server(self, words: List[int]) -> AbdState:
+        s = self.server_count
+        w0, w1 = words[0], words[1]
+        seq = _seq_tuple(w0 & 0xFFF)
+        val = _val_char((w0 >> 12) & 0xF)
+        tag = (w0 >> 16) & 3
+        rid = (w0 >> 18) & 0xFF
+        requester = (w0 >> 26) & 0xF
+        phase = None
+        if tag == 1:
+            write = _val_char((w1 >> 1) & 0xF) if w1 & 1 else None
+            responses = frozenset(
+                (sid, (_seq_tuple((rw >> 4) & 0xFFF),
+                       _val_char(rw & 0xF)))
+                for sid, rw in enumerate(words[2:2 + s])
+                if (rw >> 16) & 1)
+            phase = Phase1(request_id=rid, requester_id=requester,
+                           write=write, responses=responses)
+        elif tag == 2:
+            read = _val_char((w1 >> 6) & 0xF) if (w1 >> 5) & 1 else None
+            acks = frozenset(a for a in range(s) if (w1 >> (10 + a)) & 1)
+            phase = Phase2(request_id=rid, requester_id=requester,
+                           read=read, acks=acks)
+        return AbdState(seq=seq, val=val, phase=phase)
+
+    # ------------------------------------------------------------------
+    # internal message packing
+    # ------------------------------------------------------------------
+    def encode_internal(self, inner: Any) -> List[int]:
+        if isinstance(inner, Query):
+            return [(T_QUERY << 24) | (inner.request_id << 12), 0]
+        if isinstance(inner, AckQuery):
+            return [(T_ACKQUERY << 24) | (inner.request_id << 12)
+                    | _seq_word(inner.seq), _val_code(inner.value)]
+        if isinstance(inner, Record):
+            return [(T_RECORD << 24) | (inner.request_id << 12)
+                    | _seq_word(inner.seq), _val_code(inner.value)]
+        assert isinstance(inner, AckRecord)
+        return [(T_ACKRECORD << 24) | (inner.request_id << 12), 0]
+
+    def decode_internal(self, words: List[int]) -> Any:
+        w0, w1 = words
+        mtype = w0 >> 24
+        rid = (w0 >> 12) & 0xFFF
+        seq = _seq_tuple(w0 & 0xFFF)
+        if mtype == T_QUERY:
+            return Query(rid)
+        if mtype == T_ACKQUERY:
+            return AckQuery(rid, seq, _val_char(w1 & 0xF))
+        if mtype == T_RECORD:
+            return Record(rid, seq, _val_char(w1 & 0xF))
+        assert mtype == T_ACKRECORD
+        return AckRecord(rid)
+
+    # ------------------------------------------------------------------
+    # the masked server kernel (`linearizable-register.rs:57-188`)
+    # ------------------------------------------------------------------
+    def _server_step(self, sid, w, src, msg):
+        import jax.numpy as jnp
+
+        from ..actor.packed_register import (T_GET, T_GETOK, T_PUT,
+                                             T_PUTOK)
+        s = self.server_count
+        quorum = s // 2 + 1
+        sid = sid.astype(jnp.uint32)
+        srv_src = jnp.minimum(src, s - 1)
+        src_sel = jnp.arange(s, dtype=jnp.uint32) == srv_src
+
+        w0, w1 = w[0], w[1]
+        resp = w[2:2 + s]
+        seq = w0 & 0xFFF
+        val = (w0 >> 12) & 0xF
+        tag = (w0 >> 16) & 3
+        rid = (w0 >> 18) & 0xFF
+        requester = (w0 >> 26) & 0xF
+        wr_p = (w1 & 1).astype(bool)
+        wr_v = (w1 >> 1) & 0xF
+        rd_p = ((w1 >> 5) & 1).astype(bool)
+        rd_v = (w1 >> 6) & 0xF
+        acks = (w1 >> 10) & 0xF
+
+        mtype = msg[0] >> 24
+        m_rid = (msg[0] >> 12) & 0xFFF
+        m_seq = msg[0] & 0xFFF
+        m_val = msg[1] & 0xF
+
+        zmsg = jnp.zeros((2,), jnp.uint32)
+        sends = [[jnp.uint32(0), zmsg, jnp.bool_(False)]
+                 for _ in range(self.max_sends)]
+
+        def set_send(k, cond, dst, m):
+            sends[k][0] = jnp.where(cond, dst.astype(jnp.uint32),
+                                    sends[k][0])
+            sends[k][1] = jnp.where(cond, m, sends[k][1])
+            sends[k][2] = sends[k][2] | cond
+
+        def broadcast(cond, m):
+            for k in range(s - 1):
+                set_send(k, cond, (sid + 1 + k) % s, m)
+
+        nw0, nw1, nresp = w0, w1, resp
+
+        # --- Put/Get while idle: phase 1 query (`:96-115` in the py port)
+        start = ((mtype == T_PUT) | (mtype == T_GET)) & (tag == 0)
+        is_put = mtype == T_PUT
+        put_val = msg[0] & 0xF  # register msgs carry the value in word 0
+        query_msg = jnp.stack([(jnp.uint32(T_QUERY) << 24)
+                               | (m_rid << 12), jnp.uint32(0)])
+        broadcast(start, query_msg)
+        start_w0 = seq | (val << 12) | (jnp.uint32(1) << 16) \
+            | (m_rid << 18) | (src.astype(jnp.uint32) << 26)
+        start_w1 = jnp.where(is_put, jnp.uint32(1) | (put_val << 1),
+                             jnp.uint32(0))
+        own_sel = jnp.arange(s, dtype=jnp.uint32) == sid
+        start_resp = jnp.where(
+            own_sel, (jnp.uint32(1) << 16) | (seq << 4) | val,
+            jnp.uint32(0))
+        nw0 = jnp.where(start, start_w0, nw0)
+        nw1 = jnp.where(start, start_w1, nw1)
+        nresp = jnp.where(start, start_resp, nresp)
+
+        # --- Query: answer with our (seq, val) ---------------------------
+        is_query = mtype == T_QUERY
+        ackq_msg = jnp.stack([(jnp.uint32(T_ACKQUERY) << 24)
+                              | (m_rid << 12) | seq, val])
+        set_send(0, is_query, src, ackq_msg)
+
+        # --- AckQuery in phase 1: collect, act at quorum -----------------
+        ackq = (mtype == T_ACKQUERY) & (tag == 1) & (m_rid == rid)
+        entry = (jnp.uint32(1) << 16) | (m_seq << 4) | m_val
+        resp2 = jnp.where(ackq & src_sel, entry, nresp)
+        cnt = ((resp2 >> 16) & 1).sum()
+        q_hit = ackq & (cnt == quorum)
+        # newest (seq, value): integer max over packed (seq<<4 | val)
+        keys = jnp.where(((resp2 >> 16) & 1).astype(bool),
+                         resp2 & 0xFFFF, jnp.uint32(0))
+        best = keys.max()
+        b_seq, b_val = best >> 4, best & 0xF
+        n_seq = jnp.where(wr_p, (((b_seq >> 4) + 1) << 4) | sid, b_seq)
+        n_val = jnp.where(wr_p, wr_v, b_val)
+        record_msg = jnp.stack([(jnp.uint32(T_RECORD) << 24)
+                                | (rid << 12) | n_seq, n_val])
+        broadcast(q_hit, record_msg)
+        # move to phase 2 (self-ack); adopt the recorded value if newer
+        newer = n_seq > seq
+        ph2_w0 = jnp.where(newer, n_seq | (n_val << 12),
+                           seq | (val << 12)) \
+            | (jnp.uint32(2) << 16) | (rid << 18) | (requester << 26)
+        ph2_w1 = jnp.where(wr_p, jnp.uint32(0),
+                           (jnp.uint32(1) << 5) | (b_val << 6)) \
+            | ((jnp.uint32(1) << sid) << 10)
+        nw0 = jnp.where(q_hit, ph2_w0, nw0)
+        nw1 = jnp.where(q_hit, ph2_w1, nw1)
+        nresp = jnp.where(q_hit, jnp.uint32(0),
+                          jnp.where(ackq, resp2, nresp))
+
+        # --- Record: ack; adopt if newer ---------------------------------
+        is_rec = mtype == T_RECORD
+        ackr_msg = jnp.stack([(jnp.uint32(T_ACKRECORD) << 24)
+                              | (m_rid << 12), jnp.uint32(0)])
+        set_send(0, is_rec, src, ackr_msg)
+        adopt = is_rec & (m_seq > (nw0 & 0xFFF))
+        nw0 = jnp.where(adopt,
+                        (nw0 & ~jnp.uint32(0xFFFF)) | m_seq | (m_val << 12),
+                        nw0)
+
+        # --- AckRecord in phase 2: count, respond at quorum --------------
+        already = ((acks >> srv_src) & 1).astype(bool)
+        ackr = (mtype == T_ACKRECORD) & (tag == 2) & (m_rid == rid) \
+            & ~already
+        acks2 = acks | (jnp.uint32(1) << srv_src)
+        cnt2 = jnp.uint32(0)
+        for j in range(s):
+            cnt2 = cnt2 + ((acks2 >> j) & 1)
+        r_hit = ackr & (cnt2 == quorum)
+        done_msg = jnp.where(
+            rd_p,
+            jnp.stack([(jnp.uint32(T_GETOK) << 24) | (rid << 12) | rd_v,
+                       jnp.uint32(0)]),
+            jnp.stack([(jnp.uint32(T_PUTOK) << 24) | (rid << 12),
+                       jnp.uint32(0)]))
+        set_send(0, r_hit, requester, done_msg)
+        nw1 = jnp.where(ackr & ~r_hit,
+                        (nw1 & ~jnp.uint32(0xF << 10)) | (acks2 << 10),
+                        nw1)
+        # back to idle: clear phase bits entirely
+        idle_w0 = (nw0 & 0xFFFF)
+        nw0 = jnp.where(r_hit, idle_w0, nw0)
+        nw1 = jnp.where(r_hit, jnp.uint32(0), nw1)
+
+        changed = start | ackq | adopt | ackr
+        new_w = jnp.concatenate(
+            [jnp.stack([nw0, nw1]), nresp]).astype(jnp.uint32)
+        return new_w, changed, sends
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args[0] if args else None
+    client_count = int(args[1]) if len(args) > 1 else 2
+    if cmd == "check-tpu":
+        print(f"Model checking packed ABD with {client_count} clients "
+              "on the TPU engine.")
+        PackedAbd(client_count).checker().spawn_tpu().report(sys.stdout)
+    elif cmd == "check":
+        print(f"Model checking packed ABD with {client_count} clients "
+              "on the host engine.")
+        PackedAbd(client_count).checker().spawn_bfs().report(sys.stdout)
+    else:
+        print("USAGE:")
+        print("  python -m stateright_tpu.examples.abd_packed "
+              "check[-tpu] [CLIENT_COUNT]")
+
+
+if __name__ == "__main__":
+    main()

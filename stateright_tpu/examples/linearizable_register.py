@@ -221,6 +221,13 @@ def main(argv=None) -> None:
         (AbdModelCfg(client_count=client_count, server_count=3,
                      network=network)
          .into_model().checker().spawn_dfs().report(sys.stdout))
+    elif cmd == "check-tpu":
+        client_count = int(args[1]) if len(args) > 1 else 2
+        print(f"Model checking a linearizable register with {client_count} "
+              "clients on the TPU engine.")
+        from .abd_packed import PackedAbd
+        (PackedAbd(client_count, server_count=3).checker()
+         .spawn_tpu().report(sys.stdout))
     elif cmd == "explore":
         client_count = int(args[1]) if len(args) > 1 else 2
         address = args[2] if len(args) > 2 else "localhost:3000"
@@ -233,6 +240,8 @@ def main(argv=None) -> None:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.linearizable_register "
               "check [CLIENT_COUNT] [NETWORK]")
+        print("  python -m stateright_tpu.examples.linearizable_register "
+              "check-tpu [CLIENT_COUNT]")
         print("  python -m stateright_tpu.examples.linearizable_register "
               "explore [CLIENT_COUNT] [ADDRESS]")
         print(f"NETWORK: {' | '.join(Network.names())}")

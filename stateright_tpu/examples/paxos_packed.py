@@ -35,33 +35,17 @@ from __future__ import annotations
 import sys
 from typing import Any, List, Optional, Tuple
 
-from ..actor import Id, Network
-from ..actor.packed import PackedActorModel
-from ..actor.register import (Get, GetOk, Internal, Put, PutOk,
-                              RegisterClient, RegisterServer,
-                              record_invocations, record_returns)
-from ..core import Expectation
-from ..semantics import LinearizabilityTester, Register
-from ..semantics.register import Read as ReadOp, ReadOk, Write as WriteOp, \
-    WriteOk
+from ..actor import Id
+from ..actor.packed_register import (PackedRegisterModel, T_GET, T_GETOK,
+                                     T_INTERNAL0, T_PUT, T_PUTOK,
+                                     val_char as _val_char,
+                                     val_code as _val_code)
 from .paxos import (Accept, Accepted, Decided, PaxosActor, PaxosState,
                     Prepare, Prepared)
 
-# message type tags
-T_PUT, T_GET, T_PUTOK, T_GETOK = 1, 2, 3, 4
-T_PREPARE, T_PREPARED, T_ACCEPT, T_ACCEPTED, T_DECIDED = 5, 6, 7, 8, 9
-
-
-def _val_code(value: Any) -> int:
-    if value == '\0':
-        return 0
-    code = ord(value) - ord('A') + 1
-    assert 1 <= code <= 15, f"value out of packed range: {value!r}"
-    return code
-
-
-def _val_char(code: int) -> str:
-    return '\0' if code == 0 else chr(ord('A') + code - 1)
+# protocol-internal message type tags
+T_PREPARE, T_PREPARED, T_ACCEPT, T_ACCEPTED, T_DECIDED = range(
+    T_INTERNAL0, T_INTERNAL0 + 5)
 
 
 def _ballot_word(ballot: Tuple[int, int]) -> int:
@@ -101,116 +85,65 @@ def _la_tuple(word: int):
             _proposal_tuple(word & 0x3FFF))
 
 
-class PackedPaxos(PackedActorModel):
-    """Paxos with S servers + C put-once register clients, packed."""
+class PackedPaxos(PackedRegisterModel):
+    """Paxos with S servers + C put-once register clients, packed.
+
+    Client slots, register messages, the linearizability history, and the
+    one-hot dispatch come from :class:`PackedRegisterModel`; this class
+    supplies the paxos server packing and its masked step kernel."""
 
     def __init__(self, client_count: int, server_count: int = 3,
                  net_capacity: int = 16):
-        assert server_count <= 4, "accepts mask packs up to 4 servers"
-        assert client_count <= 7, "last-completed codes pack up to 7 peers"
-        super().__init__(cfg=self,
-                         init_history=LinearizabilityTester(Register('\0')))
-        self.client_count = client_count
-        self.server_count = server_count
-        self._server_w = 3 + server_count
-        for i in range(server_count):
-            peers = [Id(j) for j in range(server_count) if j != i]
-            self.actor(RegisterServer(PaxosActor(peers)))
-        for _ in range(client_count):
-            self.actor(RegisterClient(put_count=1,
-                                      server_count=server_count))
-        self.init_network(Network.new_unordered_nonduplicating())
-
-        def value_chosen(_model, state):
-            for env in state.network.iter_deliverable():
-                if isinstance(env.msg, GetOk) and env.msg.value != '\0':
-                    return True
-            return False
-
-        self.property(Expectation.ALWAYS, "linearizable",
-                      lambda _, state:
-                      state.history.serialized_history() is not None)
-        self.property(Expectation.SOMETIMES, "value chosen", value_chosen)
-        self.record_msg_in(record_returns)
-        self.record_msg_out(record_invocations)
-
-        # --- packed schema ---------------------------------------------
-        self.actor_widths = [self._server_w] * server_count \
-            + [1] * client_count
-        self.msg_width = 2
-        self.net_capacity = net_capacity
-        self.history_width = 1 + 3 * client_count
-        self.max_sends = server_count  # Decided broadcast + PutOk
-        self.host_property_indices = (0,)  # linearizable
-        self.finalize_layout()
+        self._init_register(
+            client_count, server_count,
+            server_actor=lambda i: PaxosActor(
+                [Id(j) for j in range(server_count) if j != i]),
+            server_width=3 + server_count,
+            net_capacity=net_capacity,
+            max_sends=server_count)  # Decided broadcast + PutOk
 
     def cache_key(self):
         return ("paxos", self.client_count, self.server_count,
                 self.net_capacity)
 
     # ------------------------------------------------------------------
-    # actor state packing
+    # server state packing
     # ------------------------------------------------------------------
-    def encode_actor(self, index: int, state: Any) -> List[int]:
+    def encode_server(self, p: PaxosState) -> List[int]:
         s = self.server_count
-        if index < s:
-            p: PaxosState = state.state  # unwrap ServerState
-            w0 = _ballot_word(p.ballot)
-            for a in p.accepts:
-                w0 |= 1 << (12 + a)
-            w0 |= int(p.is_decided) << 16
-            w1 = 0 if p.proposal is None \
-                else (1 << 15) | _proposal_word(p.proposal)
-            preps = [0] * s
-            for sid, la in p.prepares:
-                preps[sid] = (1 << 27) | _la_word(la)
-            return [w0, w1] + preps + [_la_word(p.accepted)]
-        c = state  # ClientState
-        w = (c.op_count & 0xF)
-        if c.awaiting is not None:
-            w |= (1 << 31) | (c.awaiting << 8)
-        return [w]
+        w0 = _ballot_word(p.ballot)
+        for a in p.accepts:
+            w0 |= 1 << (12 + a)
+        w0 |= int(p.is_decided) << 16
+        w1 = 0 if p.proposal is None \
+            else (1 << 15) | _proposal_word(p.proposal)
+        preps = [0] * s
+        for sid, la in p.prepares:
+            preps[sid] = (1 << 27) | _la_word(la)
+        return [w0, w1] + preps + [_la_word(p.accepted)]
 
-    def decode_actor(self, index: int, words: List[int]) -> Any:
-        from .paxos import PaxosState
-        from ..actor.register import ClientState, ServerState
+    def decode_server(self, words: List[int]) -> PaxosState:
         s = self.server_count
-        if index < s:
-            w0, w1 = words[0], words[1]
-            preps = words[2:2 + s]
-            ballot = _ballot_tuple(w0 & 0xFFF)
-            accepts = frozenset(a for a in range(s)
-                                if (w0 >> (12 + a)) & 1)
-            decided = bool((w0 >> 16) & 1)
-            proposal = _proposal_tuple(w1 & 0x3FFF) if (w1 >> 15) & 1 \
-                else None
-            prepares = tuple(sorted(
-                (sid, _la_tuple(pw & 0x7FFFFFF))
-                for sid, pw in enumerate(preps) if (pw >> 27) & 1))
-            return ServerState(PaxosState(
-                ballot=ballot, proposal=proposal, prepares=prepares,
-                accepts=accepts, accepted=_la_tuple(words[2 + s]),
-                is_decided=decided))
-        w = words[0]
-        awaiting = (w >> 8) & 0xFF if (w >> 31) & 1 else None
-        return ClientState(awaiting=awaiting, op_count=w & 0xF)
+        w0, w1 = words[0], words[1]
+        preps = words[2:2 + s]
+        ballot = _ballot_tuple(w0 & 0xFFF)
+        accepts = frozenset(a for a in range(s)
+                            if (w0 >> (12 + a)) & 1)
+        decided = bool((w0 >> 16) & 1)
+        proposal = _proposal_tuple(w1 & 0x3FFF) if (w1 >> 15) & 1 \
+            else None
+        prepares = tuple(sorted(
+            (sid, _la_tuple(pw & 0x7FFFFFF))
+            for sid, pw in enumerate(preps) if (pw >> 27) & 1))
+        return PaxosState(
+            ballot=ballot, proposal=proposal, prepares=prepares,
+            accepts=accepts, accepted=_la_tuple(words[2 + s]),
+            is_decided=decided)
 
     # ------------------------------------------------------------------
     # message packing: [type<<24 | a<<12 | b, c]
     # ------------------------------------------------------------------
-    def encode_msg(self, msg: Any) -> List[int]:
-        if isinstance(msg, Put):
-            return [(T_PUT << 24) | (msg.request_id << 12)
-                    | _val_code(msg.value), 0]
-        if isinstance(msg, Get):
-            return [(T_GET << 24) | (msg.request_id << 12), 0]
-        if isinstance(msg, PutOk):
-            return [(T_PUTOK << 24) | (msg.request_id << 12), 0]
-        if isinstance(msg, GetOk):
-            return [(T_GETOK << 24) | (msg.request_id << 12)
-                    | _val_code(msg.value), 0]
-        assert isinstance(msg, Internal)
-        inner = msg.msg
+    def encode_internal(self, inner: Any) -> List[int]:
         if isinstance(inner, Prepare):
             return [(T_PREPARE << 24) | _ballot_word(inner.ballot), 0]
         if isinstance(inner, Prepared):
@@ -225,188 +158,24 @@ class PackedPaxos(PackedActorModel):
         return [(T_DECIDED << 24) | _ballot_word(inner.ballot),
                 _proposal_word(inner.proposal)]
 
-    def decode_msg(self, words: List[int]) -> Any:
+    def decode_internal(self, words: List[int]) -> Any:
         w0, c = words
         mtype = w0 >> 24
-        a = (w0 >> 12) & 0xFFF
         b = w0 & 0xFFF
-        if mtype == T_PUT:
-            return Put(a, _val_char(b & 0xF))
-        if mtype == T_GET:
-            return Get(a)
-        if mtype == T_PUTOK:
-            return PutOk(a)
-        if mtype == T_GETOK:
-            return GetOk(a, _val_char(b & 0xF))
         if mtype == T_PREPARE:
-            return Internal(Prepare(_ballot_tuple(b)))
+            return Prepare(_ballot_tuple(b))
         if mtype == T_PREPARED:
-            return Internal(Prepared(_ballot_tuple(b), _la_tuple(c)))
+            return Prepared(_ballot_tuple(b), _la_tuple(c))
         if mtype == T_ACCEPT:
-            return Internal(Accept(_ballot_tuple(b), _proposal_tuple(c)))
+            return Accept(_ballot_tuple(b), _proposal_tuple(c))
         if mtype == T_ACCEPTED:
-            return Internal(Accepted(_ballot_tuple(b)))
+            return Accepted(_ballot_tuple(b))
         assert mtype == T_DECIDED
-        return Internal(Decided(_ballot_tuple(b), _proposal_tuple(c)))
+        return Decided(_ballot_tuple(b), _proposal_tuple(c))
 
     # ------------------------------------------------------------------
-    # history packing (LinearizabilityTester over Register)
+    # the masked server kernel
     # ------------------------------------------------------------------
-    def _lc_bits(self, thread: int, lc: dict) -> int:
-        """2-bit completed-count codes for each peer of ``thread``."""
-        bits = 0
-        pos = 0
-        s = self.server_count
-        for peer in range(self.client_count):
-            if peer == thread:
-                continue
-            idx = lc.get(Id(s + peer))
-            code = 0 if idx is None else idx + 1
-            bits |= code << (2 * pos)
-            pos += 1
-        return bits
-
-    def _lc_dict(self, thread: int, bits: int) -> dict:
-        lc = {}
-        pos = 0
-        s = self.server_count
-        for peer in range(self.client_count):
-            if peer == thread:
-                continue
-            code = (bits >> (2 * pos)) & 3
-            if code:
-                lc[Id(s + peer)] = code - 1
-            pos += 1
-        return lc
-
-    @staticmethod
-    def _entry_word(lc_bits: int, op, ret) -> int:
-        kind = int(isinstance(op, ReadOp))
-        opval = 0 if kind else _val_code(op.value)
-        retval = _val_code(ret.value) if isinstance(ret, ReadOk) else 0
-        return (1 << 31) | (kind << 30) | (opval << 26) | (retval << 22) \
-            | lc_bits
-
-    def encode_history(self, history: LinearizabilityTester) -> List[int]:
-        words = [int(history._valid)]
-        s = self.server_count
-        for t in range(self.client_count):
-            tid = Id(s + t)
-            entries = history._history.get(tid, [])
-            assert len(entries) <= 2, "put_count=1 clients do <=2 ops"
-            e = [0, 0]
-            for k, (lc, op, ret) in enumerate(entries):
-                e[k] = self._entry_word(self._lc_bits(t, lc), op, ret)
-            inflight = 0
-            if tid in history._in_flight:
-                lc, op = history._in_flight[tid]
-                kind = int(isinstance(op, ReadOp))
-                opval = 0 if kind else _val_code(op.value)
-                inflight = (1 << 31) | (kind << 30) | (opval << 26) \
-                    | self._lc_bits(t, lc)
-            words.extend([e[0], e[1], inflight])
-        return words
-
-    def decode_history(self, words: List[int]) -> LinearizabilityTester:
-        tester = LinearizabilityTester(Register('\0'))
-        tester._valid = bool(words[0] & 1)
-        s = self.server_count
-        for t in range(self.client_count):
-            tid = Id(s + t)
-            e0, e1, inflight = words[1 + 3 * t: 4 + 3 * t]
-            entries = []
-            for w in (e0, e1):
-                if not (w >> 31) & 1:
-                    continue
-                kind = (w >> 30) & 1
-                opval = (w >> 26) & 0xF
-                retval = (w >> 22) & 0xF
-                op = ReadOp() if kind else WriteOp(_val_char(opval))
-                ret = ReadOk(_val_char(retval)) if kind else WriteOk()
-                entries.append((self._lc_dict(t, w & 0x3FFF), op, ret))
-            if entries:
-                tester._history[tid] = entries
-            if (inflight >> 31) & 1:
-                kind = (inflight >> 30) & 1
-                opval = (inflight >> 26) & 0xF
-                op = ReadOp() if kind else WriteOp(_val_char(opval))
-                tester._in_flight[tid] = (
-                    self._lc_dict(t, inflight & 0x3FFF), op)
-                tester._history.setdefault(tid, [])
-        return tester
-
-    # ------------------------------------------------------------------
-    # device kernels
-    # ------------------------------------------------------------------
-    def _peer_counts(self, hist, thread: int):
-        """Packed last-completed codes for ``thread`` from current
-        per-peer completed counts (mirrors ``on_invoke``,
-        `linearizability.rs:102-125`)."""
-        import jax.numpy as jnp
-        bits = jnp.uint32(0)
-        pos = 0
-        for peer in range(self.client_count):
-            if peer == thread:
-                continue
-            e0 = hist[1 + 3 * peer]
-            e1 = hist[2 + 3 * peer]
-            count = ((e0 >> 31) & 1) + ((e1 >> 31) & 1)
-            bits = bits | (count.astype(jnp.uint32) << (2 * pos))
-            pos += 1
-        return bits
-
-    def packed_record_out(self, hist, src, dst, msg):
-        """``record_invocations``: Put -> Write invoke, Get -> Read."""
-        import jax.numpy as jnp
-        mtype = msg[0] >> 24
-        is_put = mtype == T_PUT
-        applies = is_put | (mtype == T_GET)
-        valid = (hist[0] & 1).astype(bool)
-        s = self.server_count
-        new = hist
-        for t in range(self.client_count):
-            sel = applies & (src == (s + t))
-            inflight = hist[3 + 3 * t]
-            has_inflight = ((inflight >> 31) & 1).astype(bool)
-            # double-invoke invalidates the history (on_invoke raising
-            # after setting _valid=False; the record hook swallows it)
-            invalidate = sel & valid & has_inflight
-            kind = jnp.where(is_put, jnp.uint32(0), jnp.uint32(1))
-            opval = jnp.where(is_put, msg[0] & 0xF, jnp.uint32(0))
-            word = (jnp.uint32(1) << 31) | (kind << 30) | (opval << 26) \
-                | self._peer_counts(hist, t)
-            do_set = sel & valid & ~has_inflight
-            new = jnp.where(do_set, new.at[3 + 3 * t].set(word), new)
-            new = jnp.where(invalidate,
-                            new.at[0].set(hist[0] & ~jnp.uint32(1)), new)
-        return new
-
-    def packed_record_in(self, hist, src, dst, msg):
-        """``record_returns``: GetOk -> ReadOk, PutOk -> WriteOk."""
-        import jax.numpy as jnp
-        mtype = msg[0] >> 24
-        is_getok = mtype == T_GETOK
-        applies = is_getok | (mtype == T_PUTOK)
-        valid = (hist[0] & 1).astype(bool)
-        s = self.server_count
-        new = hist
-        for t in range(self.client_count):
-            sel = applies & (dst == (s + t))
-            inflight = hist[3 + 3 * t]
-            has_inflight = ((inflight >> 31) & 1).astype(bool)
-            invalidate = sel & valid & ~has_inflight
-            retval = jnp.where(is_getok, msg[0] & 0xF, jnp.uint32(0))
-            entry = inflight | (retval << 22)
-            count0 = ~((hist[1 + 3 * t] >> 31) & 1).astype(bool)
-            slot = jnp.where(count0, 1 + 3 * t, 2 + 3 * t)
-            do_set = sel & valid & has_inflight
-            completed = new.at[slot].set(entry).at[3 + 3 * t].set(
-                jnp.uint32(0))  # entry appended, in-flight cleared
-            new = jnp.where(do_set, completed, new)
-            new = jnp.where(invalidate,
-                            new.at[0].set(hist[0] & ~jnp.uint32(1)), new)
-        return new
-
     def _server_step(self, sid, w, src, msg):
         """One server's ``on_msg`` (`paxos.rs:85-172`) as masked JAX.
 
@@ -550,104 +319,6 @@ class PackedPaxos(PackedActorModel):
             [jnp.stack([nw0, nw1]), npreps, jnp.stack([naccepted])]) \
             .astype(jnp.uint32)
         return new_w, changed, sends
-
-    def _client_step(self, index, w, src, msg):
-        """Register client ``on_msg`` (`register.rs:127-216`).
-
-        ``index`` is a traced actor index (>= server_count)."""
-        import jax.numpy as jnp
-        s = self.server_count
-        index = index.astype(jnp.uint32)
-        word = w[0]
-        has_awaiting = ((word >> 31) & 1).astype(bool)
-        awaiting = (word >> 8) & 0xFF
-        opc = word & 0xF
-        mtype = msg[0] >> 24
-        a = (msg[0] >> 12) & 0xFFF
-
-        putok = (mtype == T_PUTOK) & has_awaiting & (a == awaiting)
-        getok = (mtype == T_GETOK) & has_awaiting & (a == awaiting)
-        new_req = ((opc + 1) * index).astype(jnp.uint32)
-        get_dst = ((index + opc) % s).astype(jnp.uint32)
-        get_msg = jnp.stack([(jnp.uint32(T_GET) << 24) | (new_req << 12),
-                             jnp.uint32(0)])
-        new_word = jnp.where(
-            putok,
-            (jnp.uint32(1) << 31) | (new_req << 8) | (opc + 1),
-            jnp.where(getok, (opc + 1) & 0xF, word))
-        zmsg = jnp.zeros((2,), jnp.uint32)
-        sends = [[jnp.uint32(0), zmsg, jnp.bool_(False)]
-                 for _ in range(self.max_sends)]
-        sends[0][0] = jnp.where(putok, get_dst, sends[0][0])
-        sends[0][1] = jnp.where(putok, get_msg, sends[0][1])
-        sends[0][2] = putok
-        return new_word[None].astype(jnp.uint32), putok | getok, sends
-
-    def packed_deliver(self, actors, src, dst, msg):
-        """Dynamic dispatch on the traced ``dst``: one server-handler and
-        one client-handler instance in the graph, with the destination's
-        state read and written via one-hot mask arithmetic (dynamic
-        slices are the expensive primitive under vmap in the engine's
-        device loop)."""
-        import jax.numpy as jnp
-        s = self.server_count
-        sw = self._server_w
-        dst = dst.astype(jnp.uint32)
-        is_server = dst < s
-        iota = jnp.arange(self._aw, dtype=jnp.int32)
-
-        sidx = jnp.minimum(dst, s - 1)
-        s_off = (sidx * sw).astype(jnp.int32)
-        # one (aw, sw) one-hot encodes the server span mapping for both
-        # the read (gather) and the write-back (scatter) below
-        onehot = iota[:, None] == (s_off + jnp.arange(sw)[None, :])
-        s_words = (jnp.where(onehot, actors[:, None], 0)
-                   .sum(axis=0).astype(jnp.uint32))
-        n_sw, s_ch, s_snds = self._server_step(sidx, s_words, src, msg)
-
-        cidx = jnp.clip(dst.astype(jnp.int32) - s, 0,
-                        self.client_count - 1)
-        c_off = (s * sw + cidx).astype(jnp.int32)
-        c_words = jnp.where(iota == c_off, actors, 0).sum()[None].astype(
-            jnp.uint32)
-        n_cw, c_ch, c_snds = self._client_step(cidx + s, c_words, src,
-                                               msg)
-
-        # write-back via the same one-hot: position i takes n_sw[i - s_off]
-        # inside the server span (resp. n_cw at c_off), else keeps its word
-        span = onehot.any(axis=1)
-        scatter_sw = (jnp.where(onehot, n_sw[None, :], 0)).sum(axis=1)
-        upd_server = jnp.where(span, scatter_sw, actors)
-        upd_client = jnp.where(iota == c_off, n_cw[0], actors)
-        new_actors = jnp.where(is_server, upd_server, upd_client)
-        changed = jnp.where(is_server, s_ch, c_ch)
-        sends = []
-        for k in range(self.max_sends):
-            sends.append((
-                jnp.where(is_server, s_snds[k][0], c_snds[k][0]),
-                jnp.where(is_server, s_snds[k][1], c_snds[k][1]),
-                jnp.where(is_server, s_snds[k][2], c_snds[k][2])))
-        return new_actors, changed, sends
-
-    def host_property_key(self, row) -> bytes:
-        """The linearizable property depends only on the history words."""
-        import numpy as np
-        return np.asarray(row[self._hist_off:], dtype=np.uint32).tobytes()
-
-    def packed_properties(self, words):
-        import jax.numpy as jnp
-        # index 0 "linearizable" is host-evaluated: neutral True
-        chosen = jnp.bool_(False)
-        for e in range(self.net_capacity):
-            off = self._net_off + e * self._sw
-            hdr = words[off]
-            m0 = words[off + 2]
-            occupied = (hdr >> 16) & 1
-            is_getok = (m0 >> 24) == T_GETOK
-            has_value = (m0 & 0xF) != 0
-            chosen = chosen | (occupied.astype(bool) & is_getok
-                               & has_value)
-        return jnp.stack([jnp.bool_(True), chosen])
 
 
 def main(argv=None) -> None:
