@@ -1,0 +1,104 @@
+"""Packed single-copy register: the linearizability-counterexample
+workload on the TPU engine.
+
+The same system as :mod:`stateright_tpu.examples.single_copy_register`
+(a behavioral port of `/root/reference/examples/single-copy-register.rs`):
+unreplicated value servers. One server is linearizable (93 states for 2
+clients, `single-copy-register.rs:100`); two servers are NOT — the checker
+must produce a linearizability counterexample (the reference stops after
+20 states, `:121`; the device engine, which evaluates the host property
+post-hoc per chunk, may explore more before reporting — any valid
+counterexample is accepted, as with the reference's multithreaded runs).
+
+This is the workload proving the device engine can *catch* a
+linearizability bug, not just confirm absence. Server state = 1 word
+(the value code)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, List
+
+from ..actor.packed_register import (PackedRegisterModel,
+                                     T_GET, T_GETOK, T_PUT, T_PUTOK,
+                                     val_char as _val_char,
+                                     val_code as _val_code)
+from .single_copy_register import SingleCopyActor
+
+
+class PackedSingleCopy(PackedRegisterModel):
+    """Unreplicated value server(s) + C put-once register clients."""
+
+    def __init__(self, client_count: int, server_count: int = 1,
+                 net_capacity: int = 16):
+        self._init_register(
+            client_count, server_count,
+            server_actor=lambda i: SingleCopyActor(),
+            server_width=1,
+            net_capacity=net_capacity,
+            max_sends=1)
+
+    def cache_key(self):
+        return ("single_copy", self.client_count, self.server_count,
+                self.net_capacity)
+
+    # --- server packing: one word, the stored value ----------------------
+    def encode_server(self, val: str) -> List[int]:
+        return [_val_code(val)]
+
+    def decode_server(self, words: List[int]) -> str:
+        return _val_char(words[0])
+
+    def encode_internal(self, msg: Any) -> List[int]:
+        raise AssertionError("single-copy register has no internal msgs")
+
+    def decode_internal(self, words: List[int]) -> Any:
+        raise AssertionError("single-copy register has no internal msgs")
+
+    # --- the masked server kernel (`single-copy-register.rs:18-37`) ------
+    def _server_step(self, sid, w, src, msg):
+        import jax.numpy as jnp
+
+        val = w[0]
+        mtype = msg[0] >> 24
+        m_rid = (msg[0] >> 12) & 0xFFF
+        is_put = mtype == T_PUT
+        is_get = mtype == T_GET
+
+        new_val = jnp.where(is_put, msg[0] & 0xF, val)
+        putok = jnp.stack([(jnp.uint32(T_PUTOK) << 24) | (m_rid << 12),
+                           jnp.uint32(0)])
+        getok = jnp.stack([(jnp.uint32(T_GETOK) << 24) | (m_rid << 12)
+                           | val, jnp.uint32(0)])
+        zmsg = jnp.zeros((2,), jnp.uint32)
+        sends = [[jnp.uint32(0), zmsg, jnp.bool_(False)]
+                 for _ in range(self.max_sends)]
+        reply = is_put | is_get
+        sends[0][0] = jnp.where(reply, src.astype(jnp.uint32),
+                                sends[0][0])
+        sends[0][1] = jnp.where(is_put, putok,
+                                jnp.where(is_get, getok, zmsg))
+        sends[0][2] = reply
+        changed = is_put & (new_val != val)
+        return new_val[None].astype(jnp.uint32), changed, sends
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args[0] if args else None
+    client_count = int(args[1]) if len(args) > 1 else 2
+    server_count = int(args[2]) if len(args) > 2 else 1
+    if cmd == "check-tpu":
+        print(f"Model checking a packed single-copy register with "
+              f"{client_count} clients, {server_count} servers on the "
+              "TPU engine.")
+        (PackedSingleCopy(client_count, server_count).checker()
+         .spawn_tpu().report(sys.stdout))
+    else:
+        print("USAGE:")
+        print("  python -m stateright_tpu.examples.single_copy_packed "
+              "check-tpu [CLIENT_COUNT] [SERVER_COUNT]")
+
+
+if __name__ == "__main__":
+    main()

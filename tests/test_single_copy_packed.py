@@ -1,0 +1,37 @@
+"""Packed single-copy register: the device engine catching a
+linearizability violation (`/root/reference/examples/single-copy-register.rs:84-122`)."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.examples.single_copy_packed import PackedSingleCopy  # noqa: E402
+from stateright_tpu.models.packed import validate_packed_model  # noqa: E402
+
+
+class TestPackedSingleCopy:
+    def test_contract_full(self):
+        # all 93 reachable states of the 1-server config
+        assert validate_packed_model(
+            PackedSingleCopy(2, server_count=1), max_states=200) == 93
+
+    def test_one_server_linearizable_93(self):
+        ck = (PackedSingleCopy(2, server_count=1).checker()
+              .tpu_options(capacity=1 << 10).spawn_tpu().join())
+        assert ck.unique_state_count() == 93
+        ck.assert_properties()
+
+    def test_two_servers_counterexample(self):
+        # the headline: two unreplicated servers are NOT linearizable and
+        # the device engine must produce a counterexample whose final
+        # history really fails the linearizability search
+        ck = (PackedSingleCopy(2, server_count=2).checker()
+              .tpu_options(capacity=1 << 12).spawn_tpu().join())
+        path = ck.assert_any_discovery("linearizable")
+        last = path.last_state()
+        assert last.history.serialized_history() is None
+
+    def test_two_servers_host_agrees(self):
+        host = (PackedSingleCopy(2, server_count=2).checker()
+                .spawn_bfs().join())
+        assert host.discovery("linearizable") is not None
