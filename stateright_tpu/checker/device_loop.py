@@ -70,10 +70,12 @@ class ChunkCarry(NamedTuple):
     q_tail: jax.Array   # int32[]         next free row (q_size = tail-head)
     key_hi: jax.Array   # uint32[cap]     visited table
     key_lo: jax.Array   # uint32[cap]
-    log_chi: jax.Array  # uint32[logcap]  child fp (insertion order)
-    log_clo: jax.Array  # uint32[logcap]
+    log_chi: jax.Array  # uint32[logcap]  child fp, insertion order
+    log_clo: jax.Array  #                 (canonical under symmetry)
     log_phi: jax.Array  # uint32[logcap]  parent fp
     log_plo: jax.Array  # uint32[logcap]
+    log_ohi: jax.Array  # uint32[logcap | 1]  child ORIGINAL fp (symmetry
+    log_olo: jax.Array  #                     only; 1-element dummy else)
     log_n: jax.Array    # int32[]
     disc_hit: jax.Array  # bool[P]   property discovered?
     disc_hi: jax.Array   # uint32[P] witnessing state fp (sticky first)
@@ -114,7 +116,8 @@ def model_cache_key(model):
     return (type(model), mkey, getattr(model, "lossy_network_", None))
 
 
-def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
+def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
+                   symmetry: bool = False):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit) ->
@@ -127,19 +130,21 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
     (and already-compiled) chunk across instances of the same model config.
     """
     mkey = model_cache_key(model)
+    key = (mkey, qcap, capacity, fmax, kmax, symmetry)
     if mkey is not None:
-        cached = _CHUNK_CACHE.get((mkey, qcap, capacity, fmax, kmax))
+        cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
-    fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax)
+    fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry)
     if mkey is not None:
         if len(_CHUNK_CACHE) >= _CACHE_LIMIT:
             _CHUNK_CACHE.clear()
-        _CHUNK_CACHE[(mkey, qcap, capacity, fmax, kmax)] = fn
+        _CHUNK_CACHE[key] = fn
     return fn
 
 
-def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
+def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
+                    symmetry: bool):
     n_actions = model.max_actions
     properties = model.properties()
     prop_count = len(properties)
@@ -185,7 +190,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
 
             # the shared check_block analog (ops/expand.py)
             exp = expand_frontier(model, frontier, fvalid, ebits,
-                                  eventually_idx)
+                                  eventually_idx, symmetry=symmetry)
             vcount = exp.cvalid.sum(dtype=jnp.int32)
             kovf = vcount > kmax_b
 
@@ -237,6 +242,14 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
                     c.log_phi, n_phi, (c.log_n,))
                 log_plo = jax.lax.dynamic_update_slice(
                     c.log_plo, n_plo, (c.log_n,))
+                log_ohi, log_olo = c.log_ohi, c.log_olo
+                if symmetry:
+                    k_ohi = exp.ohi[src]
+                    k_olo = exp.olo[src]
+                    log_ohi = jax.lax.dynamic_update_slice(
+                        log_ohi, k_ohi[src2], (c.log_n,))
+                    log_olo = jax.lax.dynamic_update_slice(
+                        log_olo, k_olo[src2], (c.log_n,))
                 return c._replace(
                     q_rows=q_rows, q_eb=q_eb,
                     q_head=c.q_head + take,
@@ -244,6 +257,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
                     key_hi=key_hi, key_lo=key_lo,
                     log_chi=log_chi, log_clo=log_clo,
                     log_phi=log_phi, log_plo=log_plo,
+                    log_ohi=log_ohi, log_olo=log_olo,
                     log_n=c.log_n + cnt,
                     gen=c.gen + vcount,
                     ovf=c.ovf | t_ovf,
@@ -281,7 +295,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int):
 
 
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
-               steps: int = 0):
+               steps: int = 0, symmetry: bool = False):
     """Host-side construction of the initial carry (init states enqueued;
     the caller bulk-inserts their fingerprints into the table).
     ``full_ebits`` is a scalar for fresh runs or a per-row array when
@@ -310,6 +324,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
         log_clo=jnp.zeros((logcap,), jnp.uint32),
         log_phi=jnp.zeros((logcap,), jnp.uint32),
         log_plo=jnp.zeros((logcap,), jnp.uint32),
+        log_ohi=jnp.zeros((logcap if symmetry else 1,), jnp.uint32),
+        log_olo=jnp.zeros((logcap if symmetry else 1,), jnp.uint32),
         log_n=jnp.int32(0),
         disc_hit=jnp.zeros((prop_count,), bool),
         disc_hi=jnp.zeros((prop_count,), jnp.uint32),

@@ -112,7 +112,7 @@ def _insert_jit():
     return _INSERT_JIT
 
 
-def build_level_fn(model):
+def build_level_fn(model, symmetry: bool = False):
     """Build the jitted single-chip BFS level step for a packed model.
 
     One launch fuses everything the reference does per state in
@@ -126,10 +126,11 @@ def build_level_fn(model):
 
     mkey = model_cache_key(model)
     if mkey is not None:
+        mkey = (mkey, symmetry)
         cached = _LEVEL_CACHE.get(mkey)
         if cached is not None:
             return cached
-    fn = _build_level_fn(model)
+    fn = _build_level_fn(model, symmetry)
     if mkey is not None:
         if len(_LEVEL_CACHE) >= 64:
             _LEVEL_CACHE.clear()
@@ -137,7 +138,7 @@ def build_level_fn(model):
     return fn
 
 
-def _build_level_fn(model):
+def _build_level_fn(model, symmetry: bool):
     import jax
     import jax.numpy as jnp
 
@@ -151,7 +152,7 @@ def _build_level_fn(model):
 
     def level_fn(frontier, fvalid, ebits, key_hi, key_lo):
         exp = expand_frontier(model, frontier, fvalid, ebits,
-                              eventually_idx)
+                              eventually_idx, symmetry=symmetry)
         inserted, key_hi, key_lo, overflow = table_insert(
             key_hi, key_lo, exp.chi, exp.clo, exp.cvalid)
 
@@ -160,15 +161,17 @@ def _build_level_fn(model):
         par_lo = jnp.repeat(exp.plo, n_actions)
         ceb = jnp.repeat(exp.ebits, n_actions)
         (count, comp_rows, comp_chi, comp_clo, comp_phi, comp_plo,
-         comp_eb) = _compact(inserted, exp.flat, exp.chi, exp.clo,
-                             par_hi, par_lo, ceb)
+         comp_eb, comp_ohi, comp_olo) = _compact(
+            inserted, exp.flat, exp.chi, exp.clo, par_hi, par_lo, ceb,
+            exp.ohi, exp.olo)
 
         disc_hit, disc_hi, disc_lo = discovery_candidates(
             properties, exp, fvalid)
         gen_count = exp.cvalid.sum(dtype=jnp.int32)
         return (key_hi, key_lo, comp_rows, comp_chi, comp_clo, comp_phi,
                 comp_plo, comp_eb, count, disc_hit, disc_hi, disc_lo,
-                gen_count, overflow, exp.phi, exp.plo, exp.xovf)
+                gen_count, overflow, exp.phi, exp.plo, exp.xovf,
+                comp_ohi, comp_olo)
 
     return jax.jit(level_fn)
 
@@ -194,12 +197,16 @@ def _level_helpers():
         def take_fn(chi, clo, phi, plo, size):
             return chi[:size], clo[:size], phi[:size], plo[:size]
 
+        def take2_fn(a, b, size):
+            return a[:size], b[:size]
+
         def take_rows_fn(rows, size):
             return rows[:size]
 
         _LEVEL_HELPERS = (jax.jit(slice_fn, static_argnums=(3,)),
                           jax.jit(take_fn, static_argnums=(4,)),
-                          jax.jit(take_rows_fn, static_argnums=(1,)))
+                          jax.jit(take_rows_fn, static_argnums=(1,)),
+                          jax.jit(take2_fn, static_argnums=(2,)))
     return _LEVEL_HELPERS
 
 
@@ -283,11 +290,21 @@ class TpuChecker(HostChecker):
         # fingerprint -> parent fingerprint mirror (host side; the
         # checkpointable search record, also used for path reconstruction).
         self._generated: Dict[int, Optional[int]] = {}
-        if builder.symmetry_fn_ is not None:
-            raise NotImplementedError(
-                "symmetry reduction on the TPU engine requires a packed "
-                "canonicalization; use spawn_dfs() for symmetry or provide "
-                "packed_representative (planned).")
+        # under symmetry: canonical fp -> the ORIGINAL explored state's fp,
+        # so witness paths replay through concrete states
+        self._orig_of: Dict[int, int] = {}
+        self._symmetry_fn = builder.symmetry_fn_
+        self._symmetry = builder.symmetry_fn_ is not None
+        if self._symmetry:
+            if not hasattr(model, "packed_representative"):
+                raise NotImplementedError(
+                    "symmetry reduction on the TPU engine requires the "
+                    "model to implement packed_representative (the device "
+                    "canonicalization); use spawn_dfs() otherwise")
+            if builder.resume_path_ is not None:
+                raise NotImplementedError(
+                    "checkpoint resume under symmetry reduction is not "
+                    "supported")
 
     @contextmanager
     def _timed(self, name: str):
@@ -343,13 +360,30 @@ class TpuChecker(HostChecker):
                        if model.within_boundary(s)]
         self._state_count = len(init_states)
         validate = getattr(model, "validate_device_state", None)
+        if self._symmetry:
+            # the host symmetry_fn and the device packed_representative
+            # must agree bit-for-bit, or dedup silently corrupts; check
+            # the init states up front (the builder API accepts any fn)
+            for s in init_states[:4]:
+                host = model.encode(self._symmetry_fn(s))
+                dev = np.asarray(model.packed_representative(
+                    model.encode(s)))
+                if not np.array_equal(host, dev):
+                    raise ValueError(
+                        "symmetry_fn disagrees with the model's "
+                        "packed_representative on an init state: host "
+                        f"canonical {host.tolist()} vs device "
+                        f"{dev.tolist()}. The device engines require the "
+                        "two canonicalizations to be bit-identical.")
         init_rows: List[np.ndarray] = []
         for s in init_states:
             if validate is not None:
                 validate(s)
-            fp = model.fingerprint(s)
+            fp = self._canon_fp(s)
             if fp not in self._generated:
                 self._generated[fp] = None
+                if self._symmetry:
+                    self._orig_of[fp] = model.fingerprint(s)
                 init_rows.append(model.encode(s))
         self._unique_state_count = len(self._generated)
         return init_rows
@@ -417,13 +451,14 @@ class TpuChecker(HostChecker):
         qcap = self._device_qcap(n_init, headroom)
         with self._timed("seed"):
             carry = seed_carry(model, qcap, self._capacity, init_rows,
-                               seed_ebits)
+                               seed_ebits, symmetry=self._symmetry)
             key_hi, key_lo = self._bulk_insert(
                 insert_fn, carry.key_hi, carry.key_lo,
                 list(generated.keys()))
             carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
             jax.block_until_ready(carry)
-        chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax, kmax)
+        chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
+                                  kmax, symmetry=self._symmetry)
 
         # --- chunk loop -------------------------------------------------
         while True:
@@ -464,7 +499,8 @@ class TpuChecker(HostChecker):
                 # buffer; nothing was committed — double kmax and resume
                 kmax = min(kmax * 2, fa)
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
-                                          fmax, kmax)
+                                          fmax, kmax,
+                                          symmetry=self._symmetry)
                 carry = carry._replace(kovf=jnp.bool_(False))
                 continue
             if self._host_props and any(
@@ -489,7 +525,8 @@ class TpuChecker(HostChecker):
                     carry, qcap = self._grow_device(carry, qcap, n_init,
                                                     headroom, insert_fn)
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
-                                          fmax, kmax)
+                                          fmax, kmax,
+                                          symmetry=self._symmetry)
 
         if self._host_props and any(
                 p.name not in discoveries for _i, p in self._host_props):
@@ -509,7 +546,8 @@ class TpuChecker(HostChecker):
         # pure host-link cost, pointless for count-only runs. Keep only
         # the log fields so the table/queue HBM is freed promptly.
         self._mirror_carry = (carry.log_chi, carry.log_clo, carry.log_phi,
-                              carry.log_plo, carry.log_n)
+                              carry.log_plo, carry.log_ohi, carry.log_olo,
+                              carry.log_n)
         self._discovery_fps.update(discoveries)
 
     def _device_qcap(self, n_init: int, headroom: int) -> int:
@@ -536,8 +574,11 @@ class TpuChecker(HostChecker):
         self._capacity = old_capacity * 4
         new_qcap = self._device_qcap(n_init, headroom)
 
+        symmetry = self._symmetry
+
         def rebuild(q_rows, q_eb, q_head, q_tail,
-                    log_chi, log_clo, log_phi, log_plo, log_n):
+                    log_chi, log_clo, log_phi, log_plo,
+                    log_ohi, log_olo, log_n):
             # copy the whole queue prefix into the larger buffer at the
             # same positions: the [0, tail) region doubles as the list of
             # every unique state's packed row (post-hoc property eval,
@@ -555,6 +596,15 @@ class TpuChecker(HostChecker):
             nl_phi = jax.lax.dynamic_update_slice(nl_phi, log_phi, (0,))
             nl_plo = jnp.zeros((self._capacity,), jnp.uint32)
             nl_plo = jax.lax.dynamic_update_slice(nl_plo, log_plo, (0,))
+            if symmetry:
+                nl_ohi = jnp.zeros((self._capacity,), jnp.uint32)
+                nl_ohi = jax.lax.dynamic_update_slice(nl_ohi, log_ohi,
+                                                      (0,))
+                nl_olo = jnp.zeros((self._capacity,), jnp.uint32)
+                nl_olo = jax.lax.dynamic_update_slice(nl_olo, log_olo,
+                                                      (0,))
+            else:
+                nl_ohi, nl_olo = log_ohi, log_olo
             # fresh table; re-insert every logged fingerprint
             key_hi = jnp.zeros((self._capacity,), jnp.uint32)
             key_lo = jnp.zeros((self._capacity,), jnp.uint32)
@@ -562,13 +612,15 @@ class TpuChecker(HostChecker):
             _, key_hi, key_lo, ovf = table_insert_local(
                 key_hi, key_lo, log_chi, log_clo, valid)
             return (nq_rows, nq_eb, key_hi, key_lo,
-                    nl_chi, nl_clo, nl_phi, nl_plo, ovf)
+                    nl_chi, nl_clo, nl_phi, nl_plo, nl_ohi, nl_olo, ovf)
 
         rebuild = jax.jit(rebuild)
         (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo, nl_phi,
-         nl_plo, ovf) = rebuild(carry.q_rows, carry.q_eb, carry.q_head,
-                                carry.q_tail, carry.log_chi, carry.log_clo,
-                                carry.log_phi, carry.log_plo, carry.log_n)
+         nl_plo, nl_ohi, nl_olo, ovf) = rebuild(
+            carry.q_rows, carry.q_eb, carry.q_head,
+            carry.q_tail, carry.log_chi, carry.log_clo,
+            carry.log_phi, carry.log_plo, carry.log_ohi, carry.log_olo,
+            carry.log_n)
         if bool(jax.device_get(ovf)):
             raise RuntimeError("overflow while re-inserting during growth")
         # fingerprints known at seed time (inits, or a resumed snapshot)
@@ -579,7 +631,7 @@ class TpuChecker(HostChecker):
             q_rows=nq_rows, q_eb=nq_eb,
             key_hi=key_hi, key_lo=key_lo,
             log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
-            log_plo=nl_plo)
+            log_plo=nl_plo, log_ohi=nl_ohi, log_olo=nl_olo)
         return carry, new_qcap
 
     # ------------------------------------------------------------------
@@ -680,7 +732,8 @@ class TpuChecker(HostChecker):
         if mirror is None:
             return
         self._mirror_carry = None
-        log_chi, log_clo, log_phi, log_plo, log_n_d = mirror
+        log_chi, log_clo, log_phi, log_plo, log_ohi, log_olo, log_n_d = \
+            mirror
         import jax
 
         with self._timed("mirror_pull"):
@@ -689,12 +742,16 @@ class TpuChecker(HostChecker):
                 return
             # pull only the live prefix (pow2-padded slice jitted on device)
             n = min(_bucket(log_n), log_chi.shape[0])
-            _slice, take_fn, _rows = _level_helpers()
+            _slice, take_fn, _rows, take2_fn = _level_helpers()
             chi, clo, phi, plo = jax.device_get(take_fn(
                 log_chi, log_clo, log_phi, log_plo, n))
             child = _combine64(chi[:log_n], clo[:log_n])
             parent = _combine64(phi[:log_n], plo[:log_n])
             self._generated.update(zip(child.tolist(), parent.tolist()))
+            if self._symmetry:
+                ohi, olo = jax.device_get(take2_fn(log_ohi, log_olo, n))
+                orig = _combine64(ohi[:log_n], olo[:log_n])
+                self._orig_of.update(zip(child.tolist(), orig.tolist()))
             self._unique_state_count = len(self._generated)
 
     # ------------------------------------------------------------------
@@ -717,9 +774,9 @@ class TpuChecker(HostChecker):
         target = self._target_state_count
         visitor = self._visitor
 
-        level_fn = build_level_fn(model)
+        level_fn = build_level_fn(model, symmetry=self._symmetry)
         insert_fn = _insert_jit()
-        slice_fn, take_fn, take_rows_fn = _level_helpers()
+        slice_fn, take_fn, take_rows_fn, take2_fn = _level_helpers()
 
         # --- init -------------------------------------------------------
         init_rows = self._seed_inits()
@@ -729,7 +786,7 @@ class TpuChecker(HostChecker):
             # the seeds, handled here on the host states directly
             for s in model.init_states():
                 if model.within_boundary(s):
-                    self._eval_host_props_state(s, model.fingerprint(s),
+                    self._eval_host_props_state(s, self._canon_fp(s),
                                                 discoveries)
 
         key_hi, key_lo = make_table(self._capacity)
@@ -762,7 +819,8 @@ class TpuChecker(HostChecker):
             while True:
                 (key_hi, key_lo, comp_rows, comp_chi, comp_clo, comp_phi,
                  comp_plo, comp_eb, count_d, disc_hit_d, disc_hi_d,
-                 disc_lo_d, gen_d, ovf_d, fp_hi_d, fp_lo_d, xovf_d) = \
+                 disc_lo_d, gen_d, ovf_d, fp_hi_d, fp_lo_d, xovf_d,
+                 comp_ohi, comp_olo) = \
                     level_fn(frontier, fvalid, ebits, key_hi, key_lo)
 
                 # small pull: scalars + per-property discovery candidates
@@ -807,6 +865,12 @@ class TpuChecker(HostChecker):
                 fp_c = _combine64(chi_h[:count], clo_h[:count])
                 fp_p = _combine64(phi_h[:count], plo_h[:count])
                 generated.update(zip(fp_c.tolist(), fp_p.tolist()))
+                if self._symmetry:
+                    ohi_h, olo_h = jax.device_get(take2_fn(
+                        comp_ohi, comp_olo, _bucket(count)))
+                    fp_o = _combine64(ohi_h[:count], olo_h[:count])
+                    self._orig_of.update(zip(fp_c.tolist(),
+                                             fp_o.tolist()))
                 if self._host_props and any(
                         p.name not in discoveries
                         for _i, p in self._host_props):
@@ -889,6 +953,13 @@ class TpuChecker(HostChecker):
                     "device hash table overflow during bulk insert")
         return key_hi, key_lo
 
+    def _canon_fp(self, state) -> int:
+        """The fingerprint dedup works in canonical-orbit space under
+        symmetry reduction, plain state space otherwise."""
+        if self._symmetry:
+            return self._model.fingerprint(self._symmetry_fn(state))
+        return self._model.fingerprint(state)
+
     def generated_fingerprints(self):
         """All visited fingerprints (pulls the device log if pending)."""
         self._ensure_mirror()
@@ -909,6 +980,9 @@ class TpuChecker(HostChecker):
             raise RuntimeError(
                 "save() needs the pending frontier: run with "
                 "tpu_options(resumable=True) on the device engine")
+        if self._symmetry:
+            raise NotImplementedError(
+                "checkpointing under symmetry reduction is not supported")
         self._ensure_mirror()
         rows, ebits = self._resume_frontier
         child = np.fromiter(self._generated.keys(), np.uint64,
@@ -962,4 +1036,18 @@ class TpuChecker(HostChecker):
 
     def _reconstruct_path(self, fp: int) -> Path:
         self._ensure_mirror()
-        return super()._reconstruct_path(fp)
+        if not self._symmetry:
+            return super()._reconstruct_path(fp)
+        # the mirror chain is canonical; translate each node to the
+        # ORIGINAL explored state's fingerprint (recorded device-side), so
+        # the replayed trace is a concrete path — the DFS engine's
+        # enqueue-original rule (`dfs.rs:260-285`) carried to the mirror
+        fingerprints: deque = deque()
+        nxt = fp
+        while nxt in self._generated:
+            fingerprints.appendleft(self._orig_of.get(nxt, nxt))
+            parent = self._generated[nxt]
+            if parent is None:
+                break
+            nxt = parent
+        return Path.from_fingerprints(self._model, fingerprints)

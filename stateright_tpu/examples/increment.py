@@ -85,6 +85,16 @@ class Increment(PackedModel):
         s = tuple((int(w) >> 4, int(w) & 0xF) for w in words[1:self.n + 1])
         return (i, s)
 
+    def packed_representative(self, words):
+        """Device canonicalization: sort the thread words — bit-exact with
+        :meth:`representative` since a thread word is ``t<<4 | pc`` and
+        the host's stable value sort over (t, pc) tuples equals integer
+        sort of the packed words (pc < 16)."""
+        import jax.numpy as jnp
+        threads = jnp.sort(words[1:self.n + 1])
+        return jnp.concatenate([words[:1], threads,
+                                words[self.n + 1:]]).astype(jnp.uint32)
+
     def packed_step(self, words):
         import jax.numpy as jnp
         i = words[0]

@@ -151,6 +151,26 @@ class TwoPhaseSys(PackedModel):
         msgs = frozenset(m for m in range(18) if msgw & (1 << m))
         return (rm_state, tm_state, tm_prepared, msgs)
 
+    def packed_representative(self, words):
+        """Device canonicalization under RM permutation: stable sort of
+        the per-RM (state, prepared, message) triples by RM state —
+        bit-exact with :meth:`representative` (the host uses the same
+        stable value sort, `2pc.rs:165-182`)."""
+        import jax.numpy as jnp
+        n = self.n
+        rmw, tm, prep, msgs = words[0], words[1], words[2], words[3]
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        r = (rmw >> (2 * idx)) & 3
+        p = (prep >> idx) & 1
+        m = (msgs >> idx) & 1  # message bit i = "RM i sent Prepared"
+        order = jnp.argsort(r, stable=True)
+        r, p, m = r[order], p[order], m[order]
+        nrmw = (r << (2 * idx)).sum().astype(jnp.uint32)
+        nprep = (p << idx).sum().astype(jnp.uint32)
+        nmsgs = ((m << idx).sum()
+                 | (msgs & ~jnp.uint32((1 << n) - 1))).astype(jnp.uint32)
+        return jnp.stack([nrmw, tm, nprep, nmsgs]).astype(jnp.uint32)
+
     def packed_step(self, words):
         import jax.numpy as jnp
         n = self.n

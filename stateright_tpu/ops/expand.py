@@ -25,9 +25,13 @@ class Expansion(NamedTuple):
     ebits: jax.Array     # uint32[F]   eventually-bits after clearing
     flat: jax.Array      # uint32[F*A, W] children (action-major per row)
     cvalid: jax.Array    # bool[F*A]   child validity (enabled & non-no-op)
-    chi: jax.Array       # uint32[F*A] child fingerprints
-    clo: jax.Array
-    phi: jax.Array       # uint32[F]   frontier fingerprints
+    chi: jax.Array       # uint32[F*A] child fingerprints (canonical under
+    clo: jax.Array       #             symmetry reduction)
+    ohi: jax.Array       # uint32[F*A] child ORIGINAL-state fingerprints
+    olo: jax.Array       #             (aliases chi/clo without symmetry);
+    #                                  recorded so witness paths replay
+    #                                  through concrete explored states
+    phi: jax.Array       # uint32[F]   frontier fingerprints (canonical)
     plo: jax.Array
     terminal: jax.Array  # bool[F]     rows with no valid action
     xovf: jax.Array      # bool[]      model capacity overflow (fatal: a
@@ -41,8 +45,25 @@ def eventually_indices(properties) -> list:
 
 
 def expand_frontier(model, frontier, fvalid, ebits,
-                    eventually_idx: Sequence[int]) -> Expansion:
-    """Evaluate properties and expand one frontier batch (pure JAX)."""
+                    eventually_idx: Sequence[int],
+                    symmetry: bool = False) -> Expansion:
+    """Evaluate properties and expand one frontier batch (pure JAX).
+
+    With ``symmetry``, fingerprints are taken over
+    ``model.packed_representative`` of each state — dedup (and the host
+    mirror) works in canonical-orbit space while the enqueued rows stay
+    original, the engine analog of the DFS engine's canonicalize-then-
+    hash-but-enqueue-original rule (`dfs.rs:260-285`). Properties are
+    evaluated on the original rows, as in the reference.
+
+    Count caveat: a representative function whose ties are broken by
+    original position (e.g. 2pc's sort-by-RM-state, `2pc.rs:165-182`) is
+    not orbit-invariant, so the reduced unique count depends on which
+    orbit member each engine reaches first — the reference's pinned
+    DFS-sym counts are specific to DFS order. Reduction stays sound
+    either way (never coarser than the orbit partition); value-complete
+    representatives (e.g. increment's full-word sort) give engine-
+    independent counts."""
     fcount = frontier.shape[0]
     width = model.packed_width
     pbits = jax.vmap(model.packed_properties)(frontier)
@@ -61,11 +82,19 @@ def expand_frontier(model, frontier, fvalid, ebits,
         xovf = jnp.bool_(False)
     avalid = avalid & fvalid[:, None]
     flat = succ.reshape((-1, width))
-    chi, clo = fp64_device(flat)
-    phi, plo = fp64_device(frontier)
+    if symmetry:
+        canon = jax.vmap(model.packed_representative)
+        chi, clo = fp64_device(canon(flat))
+        ohi, olo = fp64_device(flat)
+        phi, plo = fp64_device(canon(frontier))
+    else:
+        chi, clo = fp64_device(flat)
+        ohi, olo = chi, clo
+        phi, plo = fp64_device(frontier)
     terminal = fvalid & ~avalid.any(axis=1)
     return Expansion(pbits=pbits, ebits=ebits, flat=flat,
                      cvalid=avalid.reshape(-1), chi=chi, clo=clo,
+                     ohi=ohi, olo=olo,
                      phi=phi, plo=plo, terminal=terminal, xovf=xovf)
 
 

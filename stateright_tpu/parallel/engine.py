@@ -98,12 +98,13 @@ class ShardedTpuChecker(TpuChecker):
         insert_fn = build_sharded_insert(mesh, axis)
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
                                    init_rows, init_fps, full_ebits,
-                                   prop_count)
+                                   prop_count, symmetry=self._symmetry)
         key_hi, key_lo = self._sharded_bulk_insert(
             insert_fn, carry.key_hi, carry.key_lo, init_fps, D)
         carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
         chunk_fn = build_sharded_chunk_fn(model, mesh, axis, qcap,
-                                          self._capacity, fmax)
+                                          self._capacity, fmax,
+                                          symmetry=self._symmetry)
 
         import jax.numpy as jnp
 
@@ -157,7 +158,8 @@ class ShardedTpuChecker(TpuChecker):
                 carry, qcap = self._grow_sharded(
                     carry, qcap, n_init, headroom, init_fps, insert_fn)
                 chunk_fn = build_sharded_chunk_fn(
-                    model, mesh, axis, qcap, self._capacity, fmax)
+                    model, mesh, axis, qcap, self._capacity, fmax,
+                    symmetry=self._symmetry)
 
         self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
@@ -215,6 +217,7 @@ class ShardedTpuChecker(TpuChecker):
                 f: getattr(carry, f)
                 for f in ("q_rows", "q_eb", "q_head", "q_tail",
                           "log_chi", "log_clo", "log_phi", "log_plo",
+                          "log_ohi", "log_olo",
                           "log_n", "disc_hit", "disc_hi", "disc_lo",
                           "gen", "xovf", "steps")}))
         old_qloc = qcap // D
@@ -231,6 +234,9 @@ class ShardedTpuChecker(TpuChecker):
         log_clo = np.zeros((self._capacity,), dtype=np.uint32)
         log_phi = np.zeros((self._capacity,), dtype=np.uint32)
         log_plo = np.zeros((self._capacity,), dtype=np.uint32)
+        oshape = self._capacity if self._symmetry else D
+        log_ohi = np.zeros((oshape,), dtype=np.uint32)
+        log_olo = np.zeros((oshape,), dtype=np.uint32)
         for s in range(D):
             tail = int(h.q_tail[s])
             q_rows[s * qloc:s * qloc + tail] = \
@@ -244,6 +250,9 @@ class ShardedTpuChecker(TpuChecker):
             log_clo[dst] = h.log_clo[src]
             log_phi[dst] = h.log_phi[src]
             log_plo[dst] = h.log_plo[src]
+            if self._symmetry:
+                log_ohi[dst] = h.log_ohi[src]
+                log_olo[dst] = h.log_olo[src]
 
         sh = NamedSharding(mesh, P(axis))
         rep = NamedSharding(mesh, P())
@@ -272,6 +281,8 @@ class ShardedTpuChecker(TpuChecker):
             log_chi=d_log_chi, log_clo=d_log_clo,
             log_phi=jax.device_put(log_phi, sh),
             log_plo=jax.device_put(log_plo, sh),
+            log_ohi=jax.device_put(log_ohi, sh),
+            log_olo=jax.device_put(log_olo, sh),
             log_n=jax.device_put(h.log_n, sh),
             disc_hit=jax.device_put(h.disc_hit, rep),
             disc_hi=jax.device_put(h.disc_hi, rep),
@@ -343,6 +354,10 @@ class ShardedTpuChecker(TpuChecker):
         log_n, log_chi, log_clo, log_phi, log_plo = jax.device_get(
             (carry.log_n, carry.log_chi, carry.log_clo, carry.log_phi,
              carry.log_plo))
+        log_ohi = log_olo = None
+        if self._symmetry:
+            log_ohi, log_olo = jax.device_get(
+                (carry.log_ohi, carry.log_olo))
         for s in range(D):
             ln = int(log_n[s])
             if not ln:
@@ -351,4 +366,7 @@ class ShardedTpuChecker(TpuChecker):
             child = _combine64(log_chi[src], log_clo[src])
             parent = _combine64(log_phi[src], log_plo[src])
             self._generated.update(zip(child.tolist(), parent.tolist()))
+            if self._symmetry:
+                orig = _combine64(log_ohi[src], log_olo[src])
+                self._orig_of.update(zip(child.tolist(), orig.tolist()))
         self._unique_state_count = len(self._generated)

@@ -77,6 +77,8 @@ class ShardedCarry(NamedTuple):
     log_clo: jax.Array  # uint32[C]
     log_phi: jax.Array  # uint32[C]         parent fp
     log_plo: jax.Array  # uint32[C]
+    log_ohi: jax.Array  # uint32[C | D]     child ORIGINAL fp (symmetry
+    log_olo: jax.Array  #                   only; 1-per-shard dummy else)
     log_n: jax.Array    # int32[D]          per-shard log length
     disc_hit: jax.Array  # bool[P]    replicated: property discovered?
     disc_hi: jax.Array   # uint32[P]  replicated: witness fp (sticky first)
@@ -98,7 +100,8 @@ def carry_specs(axis: str) -> ShardedCarry:
     s, r = P(axis), P()
     return ShardedCarry(
         q_rows=s, q_eb=s, q_head=s, q_tail=s, key_hi=s, key_lo=s,
-        log_chi=s, log_clo=s, log_phi=s, log_plo=s, log_n=s,
+        log_chi=s, log_clo=s, log_phi=s, log_plo=s,
+        log_ohi=s, log_olo=s, log_n=s,
         disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
         steps=r, go=r)
 
@@ -107,7 +110,8 @@ _SHARDED_CACHE: dict = {}
 
 
 def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
-                           capacity: int, fmax: int):
+                           capacity: int, fmax: int,
+                           symmetry: bool = False):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
@@ -123,11 +127,13 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     mkey = model_cache_key(model)
     key = None
     if mkey is not None:
-        key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax)
+        key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax,
+               symmetry)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
-    fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity, fmax)
+    fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
+                                 fmax, symmetry)
     if key is not None:
         if len(_SHARDED_CACHE) >= 64:
             _SHARDED_CACHE.clear()
@@ -136,7 +142,8 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
 
 def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
-                            capacity: int, fmax: int):
+                            capacity: int, fmax: int,
+                            symmetry: bool = False):
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
     qloc = qcap // D
@@ -179,7 +186,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
         # shared check_block analog (ops/expand.py) on local rows
         exp = expand_frontier(model, frontier, fvalid, ebits,
-                              eventually_idx)
+                              eventually_idx, symmetry=symmetry)
         par_hi = jnp.repeat(exp.phi, n_actions)
         par_lo = jnp.repeat(exp.plo, n_actions)
         ceb = jnp.repeat(exp.ebits, n_actions)
@@ -193,14 +200,16 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         q_rows, q_eb = c.q_rows, c.q_eb
         log_chi, log_clo = c.log_chi, c.log_clo
         log_phi, log_plo = c.log_phi, c.log_plo
+        log_ohi, log_olo = c.log_ohi, c.log_olo
         t_ovf = jnp.bool_(False)
 
         # ownership routing: D hops around the ring; each shard claims and
         # dedups the in-flight children it owns, then forwards the rest
         rc = (exp.flat, exp.chi, exp.clo, par_hi, par_lo, ceb, exp.cvalid,
-              owner)
+              owner) + ((exp.ohi, exp.olo) if symmetry else ())
         for hop in range(D):
-            flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c, val_c, own_c = rc
+            (flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c, val_c,
+             own_c) = rc[:8]
             mine = val_c & (own_c == me)
             inserted, key_hi, key_lo, o = table_insert(
                 key_hi, key_lo, chi_c, clo_c, mine)
@@ -215,6 +224,9 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             log_clo = log_clo.at[lidx].set(clo_c, mode="drop")
             log_phi = log_phi.at[lidx].set(phi_c, mode="drop")
             log_plo = log_plo.at[lidx].set(plo_c, mode="drop")
+            if symmetry:
+                log_ohi = log_ohi.at[lidx].set(rc[8], mode="drop")
+                log_olo = log_olo.at[lidx].set(rc[9], mode="drop")
             q_tail = q_tail + cnt
             log_n = log_n + cnt
             if D > 1 and hop < D - 1:
@@ -248,7 +260,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             q_head=q_head[None], q_tail=q_tail[None],
             key_hi=key_hi, key_lo=key_lo,
             log_chi=log_chi, log_clo=log_clo,
-            log_phi=log_phi, log_plo=log_plo, log_n=log_n[None],
+            log_phi=log_phi, log_plo=log_plo,
+            log_ohi=log_ohi, log_olo=log_olo, log_n=log_n[None],
             disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
             gen=gen, ovf=ovf, xovf=xovf, steps=steps, go=go)
         return (nc, target_remaining, grow_limit)
@@ -379,7 +392,8 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
 
 def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                        capacity: int, init_rows, init_fps, full_ebits,
-                       prop_count: int) -> ShardedCarry:
+                       prop_count: int,
+                       symmetry: bool = False) -> ShardedCarry:
     """Host-side construction of the initial sharded carry: init states
     routed to their owner shards' queues. The caller inserts the init
     fingerprints into the table via :func:`build_sharded_insert`."""
@@ -412,6 +426,10 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
         log_clo=put(np.zeros((capacity,), np.uint32), sh),
         log_phi=put(np.zeros((capacity,), np.uint32), sh),
         log_plo=put(np.zeros((capacity,), np.uint32), sh),
+        log_ohi=put(np.zeros((capacity if symmetry else D,), np.uint32),
+                    sh),
+        log_olo=put(np.zeros((capacity if symmetry else D,), np.uint32),
+                    sh),
         log_n=put(np.zeros((D,), np.int32), sh),
         disc_hit=put(np.zeros((prop_count,), bool), rep),
         disc_hi=put(np.zeros((prop_count,), np.uint32), rep),
