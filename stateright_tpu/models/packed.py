@@ -106,73 +106,99 @@ class PackedModel(Model):
         return fp64_words(self.encode(state).tolist())
 
 
-def validate_packed_model(model: PackedModel, max_states: int = 2000) -> int:
-    """BFS-walk the host model, checking the host/device contract state by
-    state. Returns the number of states validated. Test helper."""
+def validate_packed_model(model: PackedModel, max_states: int = 2000,
+                          batch: int = 256) -> int:
+    """BFS-walk the host model, checking the host/device contract for
+    every reachable state (up to ``max_states``). Device calls are
+    BATCHED — one vmapped dispatch per ``batch`` states — so full
+    reachable-space checks stay fast. Returns the number of states
+    validated. Test helper."""
     import jax
     import jax.numpy as jnp
 
     from ..ops.hash_kernel import fp64_device
 
-    step = jax.jit(model.packed_step)
-    props = jax.jit(model.packed_properties)
+    step = jax.jit(jax.vmap(model.packed_step))
+    props = jax.jit(jax.vmap(model.packed_properties))
     properties = model.properties()
 
+    # host-side reachable walk first
     seen = set()
+    states = []
     queue = list(model.init_states())
-    checked = 0
-    while queue and checked < max_states:
+    while queue and len(states) < max_states:
         state = queue.pop()
         fp = model.fingerprint(state)
         if fp in seen:
             continue
         seen.add(fp)
-        checked += 1
+        states.append((state, fp))
+        queue.extend(t for t in model.next_states(state)
+                     if model.within_boundary(t))
 
-        enc = model.encode(state)
-        assert enc.dtype == np.uint32 and enc.shape == (model.packed_width,), \
-            f"encode() must return uint32[{model.packed_width}], got " \
-            f"{enc.dtype}[{enc.shape}]"
-        # decode round-trips through encode
-        redec = model.decode(enc)
-        assert np.array_equal(model.encode(redec), enc), \
-            f"decode(encode(s)) != s for {state!r}"
-        # device fingerprint matches host fingerprint
-        dhi, dlo = fp64_device(jnp.asarray(enc)[None, :])
-        dev_fp = (int(dhi[0]) << 32) | int(dlo[0])
+    for start in range(0, len(states), batch):
+        chunk = states[start:start + batch]
+        encs = []
+        for state, fp in chunk:
+            enc = model.encode(state)
+            assert enc.dtype == np.uint32 \
+                and enc.shape == (model.packed_width,), \
+                f"encode() must return uint32[{model.packed_width}], " \
+                f"got {enc.dtype}[{enc.shape}]"
+            redec = model.decode(enc)
+            assert np.array_equal(model.encode(redec), enc), \
+                f"decode(encode(s)) != s for {state!r}"
+            encs.append(enc)
+        # pad the final partial chunk so every dispatch shares one
+        # compiled shape (pad rows replicate row 0 and are never checked)
+        pad = batch - len(encs)
+        if pad and start:
+            encs = encs + [encs[0]] * pad
+        rows = jnp.asarray(np.stack(encs))
+        dhi, dlo = fp64_device(rows)
+        dhi, dlo = np.asarray(dhi), np.asarray(dlo)
+        out = step(rows)
+        succ, valid = np.asarray(out[0]), np.asarray(out[1])
+        if len(out) == 3:
+            ovf = np.asarray(out[2])
+        else:
+            ovf = np.zeros_like(valid)
+        _validate_batch(model, chunk, dhi, dlo, succ, valid, ovf)
+        _validate_props_batch(model, chunk, np.asarray(props(rows)),
+                              properties)
+    return len(states)
+
+
+def _validate_batch(model, chunk, dhi, dlo, succ, valid, ovf) -> None:
+    for k, (state, fp) in enumerate(chunk):
+        dev_fp = (int(dhi[k]) << 32) | int(dlo[k])
         assert dev_fp == fp, \
             f"device fp {dev_fp:#x} != host fp {fp:#x} for {state!r}"
-        # packed successors match host successors (as multisets of encodings)
-        out = step(jnp.asarray(enc))
-        succ, valid = out[0], out[1]
-        if len(out) == 3:
-            assert not np.asarray(out[2]).any(), \
-                f"packed_step reports encoding overflow for {state!r}"
-        succ = np.asarray(succ)
-        valid = np.asarray(valid)
-        packed_succ = sorted(tuple(succ[a].tolist())
-                             for a in range(model.max_actions) if valid[a])
+        assert not ovf[k].any(), \
+            f"packed_step reports encoding overflow for {state!r}"
+        packed_succ = sorted(tuple(succ[k, a].tolist())
+                             for a in range(model.max_actions)
+                             if valid[k, a])
         host_succ = sorted(tuple(model.encode(t).tolist())
                            for t in model.next_states(state)
                            if model.within_boundary(t))
         assert packed_succ == host_succ, \
-            f"packed successors disagree with host successors for {state!r}:" \
-            f"\n packed={packed_succ}\n host={host_succ}"
-        # packed properties match host property conditions (host-evaluated
-        # properties return a neutral placeholder on device — skip them)
-        host_props = set(getattr(model, "host_property_indices", ()))
-        pb = np.asarray(props(jnp.asarray(enc)))
+            "packed successors disagree with host successors for " \
+            f"{state!r}:\n packed={packed_succ}\n host={host_succ}"
+
+
+def _validate_props_batch(model, chunk, pb, properties) -> None:
+    # packed properties match host property conditions (host-evaluated
+    # properties return a neutral placeholder on device — skip them)
+    host_props = set(getattr(model, "host_property_indices", ()))
+    for k, (state, _fp) in enumerate(chunk):
         for i, prop in enumerate(properties):
             if i in host_props:
                 continue
             want = bool(prop.condition(model, state))
-            assert bool(pb[i]) == want, \
-                f"packed property {prop.name!r} = {bool(pb[i])} != host " \
-                f"{want} for {state!r}"
-        for t in model.next_states(state):
-            if model.within_boundary(t):
-                queue.append(t)
-    return checked
+            assert bool(pb[k, i]) == want, \
+                f"packed property {prop.name!r} = {bool(pb[k, i])} != " \
+                f"host {want} for {state!r}"
 
 
 class PackedLinearEquation(PackedModel):

@@ -350,7 +350,6 @@ class TestPosthocHostProps:
         assert ck.discovery("solvable") is None
 
 
-@pytest.mark.slow
 def test_packed_contract_2pc_n5_full():
     """Full 8,832-state contract check (2pc.rs:133): every reachable
     state's encode/decode round-trip, device fingerprint, and packed
