@@ -9,7 +9,7 @@ sockets via :func:`spawn` — the framework's signature dual use.
 
 from .core import (Actor, CancelTimer, Envelope, Id, Out, ScriptedActor,
                    Send, SetTimer, is_no_op, majority, model_peers,
-                   model_timeout)
+                   model_timeout, peer_ids)
 from .model import (ActorModel, ActorModelState, Deliver, Drop, Timeout)
 from .network import (Network, Ordered, UnorderedDuplicating,
                       UnorderedNonDuplicating)
@@ -22,5 +22,5 @@ __all__ = [
     "PackedActorModel", "ScriptedActor", "Send", "SetTimer",
     "SpawnHandle", "Timeout", "UnorderedDuplicating",
     "UnorderedNonDuplicating", "is_no_op", "majority", "model_peers",
-    "model_timeout", "spawn",
+    "model_timeout", "peer_ids", "spawn",
 ]

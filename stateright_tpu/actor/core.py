@@ -144,6 +144,12 @@ def majority(participant_count: int) -> int:
     return participant_count // 2 + 1
 
 
+def peer_ids(self_id: Id, other_ids) -> List[Id]:
+    """Filter one's own id out of an id collection
+    (`src/actor.rs:445-447`)."""
+    return [i for i in other_ids if i != self_id]
+
+
 def model_peers(self_ix: int, count: int) -> List[Id]:
     """All ids but one's own (`src/actor/model.rs:68-73`)."""
     return [Id(j) for j in range(count) if j != self_ix]

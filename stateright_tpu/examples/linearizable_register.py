@@ -236,6 +236,9 @@ def main(argv=None) -> None:
         (AbdModelCfg(client_count=client_count, server_count=3,
                      network=Network.new_unordered_nonduplicating())
          .into_model().checker().serve(address))
+    elif cmd == "spawn":
+        from .register_spawn import spawn_abd_cluster
+        spawn_abd_cluster()
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.linearizable_register "
@@ -244,6 +247,8 @@ def main(argv=None) -> None:
               "check-tpu [CLIENT_COUNT]")
         print("  python -m stateright_tpu.examples.linearizable_register "
               "explore [CLIENT_COUNT] [ADDRESS]")
+        print("  python -m stateright_tpu.examples.linearizable_register "
+              "spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")
 
 

@@ -1,0 +1,112 @@
+"""Run real register servers over localhost UDP (the ``spawn``
+subcommands of `single-copy-register` and `linearizable-register`).
+
+Ports of the reference's spawn branches
+(`/root/reference/examples/single-copy-register.rs:168-186`,
+`linearizable-register.rs:328-349`): the *same* actor objects the checker
+verified, executed by the UDP runtime with netcat-friendly JSON:
+
+    $ nc -u localhost 3000
+    {"Put": [1, "X"]}
+    {"Get": [2]}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..actor import Id, peer_ids
+from ..actor.register import Get, GetOk, Internal, Put, PutOk
+from ..actor.runtime import SpawnHandle, spawn
+from .linearizable_register import (AbdActor, AckQuery, AckRecord, Query,
+                                    Record)
+from .single_copy_register import SingleCopyActor
+
+
+def msg_to_json(msg: Any) -> bytes:
+    """Externally-tagged JSON (the shape serde_json gives the reference's
+    enums)."""
+    if isinstance(msg, Put):
+        obj = {"Put": [msg.request_id, msg.value]}
+    elif isinstance(msg, Get):
+        obj = {"Get": [msg.request_id]}
+    elif isinstance(msg, PutOk):
+        obj = {"PutOk": [msg.request_id]}
+    elif isinstance(msg, GetOk):
+        obj = {"GetOk": [msg.request_id, msg.value]}
+    elif isinstance(msg, Internal):
+        inner = msg.msg
+        if isinstance(inner, Query):
+            iobj = {"Query": [inner.request_id]}
+        elif isinstance(inner, AckQuery):
+            iobj = {"AckQuery": [inner.request_id, list(inner.seq),
+                                 inner.value]}
+        elif isinstance(inner, Record):
+            iobj = {"Record": [inner.request_id, list(inner.seq),
+                               inner.value]}
+        elif isinstance(inner, AckRecord):
+            iobj = {"AckRecord": [inner.request_id]}
+        else:
+            raise TypeError(f"unknown internal message {inner!r}")
+        obj = {"Internal": iobj}
+    else:
+        raise TypeError(f"unknown message {msg!r}")
+    return json.dumps(obj).encode()
+
+
+def msg_from_json(data: bytes) -> Any:
+    obj = json.loads(data)
+    (tag, value), = obj.items()
+    if tag == "Put":
+        return Put(value[0], value[1])
+    if tag == "Get":
+        return Get(value[0])
+    if tag == "PutOk":
+        return PutOk(value[0])
+    if tag == "GetOk":
+        return GetOk(value[0], value[1])
+    if tag == "Internal":
+        (itag, ivalue), = value.items()
+        if itag == "Query":
+            return Internal(Query(ivalue[0]))
+        if itag == "AckQuery":
+            return Internal(AckQuery(ivalue[0], tuple(ivalue[1]),
+                                     ivalue[2]))
+        if itag == "Record":
+            return Internal(Record(ivalue[0], tuple(ivalue[1]),
+                                   ivalue[2]))
+        if itag == "AckRecord":
+            return Internal(AckRecord(ivalue[0]))
+    raise ValueError(f"unknown message tag in {obj!r}")
+
+
+def _banner(kind: str, port: int) -> None:
+    print(f"  A server that implements a {kind}.")
+    print("  You can interact with the server using netcat. Example:")
+    print(f"$ nc -u localhost {port}")
+    print(msg_to_json(Put(1, 'X')).decode())
+    print(msg_to_json(Get(2)).decode())
+    print()
+
+
+def spawn_single_copy(port: int = 3000,
+                      background: bool = False) -> SpawnHandle:
+    """One unreplicated register server
+    (`single-copy-register.rs:168-186`)."""
+    _banner("single-copy register", port)
+    localhost = (127, 0, 0, 1)
+    actors = [(Id.from_socket_addr(localhost, port), SingleCopyActor())]
+    return spawn(msg_to_json, msg_from_json, actors, background=background)
+
+
+def spawn_abd_cluster(port: int = 3000,
+                      background: bool = False) -> SpawnHandle:
+    """Three ABD replicas (`linearizable-register.rs:328-349`). As in the
+    reference, omits the ordered reliable link to keep the protocol
+    netcat-friendly."""
+    _banner("linearizable register", port)
+    localhost = (127, 0, 0, 1)
+    ids = [Id.from_socket_addr(localhost, port + i) for i in range(3)]
+    actors = [(i, AbdActor(peer_ids(i, ids))) for i in ids]
+    return spawn(msg_to_json, msg_from_json, actors, background=background)

@@ -92,12 +92,17 @@ def main(argv=None) -> None:
         (SingleCopyModelCfg(client_count=client_count, server_count=1,
                             network=Network.new_unordered_nonduplicating())
          .into_model().checker().serve(address))
+    elif cmd == "spawn":
+        from .register_spawn import spawn_single_copy
+        spawn_single_copy()
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.single_copy_register "
               "check [CLIENT_COUNT] [NETWORK]")
         print("  python -m stateright_tpu.examples.single_copy_register "
               "explore [CLIENT_COUNT] [ADDRESS]")
+        print("  python -m stateright_tpu.examples.single_copy_register "
+              "spawn")
         print(f"NETWORK: {' | '.join(Network.names())}")
 
 

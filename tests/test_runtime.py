@@ -133,3 +133,103 @@ class TestSpawnRuntime:
             assert value == 'X'
         finally:
             handle.stop()
+
+
+def _free_udp_port(span: int = 1) -> int:
+    """A base port with ``span`` consecutive free UDP ports (probe-then-
+    release; the tiny race is acceptable for tests)."""
+    import socket as _socket
+    for base in range(34500, 60000, span):
+        socks = []
+        try:
+            for k in range(span):
+                s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                s.bind(("127.0.0.1", base + k))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free UDP port span found")
+
+
+def _recv_for_request(sock, request_id):
+    """Receive until a reply tagged with ``request_id`` (drains stale
+    duplicate replies caused by the startup retry loop)."""
+    import time as _time
+
+    from stateright_tpu.examples.register_spawn import msg_from_json
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        msg = msg_from_json(sock.recv(1024))
+        if getattr(msg, "request_id", None) == request_id:
+            return msg
+    raise AssertionError(f"no reply for request {request_id}")
+
+
+class TestRegisterSpawn:
+    def test_single_copy_over_udp(self):
+        """Real Put/Get against a spawned single-copy server
+        (`single-copy-register.rs:168-186`)."""
+        import socket
+
+        from stateright_tpu.examples.register_spawn import (
+            msg_from_json, msg_to_json, spawn_single_copy)
+        from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+
+        port = _free_udp_port()
+        handle = spawn_single_copy(port=port, background=True)
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind(("127.0.0.1", 0))
+            sock.settimeout(1.0)
+            reply = None
+            for _attempt in range(5):  # ride out server startup
+                sock.sendto(msg_to_json(Put(1, 'X')), ("127.0.0.1", port))
+                try:
+                    reply = msg_from_json(sock.recv(1024))
+                    break
+                except socket.timeout:
+                    continue
+            assert reply == PutOk(1)
+            sock.settimeout(5.0)
+            sock.sendto(msg_to_json(Get(2)), ("127.0.0.1", port))
+            reply = _recv_for_request(sock, 2)  # skip stale retry PutOks
+            assert reply == GetOk(2, 'X')
+        finally:
+            handle.stop()
+
+    def test_abd_cluster_over_udp(self):
+        """Real Put/Get against a spawned 3-replica ABD cluster
+        (`linearizable-register.rs:328-349`)."""
+        import socket
+
+        from stateright_tpu.examples.register_spawn import (
+            msg_from_json, msg_to_json, spawn_abd_cluster)
+        from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+
+        port = _free_udp_port(span=3)
+        handle = spawn_abd_cluster(port=port, background=True)
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind(("127.0.0.1", 0))
+            sock.settimeout(1.0)
+            reply = None
+            for _attempt in range(5):  # ride out server startup
+                sock.sendto(msg_to_json(Put(1, 'Z')), ("127.0.0.1", port))
+                try:
+                    reply = msg_from_json(sock.recv(1024))
+                    break
+                except socket.timeout:
+                    continue
+            assert reply == PutOk(1)
+            sock.settimeout(5.0)
+            # read through a DIFFERENT replica: quorum replication must
+            # surface the written value
+            sock.sendto(msg_to_json(Get(2)), ("127.0.0.1", port + 1))
+            reply = _recv_for_request(sock, 2)  # skip stale retry PutOks
+            assert reply == GetOk(2, 'Z')
+        finally:
+            handle.stop()
