@@ -167,3 +167,44 @@ class RegisterServer(Actor):
             return None
         inner = self.server_actor.on_timeout(id, state.state, o)
         return None if inner is None else ServerState(inner)
+
+
+# --- wire serde for the spawn runtime (`register.rs` + serde_json shape) ----
+
+def register_msg_to_json(msg, encode_internal) -> bytes:
+    """Externally-tagged JSON for the register vocabulary; protocol
+    internals delegate to ``encode_internal(inner) -> dict``."""
+    import json
+    if isinstance(msg, Put):
+        obj = {"Put": [msg.request_id, msg.value]}
+    elif isinstance(msg, Get):
+        obj = {"Get": [msg.request_id]}
+    elif isinstance(msg, PutOk):
+        obj = {"PutOk": [msg.request_id]}
+    elif isinstance(msg, GetOk):
+        obj = {"GetOk": [msg.request_id, msg.value]}
+    elif isinstance(msg, Internal):
+        obj = {"Internal": encode_internal(msg.msg)}
+    else:
+        raise TypeError(f"unknown message {msg!r}")
+    return json.dumps(obj).encode()
+
+
+def register_msg_from_json(data: bytes, decode_internal):
+    """Inverse of :func:`register_msg_to_json`; ``decode_internal(tag,
+    value)`` handles the protocol's internal messages."""
+    import json
+    obj = json.loads(data)
+    (tag, value), = obj.items()
+    if tag == "Put":
+        return Put(value[0], value[1])
+    if tag == "Get":
+        return Get(value[0])
+    if tag == "PutOk":
+        return PutOk(value[0])
+    if tag == "GetOk":
+        return GetOk(value[0], value[1])
+    if tag == "Internal":
+        (itag, ivalue), = value.items()
+        return Internal(decode_internal(itag, ivalue))
+    raise ValueError(f"unknown message tag in {obj!r}")

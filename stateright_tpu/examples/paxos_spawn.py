@@ -18,8 +18,9 @@ from __future__ import annotations
 import json
 from typing import Any
 
-from ..actor import Id
-from ..actor.register import Get, GetOk, Internal, Put, PutOk
+from ..actor import Id, peer_ids
+from ..actor.register import (Get, GetOk, Internal, Put, PutOk,
+                              register_msg_from_json, register_msg_to_json)
 from ..actor.runtime import SpawnHandle, spawn
 from .paxos import Accept, Accepted, Decided, PaxosActor, Prepare, Prepared
 
@@ -40,37 +41,25 @@ def _la_json(la):
     return [_ballot_json(la[0]), _proposal_json(la[1])]
 
 
+def _encode_internal(inner: Any) -> dict:
+    if isinstance(inner, Prepare):
+        return {"Prepare": [_ballot_json(inner.ballot)]}
+    if isinstance(inner, Prepared):
+        return {"Prepared": [_ballot_json(inner.ballot),
+                             _la_json(inner.last_accepted)]}
+    if isinstance(inner, Accept):
+        return {"Accept": [_ballot_json(inner.ballot),
+                           _proposal_json(inner.proposal)]}
+    if isinstance(inner, Accepted):
+        return {"Accepted": [_ballot_json(inner.ballot)]}
+    assert isinstance(inner, Decided), inner
+    return {"Decided": [_ballot_json(inner.ballot),
+                        _proposal_json(inner.proposal)]}
+
+
 def msg_to_json(msg: Any) -> bytes:
     """Externally-tagged JSON encoding of a register/paxos message."""
-    if isinstance(msg, Put):
-        obj = {"Put": [msg.request_id, msg.value]}
-    elif isinstance(msg, Get):
-        obj = {"Get": [msg.request_id]}
-    elif isinstance(msg, PutOk):
-        obj = {"PutOk": [msg.request_id]}
-    elif isinstance(msg, GetOk):
-        obj = {"GetOk": [msg.request_id, msg.value]}
-    elif isinstance(msg, Internal):
-        inner = msg.msg
-        if isinstance(inner, Prepare):
-            iobj = {"Prepare": [_ballot_json(inner.ballot)]}
-        elif isinstance(inner, Prepared):
-            iobj = {"Prepared": [_ballot_json(inner.ballot),
-                                 _la_json(inner.last_accepted)]}
-        elif isinstance(inner, Accept):
-            iobj = {"Accept": [_ballot_json(inner.ballot),
-                               _proposal_json(inner.proposal)]}
-        elif isinstance(inner, Accepted):
-            iobj = {"Accepted": [_ballot_json(inner.ballot)]}
-        elif isinstance(inner, Decided):
-            iobj = {"Decided": [_ballot_json(inner.ballot),
-                                _proposal_json(inner.proposal)]}
-        else:
-            raise TypeError(f"unknown internal message {inner!r}")
-        obj = {"Internal": iobj}
-    else:
-        raise TypeError(f"unknown message {msg!r}")
-    return json.dumps(obj).encode()
+    return register_msg_to_json(msg, _encode_internal)
 
 
 def _ballot_from(v):
@@ -87,33 +76,21 @@ def _la_from(v):
     return (_ballot_from(v[0]), _proposal_from(v[1]))
 
 
+def _decode_internal(tag: str, value) -> Any:
+    if tag == "Prepare":
+        return Prepare(_ballot_from(value[0]))
+    if tag == "Prepared":
+        return Prepared(_ballot_from(value[0]), _la_from(value[1]))
+    if tag == "Accept":
+        return Accept(_ballot_from(value[0]), _proposal_from(value[1]))
+    if tag == "Accepted":
+        return Accepted(_ballot_from(value[0]))
+    assert tag == "Decided", tag
+    return Decided(_ballot_from(value[0]), _proposal_from(value[1]))
+
+
 def msg_from_json(data: bytes) -> Any:
-    obj = json.loads(data)
-    (tag, value), = obj.items()
-    if tag == "Put":
-        return Put(value[0], value[1])
-    if tag == "Get":
-        return Get(value[0])
-    if tag == "PutOk":
-        return PutOk(value[0])
-    if tag == "GetOk":
-        return GetOk(value[0], value[1])
-    if tag == "Internal":
-        (itag, ivalue), = value.items()
-        if itag == "Prepare":
-            return Internal(Prepare(_ballot_from(ivalue[0])))
-        if itag == "Prepared":
-            return Internal(Prepared(_ballot_from(ivalue[0]),
-                                     _la_from(ivalue[1])))
-        if itag == "Accept":
-            return Internal(Accept(_ballot_from(ivalue[0]),
-                                   _proposal_from(ivalue[1])))
-        if itag == "Accepted":
-            return Internal(Accepted(_ballot_from(ivalue[0])))
-        if itag == "Decided":
-            return Internal(Decided(_ballot_from(ivalue[0]),
-                                    _proposal_from(ivalue[1])))
-    raise ValueError(f"unknown message tag in {obj!r}")
+    return register_msg_from_json(data, _decode_internal)
 
 
 def spawn_paxos_cluster(port: int = 3000,
@@ -131,8 +108,5 @@ def spawn_paxos_cluster(port: int = 3000,
     # the message protocol simple for nc.
     localhost = (127, 0, 0, 1)
     ids = [Id.from_socket_addr(localhost, port + i) for i in range(3)]
-    actors = [
-        (ids[i], PaxosActor([ids[j] for j in range(3) if j != i]))
-        for i in range(3)
-    ]
+    actors = [(i, PaxosActor(peer_ids(i, ids))) for i in ids]
     return spawn(msg_to_json, msg_from_json, actors, background=background)

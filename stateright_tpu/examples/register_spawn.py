@@ -13,72 +13,46 @@ verified, executed by the UDP runtime with netcat-friendly JSON:
 
 from __future__ import annotations
 
-import json
 from typing import Any
 
 from ..actor import Id, peer_ids
-from ..actor.register import Get, GetOk, Internal, Put, PutOk
+from ..actor.register import (Get, Put, register_msg_from_json,
+                              register_msg_to_json)
 from ..actor.runtime import SpawnHandle, spawn
 from .linearizable_register import (AbdActor, AckQuery, AckRecord, Query,
                                     Record)
 from .single_copy_register import SingleCopyActor
 
 
+def _encode_internal(inner: Any) -> dict:
+    if isinstance(inner, Query):
+        return {"Query": [inner.request_id]}
+    if isinstance(inner, AckQuery):
+        return {"AckQuery": [inner.request_id, list(inner.seq),
+                             inner.value]}
+    if isinstance(inner, Record):
+        return {"Record": [inner.request_id, list(inner.seq), inner.value]}
+    assert isinstance(inner, AckRecord), inner
+    return {"AckRecord": [inner.request_id]}
+
+
+def _decode_internal(tag: str, value) -> Any:
+    if tag == "Query":
+        return Query(value[0])
+    if tag == "AckQuery":
+        return AckQuery(value[0], tuple(value[1]), value[2])
+    if tag == "Record":
+        return Record(value[0], tuple(value[1]), value[2])
+    assert tag == "AckRecord", tag
+    return AckRecord(value[0])
+
+
 def msg_to_json(msg: Any) -> bytes:
-    """Externally-tagged JSON (the shape serde_json gives the reference's
-    enums)."""
-    if isinstance(msg, Put):
-        obj = {"Put": [msg.request_id, msg.value]}
-    elif isinstance(msg, Get):
-        obj = {"Get": [msg.request_id]}
-    elif isinstance(msg, PutOk):
-        obj = {"PutOk": [msg.request_id]}
-    elif isinstance(msg, GetOk):
-        obj = {"GetOk": [msg.request_id, msg.value]}
-    elif isinstance(msg, Internal):
-        inner = msg.msg
-        if isinstance(inner, Query):
-            iobj = {"Query": [inner.request_id]}
-        elif isinstance(inner, AckQuery):
-            iobj = {"AckQuery": [inner.request_id, list(inner.seq),
-                                 inner.value]}
-        elif isinstance(inner, Record):
-            iobj = {"Record": [inner.request_id, list(inner.seq),
-                               inner.value]}
-        elif isinstance(inner, AckRecord):
-            iobj = {"AckRecord": [inner.request_id]}
-        else:
-            raise TypeError(f"unknown internal message {inner!r}")
-        obj = {"Internal": iobj}
-    else:
-        raise TypeError(f"unknown message {msg!r}")
-    return json.dumps(obj).encode()
+    return register_msg_to_json(msg, _encode_internal)
 
 
 def msg_from_json(data: bytes) -> Any:
-    obj = json.loads(data)
-    (tag, value), = obj.items()
-    if tag == "Put":
-        return Put(value[0], value[1])
-    if tag == "Get":
-        return Get(value[0])
-    if tag == "PutOk":
-        return PutOk(value[0])
-    if tag == "GetOk":
-        return GetOk(value[0], value[1])
-    if tag == "Internal":
-        (itag, ivalue), = value.items()
-        if itag == "Query":
-            return Internal(Query(ivalue[0]))
-        if itag == "AckQuery":
-            return Internal(AckQuery(ivalue[0], tuple(ivalue[1]),
-                                     ivalue[2]))
-        if itag == "Record":
-            return Internal(Record(ivalue[0], tuple(ivalue[1]),
-                                   ivalue[2]))
-        if itag == "AckRecord":
-            return Internal(AckRecord(ivalue[0]))
-    raise ValueError(f"unknown message tag in {obj!r}")
+    return register_msg_from_json(data, _decode_internal)
 
 
 def _banner(kind: str, port: int) -> None:
