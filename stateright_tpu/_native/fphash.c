@@ -1,27 +1,24 @@
 /* Native fingerprint core.
  *
- * C implementation of the two-lane murmur3-style 64-bit fingerprint defined
- * in stateright_tpu/fingerprint.py (the host reference) and mirrored by the
- * device kernel in ops/hash_kernel.py. The reference's stable hasher is
- * native too (fixed-key aHash, /root/reference/src/lib.rs:331-344); this is
- * its host-side equivalent. Built at import time by _native/__init__.py and
- * loaded via ctypes; the pure-Python implementation remains the fallback
- * and the bit-exactness oracle (differential-tested in tests).
+ * C implementation of the column-parallel two-lane 64-bit fingerprint
+ * defined in stateright_tpu/fingerprint.py (the host reference) and
+ * mirrored by the device kernel in ops/hash_kernel.py. The reference's
+ * stable hasher is native too (fixed-key aHash,
+ * /root/reference/src/lib.rs:331-344); this is its host-side equivalent.
+ * Built at import time by _native/__init__.py and loaded via ctypes; the
+ * pure-Python implementation remains the fallback and the bit-exactness
+ * oracle (differential-tested in tests).
  */
 
 #include <stddef.h>
 #include <stdint.h>
+#include <stdlib.h>
 
 #define C1_1 0xCC9E2D51u
-#define C2_1 0x1B873593u
 #define C1_2 0x239B961Bu
-#define C2_2 0xAB0E9789u
+#define GOLDEN 0x9E3779B9u
 #define SEED1 0x9747B28Cu
 #define SEED2 0x85EBCA6Bu
-
-static inline uint32_t rotl32(uint32_t x, int r) {
-    return (x << r) | (x >> (32 - r));
-}
 
 static inline uint32_t fmix32(uint32_t h) {
     h ^= h >> 16;
@@ -32,35 +29,55 @@ static inline uint32_t fmix32(uint32_t h) {
     return h;
 }
 
-uint64_t fp64_words(const uint32_t *words, size_t n) {
-    uint32_t h1 = SEED1, h2 = SEED2;
-    for (size_t i = 0; i < n; i++) {
-        uint32_t w = words[i];
-        uint32_t k = w * C1_1;
-        k = rotl32(k, 15);
-        k *= C2_1;
-        h1 ^= k;
-        h1 = rotl32(h1, 13);
-        h1 = h1 * 5u + 0xE6546B64u;
+/* Per-position whitening key P_i = fmix32((i + 1) * GOLDEN). */
+static inline uint32_t col_key(size_t i) {
+    return fmix32((uint32_t)(i + 1) * GOLDEN);
+}
 
-        k = w * C1_2;
-        k = rotl32(k, 16);
-        k *= C2_2;
-        h2 ^= k;
-        h2 = rotl32(h2, 13);
-        h2 = h2 * 5u + 0x561CCD1Bu;
+uint64_t fp64_words(const uint32_t *words, size_t n) {
+    uint32_t h1 = 0, h2 = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint32_t x = words[i] ^ col_key(i);
+        h1 ^= fmix32(x * C1_1);
+        h2 ^= fmix32(x * C1_2);
     }
-    h1 = fmix32(h1 ^ (uint32_t)n);
-    h2 = fmix32(h2 ^ (uint32_t)n);
+    h1 = fmix32(h1 ^ SEED1 ^ (uint32_t)n);
+    h2 = fmix32(h2 ^ SEED2 ^ ((uint32_t)n * C1_1));
     uint64_t fp = ((uint64_t)h1 << 32) | (uint64_t)h2;
     return fp ? fp : 1u;
 }
 
 /* Batch variant: fingerprint `count` rows of `width` words each (row-major),
- * writing one uint64 per row. Used for bulk host-side mirroring. */
+ * writing one uint64 per row. Used for bulk host-side mirroring. The
+ * whitening keys are computed once per call, not once per row. */
 void fp64_rows(const uint32_t *rows, size_t count, size_t width,
                uint64_t *out) {
-    for (size_t r = 0; r < count; r++) {
-        out[r] = fp64_words(rows + r * width, width);
+    uint32_t stack_keys[256];
+    uint32_t *keys = stack_keys;
+    if (width > 256) {
+        keys = (uint32_t *)malloc(width * sizeof(uint32_t));
+        if (!keys) { /* fall back to the scalar path */
+            for (size_t r = 0; r < count; r++)
+                out[r] = fp64_words(rows + r * width, width);
+            return;
+        }
     }
+    for (size_t i = 0; i < width; i++)
+        keys[i] = col_key(i);
+    uint32_t fin2 = (uint32_t)width * C1_1;
+    for (size_t r = 0; r < count; r++) {
+        const uint32_t *row = rows + r * width;
+        uint32_t h1 = 0, h2 = 0;
+        for (size_t i = 0; i < width; i++) {
+            uint32_t x = row[i] ^ keys[i];
+            h1 ^= fmix32(x * C1_1);
+            h2 ^= fmix32(x * C1_2);
+        }
+        h1 = fmix32(h1 ^ SEED1 ^ (uint32_t)width);
+        h2 = fmix32(h2 ^ SEED2 ^ fin2);
+        uint64_t fp = ((uint64_t)h1 << 32) | (uint64_t)h2;
+        out[r] = fp ? fp : 1u;
+    }
+    if (keys != stack_keys)
+        free(keys);
 }

@@ -211,17 +211,17 @@ def _level_helpers():
 
 
 def auto_fmax(model, shards: int = 1) -> int:
-    """Default expansion width: ~8M child lane-words per iteration
+    """Default expansion width: ~16M child lane-words per iteration
     (divided across shards) — empirically the knee of the lane-cost curve
-    across model shapes (narrow 2pc, wide packed-actor states) with
-    mask-arithmetic handlers. Shared by the single-chip and sharded
-    engines so the knee is tuned in one place. The floor (1024 rows on a
-    single chip, divided across shards down to 256) keeps enough frontier
-    rows per iteration to amortize the fixed per-iteration cost on very
-    wide models."""
+    across model shapes (narrow 2pc, wide packed-actor states) after the
+    incremental-network/bucketed-probe rework dropped the per-lane cost.
+    Shared by the single-chip and sharded engines so the knee is tuned in
+    one place. The floor (1024 rows on a single chip, divided across
+    shards down to 256) keeps enough frontier rows per iteration to
+    amortize the fixed per-iteration cost on very wide models."""
     return max(max(256, (1 << 10) // shards), min(
         1 << 13,
-        (1 << 23) // (model.max_actions * model.packed_width * shards)))
+        (1 << 24) // (model.max_actions * model.packed_width * shards)))
 
 
 def _enable_compile_cache() -> None:
@@ -279,6 +279,13 @@ class TpuChecker(HostChecker):
                     "supported on the TPU engine; evaluate them with the "
                     "host engines")
         self._host_prop_cache: Dict[bytes, List[bool]] = {}
+        # incremental post-hoc reduction state (device engine): the
+        # history-key dedup table persists across chunks and only queue
+        # rows appended since the last pass are reduced
+        self._posthoc_table = None
+        self._posthoc_start = 0
+        self._posthoc_hmax = int(opts.get("hmax", 1 << 14))
+        self._posthoc_cap = int(opts.get("hcap", 1 << 16))
         # wall-time per engine phase (seconds), for report()/bench tuning
         self._prof: Dict[str, float] = {}
         # device-resident search record, pulled lazily by _ensure_mirror
@@ -511,7 +518,7 @@ class TpuChecker(HostChecker):
                 # full exhaustion
                 with self._timed("posthoc"):
                     self._posthoc_eval(carry, qcap, n_init, seed_fps,
-                                       discoveries)
+                                       discoveries, int(q_tail))
             done = (q_size == 0
                     or len(discoveries) == prop_count
                     or (target is not None
@@ -532,7 +539,7 @@ class TpuChecker(HostChecker):
                 p.name not in discoveries for _i, p in self._host_props):
             with self._timed("posthoc"):
                 self._posthoc_eval(carry, qcap, n_init, seed_fps,
-                                   discoveries)
+                                   discoveries, int(q_tail))
         if self._tpu_options.get("resumable"):
             # pull the pending frontier eagerly so save() needs no pinned
             # device buffers
@@ -637,11 +644,13 @@ class TpuChecker(HostChecker):
     # ------------------------------------------------------------------
     _POSTHOC_CACHE: dict = {}
 
-    def _posthoc_fn(self, qcap: int, capacity: int, hmax: int):
+    def _posthoc_fn(self, rmax: int, capacity: int, hmax: int):
         """Jitted device reduction for post-hoc host-property evaluation:
-        dedup the reached set (the queue prefix) by host-property columns
-        and emit one representative row + witness fingerprint per distinct
-        key."""
+        dedup a queue region ``[q_start, q_start + rmax)`` by host-property
+        columns against a PERSISTENT history-key table and emit one
+        representative row + witness fingerprint per newly seen key. The
+        device work is O(region), not O(queue): only the rows appended
+        since the last pass are sliced out, hashed, and probed."""
         import jax
         import jax.numpy as jnp
 
@@ -650,33 +659,39 @@ class TpuChecker(HostChecker):
         from ..ops.hashtable import table_insert
 
         model = self._model
+        width = model.packed_width
         cols = getattr(model, "host_property_cols", None)
-        off, hw = cols if cols is not None else (0, model.packed_width)
+        off, hw = cols if cols is not None else (0, width)
         mkey = model_cache_key(model)
-        ckey = (mkey, qcap, capacity, hmax)
+        ckey = (mkey, rmax, capacity, hmax)
         if mkey is not None:
             cached = self._POSTHOC_CACHE.get(ckey)
             if cached is not None:
                 return cached
 
-        def fn(q_rows, q_tail, log_chi, log_clo, n_init):
-            key_cols = q_rows[:, off:off + hw]
-            hhi, hlo = fp64_device(key_cols)
-            valid = jnp.arange(qcap, dtype=jnp.int32) < q_tail
-            khi = jnp.zeros((capacity,), jnp.uint32)
-            klo = jnp.zeros((capacity,), jnp.uint32)
+        def fn(q_rows, s0, q_off, q_len, log_chi, log_clo, n_init,
+               khi, klo):
+            # region [s0, s0 + rmax) with the live rows at
+            # [s0 + q_off, s0 + q_off + q_len); the caller guarantees
+            # s0 + rmax <= qcap so dynamic_slice never clamp-shifts
+            region = jax.lax.dynamic_slice(q_rows, (s0, 0),
+                                           (rmax, width))
+            hhi, hlo = fp64_device(region[:, off:off + hw])
+            idx = jnp.arange(rmax, dtype=jnp.int32)
+            valid = (idx >= q_off) & (idx < q_off + q_len)
             inserted, khi, klo, ovf = table_insert(khi, klo, hhi, hlo,
                                                    valid)
             hcount = inserted.sum(dtype=jnp.int32)
-            src = shrink_indices(inserted, hmax)
-            out_rows = q_rows[src]
+            src = shrink_indices(inserted, hmax)   # region-relative
+            out_rows = region[src]
+            src_abs = src + s0
             # witness fp: queue row i >= n_init corresponds to log entry
             # i - n_init (queue and log append in lockstep); init rows are
             # resolved host-side from the seed order
-            li = jnp.maximum(src - n_init, 0)
+            li = jnp.maximum(src_abs - n_init, 0)
             w_hi = log_chi[li]
             w_lo = log_clo[li]
-            return out_rows, src, w_hi, w_lo, hcount, ovf
+            return out_rows, src_abs, w_hi, w_lo, hcount, ovf, khi, klo
 
         fn = jax.jit(fn, static_argnums=())
         if mkey is not None:
@@ -687,28 +702,51 @@ class TpuChecker(HostChecker):
 
     def _posthoc_eval(self, carry, qcap: int, n_init: int,
                       init_fps: List[int],
-                      discoveries: Dict[str, int]) -> None:
+                      discoveries: Dict[str, int], q_tail: int) -> None:
         """Evaluate host properties once per distinct host-property key
-        over the entire reached set (device dedup, host predicates)."""
+        over the reached set (device dedup, host predicates). Incremental:
+        only queue rows appended since the last pass are reduced, against
+        the persistent key table — the common case for every chunk after
+        the first is near-zero device work."""
         import jax
         import jax.numpy as jnp
 
-        model = self._model
-        hmax = int(self._tpu_options.get("hmax", 1 << 14))
+        from ..ops.hashtable import make_table
+
+        if self._posthoc_start >= q_tail:
+            return
         while True:
-            fn = self._posthoc_fn(qcap, self._capacity, hmax)
-            (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf_d) = fn(
-                carry.q_rows, carry.q_tail, carry.log_chi, carry.log_clo,
-                jnp.int32(n_init))
+            hmax = self._posthoc_hmax
+            if self._posthoc_table is None:
+                self._posthoc_table = make_table(self._posthoc_cap)
+                self._posthoc_start = 0
+            khi, klo = self._posthoc_table
+            start = self._posthoc_start
+            rmax = min(_bucket(q_tail - start), qcap)
+            s0 = min(start, qcap - rmax)
+            fn = self._posthoc_fn(rmax, self._posthoc_cap, hmax)
+            (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf_d,
+             khi, klo) = fn(
+                carry.q_rows, jnp.int32(s0), jnp.int32(start - s0),
+                jnp.int32(q_tail - start), carry.log_chi, carry.log_clo,
+                jnp.int32(n_init), khi, klo)
             hcount, tovf = jax.device_get((hcount_d, tovf_d))
             if bool(tovf):
-                raise RuntimeError(
-                    "device hash table probe overflow during post-hoc "
-                    "host-property reduction; raise tpu_options("
-                    "capacity=...)")
-            if int(hcount) <= hmax:
-                break
-            hmax *= 2
+                # key table saturated: quadruple it and rescan from the
+                # start (reinsertion is idempotent; host eval is memoized)
+                self._posthoc_cap *= 4
+                self._posthoc_table = None
+                continue
+            if int(hcount) > hmax:
+                # more fresh keys than representative lanes: some keys are
+                # now in the table but their rows were dropped — grow hmax
+                # and rescan with a fresh table
+                self._posthoc_hmax = hmax * 2
+                self._posthoc_table = None
+                continue
+            self._posthoc_table = (khi, klo)
+            self._posthoc_start = q_tail
+            break
         hcount = int(hcount)
         if not hcount:
             return

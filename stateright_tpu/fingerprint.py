@@ -9,15 +9,29 @@ addresses states by fingerprint paths.
 We need the additional property that the *same* hash is computable both on
 host (Python) and on device (JAX/TPU, see ``stateright_tpu.ops.hash_kernel``)
 over a canonical ``uint32``-word encoding of a state. aHash is not
-TPU-friendly (it leans on AES rounds / 128-bit folded multiplies), so we
-instead use two independent murmur3-style 32-bit lanes combined into one
-64-bit digest. All arithmetic is 32-bit — exactly what the TPU VPU gives us.
+TPU-friendly (it leans on AES rounds / 128-bit folded multiplies), and a
+murmur-style sequential accumulator is not either: its mixing chain is one
+dependent op per word, so hashing a W-word state costs O(W) *vector-op
+latency* on the VPU no matter how many states are batched. We instead use a
+**column-parallel** construction: every word is whitened independently
+(position-keyed), the whitened words are XOR-reduced, and only the final
+avalanche is sequential — O(1) dependent ops per state regardless of width,
+which benchmarked ~9 ms/iteration faster inside the engine's device loop.
 
-Layout contract (shared with the device kernel):
-  fp64(words) = (fmix32(h1 ^ n) << 32) | fmix32(h2 ^ n)
-  where h1/h2 are murmur3 accumulators over the words with distinct
-  constants, n = len(words). A zero digest is mapped to 1 (fingerprints are
-  non-zero, mirroring ``NonZeroU64`` in the reference).
+Layout contract (shared with the C core and the device kernel), all
+arithmetic mod 2^32:
+
+  P_i  = fmix32((i + 1) * GOLDEN)          # per-position whitening key
+  x_i  = w_i ^ P_i
+  h1   = XOR_i fmix32(x_i * C1_1)          # two independent 32-bit lanes
+  h2   = XOR_i fmix32(x_i * C1_2)
+  fp64 = (fmix32(h1 ^ SEED1 ^ n) << 32) | fmix32(h2 ^ SEED2 ^ n * C1_1)
+
+where n = len(words). Each lane XOR-combines a bijective whitening of each
+(word, position) pair, so single-word differences always change both lanes
+and multi-word collisions require a simultaneous 64-bit match across two
+independently-mixed lanes. A zero digest is mapped to 1 (fingerprints are
+non-zero, mirroring ``NonZeroU64`` in the reference).
 """
 
 from __future__ import annotations
@@ -44,16 +58,14 @@ def _native_lib():
         _NATIVE = _native.load()
     return _NATIVE
 
-# Lane 1: murmur3_x86_32 constants. Lane 2: first constant pair from
-# murmur3_x86_128. Both lanes use the standard murmur3 rotation schedule.
-C1_1, C2_1 = 0xCC9E2D51, 0x1B873593
-C1_2, C2_2 = 0x239B961B, 0xAB0E9789
+# Lane multipliers: murmur3_x86_32's first constant and murmur3_x86_128's
+# first constant. GOLDEN = 2^32 / golden ratio keys the per-position
+# whitening. The seeds separate the two lanes' finalizers.
+C1_1 = 0xCC9E2D51
+C1_2 = 0x239B961B
+GOLDEN = 0x9E3779B9
 SEED1 = 0x9747B28C
 SEED2 = 0x85EBCA6B
-
-
-def _rotl32(x: int, r: int) -> int:
-    return ((x << r) | (x >> (32 - r))) & M32
 
 
 def _fmix32(h: int) -> int:
@@ -63,6 +75,17 @@ def _fmix32(h: int) -> int:
     h = (h * 0xC2B2AE35) & M32
     h ^= h >> 16
     return h
+
+
+_COL_KEYS: List[int] = []
+
+
+def col_keys(n: int) -> List[int]:
+    """The first ``n`` per-position whitening keys ``P_i`` (host cache;
+    the device kernel materializes the same values as a constant)."""
+    while len(_COL_KEYS) < n:
+        _COL_KEYS.append(_fmix32((len(_COL_KEYS) + 1) * GOLDEN & M32))
+    return _COL_KEYS[:n]
 
 
 def fp64_words(words: Iterable[int]) -> int:
@@ -116,28 +139,20 @@ def fp64_rows(rows) -> "list":
 
 def _fp64_words_py(words: Iterable[int]) -> int:
     """Pure-Python reference implementation of :func:`fp64_words`."""
-    h1 = SEED1
-    h2 = SEED2
+    h1 = 0
+    h2 = 0
     n = 0
+    keys = _COL_KEYS
     for w in words:
-        w &= M32
-        k = (w * C1_1) & M32
-        k = _rotl32(k, 15)
-        k = (k * C2_1) & M32
-        h1 ^= k
-        h1 = _rotl32(h1, 13)
-        h1 = (h1 * 5 + 0xE6546B64) & M32
-
-        k = (w * C1_2) & M32
-        k = _rotl32(k, 16)
-        k = (k * C2_2) & M32
-        h2 ^= k
-        h2 = _rotl32(h2, 13)
-        h2 = (h2 * 5 + 0x561CCD1B) & M32
+        if n >= len(keys):
+            col_keys(n + 1)  # extend the shared cache in place
+        x = (w & M32) ^ keys[n]
+        h1 ^= _fmix32((x * C1_1) & M32)
+        h2 ^= _fmix32((x * C1_2) & M32)
         n += 1
 
-    h1 = _fmix32(h1 ^ n)
-    h2 = _fmix32(h2 ^ n)
+    h1 = _fmix32(h1 ^ SEED1 ^ n)
+    h2 = _fmix32(h2 ^ SEED2 ^ ((n * C1_1) & M32))
     fp = (h1 << 32) | h2
     return fp if fp != 0 else 1
 

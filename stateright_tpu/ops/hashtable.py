@@ -6,19 +6,28 @@ TPU-native replacement for the reference's concurrent visited set
 as uint32 (hi, lo) pairs; the empty slot marker is ``(0, 0)``, which the hash
 kernel guarantees is never a real fingerprint.
 
-Insertion is a lock-free-style parallel linear probe built from
-scatter/gather rounds inside one ``lax.while_loop``:
+Insertion is a lock-free-style parallel probe over **4-slot buckets**
+built from scatter/gather rounds inside one ``lax.while_loop``. Probing a
+whole aligned bucket per round matters on TPU: the bucket read is a
+contiguous 4-word row gather (cheap) and one round resolves almost every
+item at engine load factors (< 55%), where slot-at-a-time probing paid one
+serialized gather round per collision. Per round:
 
-  1. gather each item's current slot; a key match resolves the item as
-     "already present";
-  2. items at empty slots race to claim them by scattering a unique token
-     and gathering it back (XLA scatter picks one winner per slot — the
-     moral equivalent of a CAS);
+  1. gather each item's current 4-slot bucket; a key match anywhere in the
+     bucket resolves the item as "already present";
+  2. items whose bucket has an empty slot race to claim its first empty by
+     scattering a unique token and gathering it back (XLA scatter picks
+     one winner per slot — the moral equivalent of a CAS);
   3. claim winners scatter their key (race-free: one winner per slot) and
-     resolve as "inserted"; claim losers retry the same slot next round
+     resolve as "inserted"; claim losers retry the same bucket next round
      (they will observe the winner's key: a match if it was a same-
-     fingerprint duplicate inside the batch, a collision otherwise);
-  4. items that observed a foreign occupant advance to the next slot.
+     fingerprint duplicate inside the batch, or try the bucket's next
+     empty slot otherwise);
+  4. items whose bucket is full of foreign keys advance to the next
+     bucket. Buckets only ever fill (no deletion), so an item's bucket
+     scan deterministically revisits every bucket between its start
+     bucket and wherever its fingerprint was first inserted — lookups can
+     neither stop early nor miss.
 
 Which duplicate wins a slot within a batch is unspecified — the same benign
 race the reference tolerates on ``DashMap`` inserts ("Races other threads,
@@ -39,17 +48,22 @@ _PHI = 0x9E3779B9  # 2^32 / golden ratio; scrambles hi into the probe start.
 
 
 def make_table(capacity: int):
-    """Allocate an empty table. ``capacity`` must be a power of two."""
+    """Allocate an empty table. ``capacity`` must be a power of two
+    >= the bucket width (the probe reads whole 4-slot buckets)."""
     assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    assert capacity >= _BUCKET, f"capacity must be >= {_BUCKET}"
     return (jnp.zeros((capacity,), dtype=jnp.uint32),
             jnp.zeros((capacity,), dtype=jnp.uint32))
+
+
+_BUCKET = 4  # slots probed per round (one contiguous row gather)
 
 
 def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
     """Insert a batch of fingerprints.
 
     Args:
-      key_hi, key_lo: uint32[C] table halves (C a power of two).
+      key_hi, key_lo: uint32[C] table halves (C a power of two, >= 4).
       fhi, flo: uint32[N] fingerprints to insert.
       valid: bool[N]; invalid rows are ignored.
       max_rounds: probe-round bound; hitting it reports overflow.
@@ -60,29 +74,42 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
       across the table's lifetime *and* within this batch).
     """
     capacity = key_hi.shape[0]
+    assert capacity >= _BUCKET, \
+        f"table capacity must be >= {_BUCKET} (got {capacity})"
+    n_buckets = capacity // _BUCKET
     n = fhi.shape[0]
-    mask = jnp.uint32(capacity - 1)
+    gmask = n_buckets - 1
     token = jnp.arange(1, n + 1, dtype=jnp.uint32)
-    slot = (flo ^ (fhi * jnp.uint32(_PHI))) & mask
+    offs = jnp.arange(_BUCKET, dtype=jnp.uint32)
+    group = ((flo ^ (fhi * jnp.uint32(_PHI)))
+             & jnp.uint32(gmask)).astype(jnp.int32)
 
     def cond(carry):
-        unresolved, _inserted, _slot, _khi, _klo, rounds = carry
+        unresolved, _inserted, _group, _khi, _klo, rounds = carry
         return unresolved.any() & (rounds < max_rounds)
 
     def body(carry):
-        unresolved, inserted, slot, khi, klo, rounds = carry
-        cur_hi = khi[slot]
-        cur_lo = klo[slot]
-        is_empty = (cur_hi == 0) & (cur_lo == 0)
-        is_match = (cur_hi == fhi) & (cur_lo == flo)
-        unresolved = unresolved & ~is_match
+        unresolved, inserted, group, khi, klo, rounds = carry
+        bucket_hi = khi.reshape(n_buckets, _BUCKET)[group]  # (n, 4)
+        bucket_lo = klo.reshape(n_buckets, _BUCKET)[group]
+        is_empty = (bucket_hi == 0) & (bucket_lo == 0)
+        is_match = (bucket_hi == fhi[:, None]) & (bucket_lo == flo[:, None])
+        unresolved = unresolved & ~is_match.any(axis=1)
 
-        attempt = unresolved & is_empty
+        has_empty = is_empty.any(axis=1)
+        # first empty slot in the bucket, as an absolute table index
+        first_empty = jnp.where(is_empty, offs[None, :],
+                                jnp.uint32(_BUCKET)).min(axis=1)
+        slot = group.astype(jnp.uint32) * jnp.uint32(_BUCKET) + first_empty
+        attempt = unresolved & has_empty
         oob = jnp.uint32(capacity)
         claim_idx = jnp.where(attempt, slot, oob)
         claim = jnp.zeros((capacity,), dtype=jnp.uint32)
         claim = claim.at[claim_idx].set(token, mode="drop")
-        won = attempt & (claim[slot] == token)
+        # gather-back at a clamped index: non-attempting lanes read slot 0
+        # harmlessly (their `attempt` bit is already false)
+        safe = jnp.minimum(slot, oob - 1).astype(jnp.int32)
+        won = attempt & (claim[safe] == token)
 
         write_idx = jnp.where(won, slot, oob)
         khi = khi.at[write_idx].set(fhi, mode="drop")
@@ -90,16 +117,17 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
         inserted = inserted | won
         unresolved = unresolved & ~won
 
-        # Foreign occupant: linear-probe forward. Claim losers retry in
-        # place — next round they see the winner's key.
-        advance = unresolved & ~is_empty & ~is_match
-        slot = jnp.where(advance, (slot + jnp.uint32(1)) & mask, slot)
-        return unresolved, inserted, slot, khi, klo, rounds + 1
+        # A full-of-foreign bucket sends the item to the next bucket;
+        # claim losers retry the same bucket (next round they see the
+        # winner's key, or take the bucket's next empty slot).
+        advance = unresolved & ~has_empty
+        group = jnp.where(advance, (group + 1) & gmask, group)
+        return unresolved, inserted, group, khi, klo, rounds + 1
 
     unresolved = valid
     inserted = jnp.zeros((n,), dtype=bool)
-    carry = (unresolved, inserted, slot, key_hi, key_lo,
+    carry = (unresolved, inserted, group, key_hi, key_lo,
              jnp.int32(0))
-    unresolved, inserted, _slot, key_hi, key_lo, _rounds = lax.while_loop(
+    unresolved, inserted, _group, key_hi, key_lo, _rounds = lax.while_loop(
         cond, body, carry)
     return inserted, key_hi, key_lo, unresolved.any()

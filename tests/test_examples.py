@@ -97,8 +97,9 @@ class TestSingleCopyRegister:
     def test_two_servers_break_linearizability(self):
         """`single-copy-register.rs:102-122`: with 2 servers the checker
         catches the linearizability violation (reference stops at 20
-        states; our deterministic order stops at 22 — early-exit counts
-        are order-dependent, the witnesses below are not)."""
+        states; our deterministic order stops at 26 — early-exit counts
+        are order-dependent since envelopes are explored in stable-
+        fingerprint order; the witnesses below are not)."""
         from stateright_tpu.examples.single_copy_register import \
             SingleCopyModelCfg
         checker = (SingleCopyModelCfg(
@@ -117,7 +118,7 @@ class TestSingleCopyRegister:
             Deliver(src=Id(2), dst=Id(0), msg=Put(2, 'A')),
             Deliver(src=Id(3), dst=Id(0), msg=Get(6)),
         ])
-        assert checker.unique_state_count() == 22
+        assert checker.unique_state_count() == 26
 
 
 class TestLinearizableRegister:
