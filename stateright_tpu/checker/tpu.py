@@ -560,9 +560,15 @@ class TpuChecker(HostChecker):
     def _device_qcap(self, n_init: int, headroom: int) -> int:
         """Queue rows needed between growths: every enqueued state is
         unique, so the tail never exceeds n_init + grow_limit + one
-        iteration's appends."""
+        iteration's appends. A ``target_state_count`` additionally bounds
+        total appends (generated >= inserted), which keeps the queue — by
+        far the biggest device buffer, and its memset is real seed-time on
+        a tunneled device — proportional to the requested work."""
         grow_limit = int(min(self._grow_at * self._capacity,
                              self._capacity - headroom))
+        if self._target_state_count is not None:
+            grow_limit = min(grow_limit,
+                             self._target_state_count + headroom)
         return n_init + grow_limit + 2 * headroom
 
     # ------------------------------------------------------------------
@@ -1042,10 +1048,15 @@ class TpuChecker(HostChecker):
 
     def _model_tag(self) -> str:
         """Identity check for resume: a checkpoint only makes sense for
-        the same model config (same packed layout, same transitions)."""
+        the same model config (same packed layout, same transitions) AND
+        the same fingerprint algorithm — resuming old-scheme fingerprints
+        would silently fail to dedup against newly computed ones."""
+        from ..fingerprint import FP_VERSION
+
         model = self._model
         return (f"{type(model).__module__}.{type(model).__qualname__}"
-                f"|{model.cache_key()!r}|w={model.packed_width}")
+                f"|{model.cache_key()!r}|w={model.packed_width}"
+                f"|fpv={FP_VERSION}")
 
     def _load_checkpoint(self, discoveries: Dict[str, int]):
         """Seed state from a ``save()`` file: the mirror, the saved

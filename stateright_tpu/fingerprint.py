@@ -40,6 +40,7 @@ import ctypes as _ctypes
 import dataclasses
 import enum
 import struct
+import threading as _threading
 from array import array as _array
 from typing import Any, Iterable, List, Optional
 
@@ -77,14 +78,24 @@ def _fmix32(h: int) -> int:
     return h
 
 
+# Version of the fingerprint layout. Bump whenever the algorithm changes:
+# checkpoints embed it so a resume against differently-hashed history is
+# rejected instead of silently corrupting the search.
+FP_VERSION = 2
+
 _COL_KEYS: List[int] = []
+_COL_KEYS_LOCK = _threading.Lock()
 
 
 def col_keys(n: int) -> List[int]:
     """The first ``n`` per-position whitening keys ``P_i`` (host cache;
     the device kernel materializes the same values as a constant)."""
-    while len(_COL_KEYS) < n:
-        _COL_KEYS.append(_fmix32((len(_COL_KEYS) + 1) * GOLDEN & M32))
+    if len(_COL_KEYS) < n:
+        with _COL_KEYS_LOCK:
+            # re-check under the lock; compute each key from its target
+            # index so concurrent extenders can never shift positions
+            for i in range(len(_COL_KEYS), n):
+                _COL_KEYS.append(_fmix32((i + 1) * GOLDEN & M32))
     return _COL_KEYS[:n]
 
 

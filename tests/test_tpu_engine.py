@@ -355,3 +355,53 @@ def test_packed_contract_2pc_n5_full():
     state's encode/decode round-trip, device fingerprint, and packed
     successors against the host model."""
     assert validate_packed_model(TwoPhaseSys(5), max_states=10_000) == 8832
+
+
+def test_table_insert_minimum_capacity():
+    # the bucketed probe reads whole 4-slot buckets; capacity 4 is the
+    # smallest legal table and must still behave (single bucket, wraps)
+    key_hi, key_lo = make_table(4)
+    hi = np.array([1, 2, 3, 4], dtype=np.uint32)
+    lo = np.array([1, 1, 1, 1], dtype=np.uint32)
+    valid = np.ones(4, dtype=bool)
+    inserted, key_hi, key_lo, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert not bool(overflow)
+    assert np.asarray(inserted).sum() == 4  # exactly full, no overflow
+    # one more distinct key cannot land: overflow must be reported
+    _, _, _, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(np.array([9], np.uint32)),
+        jnp.asarray(np.array([9], np.uint32)),
+        jnp.asarray(np.ones(1, bool)), max_rounds=16)
+    assert bool(overflow)
+    # but a duplicate of a stored key still resolves as already-present
+    inserted, _, _, overflow = table_insert(
+        key_hi, key_lo, jnp.asarray(np.array([3], np.uint32)),
+        jnp.asarray(np.array([1], np.uint32)),
+        jnp.asarray(np.ones(1, bool)), max_rounds=16)
+    assert not bool(overflow)
+    assert np.asarray(inserted).sum() == 0
+
+
+def test_make_table_rejects_tiny_capacity():
+    with pytest.raises(AssertionError):
+        make_table(2)
+
+
+def test_posthoc_incremental_growth_paths():
+    # tiny hmax/hcap force every growth path of the incremental post-hoc
+    # reduction (hmax doubling + rescan, key-table quadrupling) while the
+    # verdicts must stay identical to the defaults
+    from stateright_tpu.examples.single_copy_packed import PackedSingleCopy
+
+    ck = (PackedSingleCopy(2, server_count=2).checker()
+          .tpu_options(capacity=1 << 12, hmax=1, hcap=4)
+          .spawn_tpu().join())
+    path = ck.assert_any_discovery("linearizable")
+    assert path.last_state().history.serialized_history() is None
+
+    ck = (PackedSingleCopy(2, server_count=1).checker()
+          .tpu_options(capacity=1 << 10, hmax=1, hcap=4)
+          .spawn_tpu().join())
+    assert ck.unique_state_count() == 93
+    ck.assert_properties()
