@@ -307,10 +307,14 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     ``full_ebits`` is a scalar for fresh runs or a per-row array when
     resuming from a checkpointed frontier.
 
-    The whole construction is ONE jitted dispatch: the big buffers are
-    allocated on device (only the init rows cross the host link), and
-    issuing a dozen separate zeros/update dispatches costs a dozen host
-    round trips on a tunneled device (~0.2 s measured)."""
+    The whole construction is ONE jitted dispatch (a dozen separate
+    zeros/update dispatches each paid a tunneled-host round trip).
+    NOTE: the engine deliberately ``block_until_ready``s the seeded carry
+    before the first chunk launch — launching the chunk (which donates
+    the carry) with the seed still in flight was measured ~2.5x slower
+    for the whole chunk loop. Folding the fingerprint table seeding INTO
+    this program was also tried and regressed the same way, so it stays
+    a separate ``table_insert`` dispatch."""
     import numpy as np
 
     width = model.packed_width

@@ -457,9 +457,13 @@ class TpuChecker(HostChecker):
         # growth point (n_init + grow_limit) plus one iteration of appends
         qcap = self._device_qcap(n_init, headroom)
         with self._timed("seed"):
+            # the block before the first chunk launch is deliberate:
+            # launching the chunk (which donates the carry) while the
+            # seed/insert programs are still in flight was measured to
+            # slow the whole chunk loop ~2.5x on the tunneled device
             carry = seed_carry(model, qcap, self._capacity, init_rows,
                                seed_ebits, symmetry=self._symmetry)
-            key_hi, key_lo = self._bulk_insert(
+            key_hi, key_lo, seed_ovf = self._bulk_insert_async(
                 insert_fn, carry.key_hi, carry.key_lo,
                 list(generated.keys()))
             carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
@@ -494,6 +498,12 @@ class TpuChecker(HostChecker):
                     continue  # host-evaluated: device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
+            if seed_ovf is not None:
+                if bool(jax.device_get(seed_ovf)):
+                    raise RuntimeError(
+                        "device hash table overflow while seeding; raise "
+                        "tpu_options(capacity=...)")
+                seed_ovf = None
             if bool(xovf):
                 raise RuntimeError(_XOVF_MESSAGE)
             if bool(ovf):
@@ -977,9 +987,13 @@ class TpuChecker(HostChecker):
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
 
-    def _bulk_insert(self, insert_fn, key_hi, key_lo, fps: List[int]):
-        """(Re)insert known fingerprints, e.g. at init or after growth."""
+    def _bulk_insert_async(self, insert_fn, key_hi, key_lo,
+                           fps: List[int]):
+        """(Re)insert known fingerprints without syncing; returns
+        ``(key_hi, key_lo, overflow)`` with ``overflow`` a device bool
+        scalar the caller must eventually check."""
         import jax.numpy as jnp
+        overflow = None  # stays None when fps is empty
         chunk_size = 1 << 16
         for start in range(0, len(fps), chunk_size):
             chunk = fps[start:start + chunk_size]
@@ -987,14 +1001,21 @@ class TpuChecker(HostChecker):
             arr = np.zeros((n,), dtype=np.uint64)
             arr[:len(chunk)] = np.asarray(chunk, dtype=np.uint64)
             valid = np.arange(n) < len(chunk)
-            _, key_hi, key_lo, overflow = insert_fn(
+            _, key_hi, key_lo, ovf = insert_fn(
                 key_hi, key_lo,
                 jnp.asarray((arr >> np.uint64(32)).astype(np.uint32)),
                 jnp.asarray(arr.astype(np.uint32)),
                 jnp.asarray(valid))
-            if bool(overflow):
-                raise RuntimeError(
-                    "device hash table overflow during bulk insert")
+            overflow = ovf if overflow is None else (overflow | ovf)
+        return key_hi, key_lo, overflow
+
+    def _bulk_insert(self, insert_fn, key_hi, key_lo, fps: List[int]):
+        """(Re)insert known fingerprints, e.g. after growth (synced)."""
+        key_hi, key_lo, overflow = self._bulk_insert_async(
+            insert_fn, key_hi, key_lo, fps)
+        if overflow is not None and bool(overflow):
+            raise RuntimeError(
+                "device hash table overflow during bulk insert")
         return key_hi, key_lo
 
     def _canon_fp(self, state) -> int:

@@ -57,6 +57,13 @@ def make_table(capacity: int):
 
 
 _BUCKET = 4  # slots probed per round (one contiguous row gather)
+_CLAIM_CELLS = 1 << 16  # claim-arena floor: full capacity would memset
+#                         MBs per probe round; hashed cells only cost a
+#                         false claim-loss (the loser retries next round)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max((n - 1).bit_length(), 0)
 
 
 def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
@@ -103,13 +110,22 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
         slot = group.astype(jnp.uint32) * jnp.uint32(_BUCKET) + first_empty
         attempt = unresolved & has_empty
         oob = jnp.uint32(capacity)
-        claim_idx = jnp.where(attempt, slot, oob)
-        claim = jnp.zeros((capacity,), dtype=jnp.uint32)
+        # claim race in a small hashed arena: XLA's scatter picks one
+        # winner per cell (the CAS analog). Two lanes CLAIMING different
+        # slots can hash to the same cell — the loser just retries next
+        # round, exactly like losing a genuine same-slot race; winning a
+        # cell always writes the lane's own slot, so no false *win*
+        # exists. Sized to the batch (>= 4x the lanes) so false
+        # collisions stay rare, but never the full capacity, whose
+        # per-round memset dominated small inserts
+        claim_cells = min(capacity,
+                          max(_CLAIM_CELLS, _next_pow2(4 * n)))
+        cmask = jnp.uint32(claim_cells - 1)
+        claim_idx = jnp.where(attempt, slot & cmask,
+                              jnp.uint32(claim_cells))
+        claim = jnp.zeros((claim_cells,), dtype=jnp.uint32)
         claim = claim.at[claim_idx].set(token, mode="drop")
-        # gather-back at a clamped index: non-attempting lanes read slot 0
-        # harmlessly (their `attempt` bit is already false)
-        safe = jnp.minimum(slot, oob - 1).astype(jnp.int32)
-        won = attempt & (claim[safe] == token)
+        won = attempt & (claim[(slot & cmask).astype(jnp.int32)] == token)
 
         write_idx = jnp.where(won, slot, oob)
         khi = khi.at[write_idx].set(fhi, mode="drop")
