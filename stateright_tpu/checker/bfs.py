@@ -7,8 +7,10 @@ reconstruction by replay (`bfs.rs:314-342`); property evaluation on pop with
 early exit once every property has a discovery; ``eventually`` bits flushed
 as counterexamples at terminal states. The two documented soundness caveats
 for ``eventually`` (ebits not part of the fingerprint, and cycle-vs-DAG-join
-ambiguity — `bfs.rs:239-244`, `:249-256`) are replicated, not fixed, so
-behavior matches the reference's pinned tests.
+ambiguity — `bfs.rs:239-244`, `:249-256`) are replicated by default, so
+behavior matches the reference's pinned tests;
+``CheckerBuilder.sound_eventually()`` opts into node-keyed dedup that fixes
+the first caveat (DAG rejoins).
 
 Symmetry reduction is intentionally *not* applied here: as in the reference,
 only the DFS engine honors it.
@@ -27,16 +29,22 @@ from .host import HostChecker
 class BfsChecker(HostChecker):
     def __init__(self, builder: CheckerBuilder):
         super().__init__(builder)
-        # fingerprint -> parent fingerprint (None for init states).
+        # Dedup-key -> parent dedup-key (None for init states). Keys are
+        # state fingerprints; under sound_eventually() they are NODE keys
+        # (state fingerprint + pending eventually-bits, ``fp64_node``),
+        # with ``_node_fp`` translating back for replay.
         self._generated: Dict[int, Optional[int]] = {}
         model = self._model
         init_states = [s for s in model.init_states()
                        if model.within_boundary(s)]
         self._state_count = len(init_states)
-        for s in init_states:
-            self._generated.setdefault(model.fingerprint(s), None)
-        self._unique_state_count = len(self._generated)
         ebits = self._init_ebits()
+        self._init_sound(builder, ebits)
+        mask = self._ebits_mask(ebits)
+        for s in init_states:
+            self._generated.setdefault(
+                self._node_key(model.fingerprint(s), mask), None)
+        self._unique_state_count = len(self._generated)
         self._pending = deque(
             (s, model.fingerprint(s), ebits) for s in init_states)
 
@@ -51,8 +59,11 @@ class BfsChecker(HostChecker):
 
         while pending:
             state, state_fp, ebits = pending.popleft()
+            # this node's dedup key uses the AT-ENQUEUE bits (dedup
+            # happened at enqueue time, before this pop's clearing)
+            state_key = self._node_key(state_fp, self._ebits_mask(ebits))
             if visitor is not None:
-                visitor.visit(model, self._reconstruct_path(state_fp))
+                visitor.visit(model, self._reconstruct_path(state_key))
 
             # Property evaluation (bfs.rs:192-226).
             is_awaiting_discoveries = False
@@ -61,12 +72,12 @@ class BfsChecker(HostChecker):
                     continue
                 if prop.expectation == Expectation.ALWAYS:
                     if not prop.condition(model, state):
-                        discoveries[prop.name] = state_fp
+                        discoveries[prop.name] = state_key
                     else:
                         is_awaiting_discoveries = True
                 elif prop.expectation == Expectation.SOMETIMES:
                     if prop.condition(model, state):
-                        discoveries[prop.name] = state_fp
+                        discoveries[prop.name] = state_key
                     else:
                         is_awaiting_discoveries = True
                 else:  # EVENTUALLY: discoveries only surface at terminals.
@@ -77,6 +88,7 @@ class BfsChecker(HostChecker):
                 return
 
             # Expansion (bfs.rs:229-264).
+            child_mask = self._ebits_mask(ebits)
             actions: List = []
             is_terminal = True
             model.actions(state, actions)
@@ -88,17 +100,18 @@ class BfsChecker(HostChecker):
                     continue
                 self._state_count += 1
                 next_fp = model.fingerprint(next_state)
-                if next_fp in generated:
+                next_key = self._node_key(next_fp, child_mask)
+                if next_key in generated:
                     is_terminal = False
                     continue
-                generated[next_fp] = state_fp
+                generated[next_key] = state_key
                 self._unique_state_count = len(generated)
                 is_terminal = False
                 pending.append((next_state, next_fp, ebits))
             if is_terminal:
                 for i, prop in enumerate(properties):
                     if i in ebits:
-                        discoveries[prop.name] = state_fp
+                        discoveries[prop.name] = state_key
             if target is not None and self._state_count >= target:
                 return
 

@@ -26,6 +26,7 @@ class CheckerBuilder:
         self.visitor_ = None
         self.tpu_options_: dict = {}
         self.resume_path_ = None
+        self.sound_eventually_: bool = False
 
     def symmetry(self) -> "CheckerBuilder":
         """Enable symmetry reduction via ``state.representative()``
@@ -52,6 +53,28 @@ class CheckerBuilder:
 
     def visitor(self, visitor) -> "CheckerBuilder":
         self.visitor_ = as_visitor(visitor)
+        return self
+
+    def sound_eventually(self) -> "CheckerBuilder":
+        """Include the pending ``eventually`` bits in the dedup identity.
+
+        The reference accepts missed ``eventually`` counterexamples when a
+        state is revisited with different pending bits (the documented
+        FIXME at `/root/reference/src/checker/bfs.rs:239-244`; pinned by
+        its ``fixme_can_miss_counterexample_when_revisiting_a_state``
+        test). This opt-in goes beyond the reference: dedup works on
+        (state, pending-bits) NODES, so DAG rejoins can no longer mask a
+        counterexample, at the cost of exploring a state once per distinct
+        pending-bits value (``unique_state_count`` counts nodes). The DFS
+        engine additionally reports a lasso counterexample when expansion
+        rejoins the CURRENT path with bits still pending (a cycle on
+        which the property never holds); a cycle entered via a cross edge
+        into an already-explored sibling branch is still missed — full
+        lasso coverage needs an SCC/nested-DFS liveness pass. Supported
+        by ``spawn_bfs`` (single worker), ``spawn_dfs``, and the
+        single-chip ``spawn_tpu`` device mode. A model with no
+        ``eventually`` properties is unaffected."""
+        self.sound_eventually_ = True
         return self
 
     def tpu_options(self, **options) -> "CheckerBuilder":

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 
 from ..ops.expand import (discovery_candidates, eventually_indices,
                           expand_frontier)
+from ..ops.hash_kernel import fp64_node_device
 from ..ops.hashtable import table_insert
 
 
@@ -120,7 +121,7 @@ def model_cache_key(model):
 
 
 def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
-                   symmetry: bool = False):
+                   symmetry: bool = False, sound: bool = False):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit) ->
@@ -129,16 +130,23 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     so the host can grow the table. ``kmax`` bounds valid children per
     iteration; exceeding it sets ``kovf`` and leaves the carry untouched.
 
+    With ``sound`` (``CheckerBuilder.sound_eventually()``), dedup and the
+    log work on (state, pending-ebits) NODE keys (``fp64_node_device``)
+    while the log's original-fp columns record the plain state
+    fingerprints for replay — fixing the reference's documented
+    DAG-rejoin miss (`bfs.rs:239-244`).
+
     Memoized on :func:`model_cache_key`: checker runs re-use the jitted
     (and already-compiled) chunk across instances of the same model config.
     """
     mkey = model_cache_key(model)
-    key = (mkey, qcap, capacity, fmax, kmax, symmetry)
+    key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound)
     if mkey is not None:
         cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
-    fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry)
+    fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
+                         sound)
     if mkey is not None:
         if len(_CHUNK_CACHE) >= _CACHE_LIMIT:
             _CHUNK_CACHE.clear()
@@ -147,7 +155,7 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 
 
 def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
-                    symmetry: bool):
+                    symmetry: bool, sound: bool = False):
     n_actions = model.max_actions
     properties = model.properties()
     prop_count = len(properties)
@@ -197,12 +205,21 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             vcount = exp.cvalid.sum(dtype=jnp.int32)
             kovf = vcount > kmax_b
 
+            if sound:
+                # node keys: dedup identity = (state fp, pending ebits).
+                # The parent's node used its AT-ENQUEUE bits (pre-clear
+                # `ebits`); witnesses and log parents use node keys so the
+                # host mirror chain stays walkable
+                p_whi, p_wlo = fp64_node_device(exp.phi, exp.plo, ebits)
+            else:
+                p_whi, p_wlo = exp.phi, exp.plo
+
             # sticky discovery registers (idempotent: safe even if the
             # kovf branch re-expands this frontier after a kmax rebuild)
             disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
             if prop_count:
                 new_hit, cand_hi, cand_lo = discovery_candidates(
-                    properties, exp, fvalid)
+                    properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
                 keep = disc_hit | ~new_hit
                 disc_hi = jnp.where(keep, disc_hi, cand_hi)
                 disc_lo = jnp.where(keep, disc_lo, cand_lo)
@@ -217,9 +234,11 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 k_chi = exp.chi[src]
                 k_clo = exp.clo[src]
                 row = src // n_actions  # parent frontier row per child
-                k_phi = exp.phi[row]
-                k_plo = exp.plo[row]
+                k_phi = p_whi[row]
+                k_plo = p_wlo[row]
                 k_ceb = exp.ebits[row]
+                if sound:
+                    k_chi, k_clo = fp64_node_device(k_chi, k_clo, k_ceb)
 
                 inserted, key_hi, key_lo, t_ovf = table_insert(
                     c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
@@ -246,7 +265,9 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 log_plo = jax.lax.dynamic_update_slice(
                     c.log_plo, n_plo, (c.log_n,))
                 log_ohi, log_olo = c.log_ohi, c.log_olo
-                if symmetry:
+                if symmetry or sound:
+                    # the replayable STATE fingerprint per logged node
+                    # (exp.ohi aliases the state fp without symmetry)
                     k_ohi = exp.ohi[src]
                     k_olo = exp.olo[src]
                     log_ohi = jax.lax.dynamic_update_slice(

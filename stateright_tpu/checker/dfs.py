@@ -24,21 +24,33 @@ from .path import Path
 class DfsChecker(HostChecker):
     def __init__(self, builder: CheckerBuilder):
         super().__init__(builder)
+        # Dedup keys: canonical state fingerprints; under
+        # sound_eventually(), (state, pending-ebits) node keys.
         self._generated: Set[int] = set()
         model = self._model
         symmetry = self._symmetry
         init_states = [s for s in model.init_states()
                        if model.within_boundary(s)]
         self._state_count = len(init_states)
+        ebits = self._init_ebits()
+        self._init_sound(builder, ebits)
+        mask = self._ebits_mask(ebits)
         for s in init_states:
             if symmetry is not None:
-                self._generated.add(model.fingerprint(symmetry(s)))
+                fp = model.fingerprint(symmetry(s))
             else:
-                self._generated.add(model.fingerprint(s))
+                fp = model.fingerprint(s)
+            self._generated.add(self._node_key(fp, mask))
         self._unique_state_count = len(self._generated)
-        ebits = self._init_ebits()
+        # stack entries: (state, fingerprint path, ebits, on-path
+        # canonical state fingerprints for lasso detection — sound mode
+        # only, else None)
         self._pending: List = [
-            (s, [model.fingerprint(s)], ebits) for s in init_states]
+            (s, [model.fingerprint(s)], ebits,
+             frozenset([model.fingerprint(
+                 symmetry(s) if symmetry is not None else s)])
+             if self._sound else None)
+            for s in init_states]
         # name -> full fingerprint path (dfs.rs:26).
         self._discovery_fps: Dict[str, List[int]] = {}
 
@@ -53,7 +65,7 @@ class DfsChecker(HostChecker):
         target = self._target_state_count
 
         while pending:
-            state, fingerprints, ebits = pending.pop()
+            state, fingerprints, ebits, on_path = pending.pop()
             if visitor is not None:
                 visitor.visit(model,
                               Path.from_fingerprints(model, fingerprints))
@@ -81,6 +93,7 @@ class DfsChecker(HostChecker):
                 return
 
             # Expansion (dfs.rs:239-301).
+            child_mask = self._ebits_mask(ebits)
             actions: List = []
             is_terminal = True
             model.actions(state, actions)
@@ -93,22 +106,35 @@ class DfsChecker(HostChecker):
                 self._state_count += 1
                 if symmetry is not None:
                     rep_fp = model.fingerprint(symmetry(next_state))
-                    if rep_fp in generated:
-                        is_terminal = False
-                        continue
-                    generated.add(rep_fp)
                     # Continue the path with the pre-canonicalized state's
                     # fingerprint (dfs.rs:266-269).
                     next_fp = model.fingerprint(next_state)
                 else:
-                    next_fp = model.fingerprint(next_state)
-                    if next_fp in generated:
-                        is_terminal = False
-                        continue
-                    generated.add(next_fp)
+                    rep_fp = next_fp = model.fingerprint(next_state)
+                if on_path is not None and ebits and rep_fp in on_path:
+                    # sound-mode lasso: expansion rejoined the CURRENT
+                    # path with eventually-bits still pending. The
+                    # ancestor's pending set was a superset (bits only
+                    # clear), so every still-pending bit is unsatisfied
+                    # around the whole loop — an infinite run on which
+                    # the property never holds. (Only rejoins of the
+                    # current path are seen: a cycle entered via a cross
+                    # edge into a sibling branch dedups at push time and
+                    # is not detected — see the pinned limitation test.)
+                    for i, prop in enumerate(properties):
+                        if i in ebits and prop.name not in discoveries:
+                            discoveries[prop.name] = \
+                                fingerprints + [next_fp]
+                next_key = self._node_key(rep_fp, child_mask)
+                if next_key in generated:
+                    is_terminal = False
+                    continue
+                generated.add(next_key)
                 self._unique_state_count = len(generated)
                 is_terminal = False
-                pending.append((next_state, fingerprints + [next_fp], ebits))
+                pending.append(
+                    (next_state, fingerprints + [next_fp], ebits,
+                     on_path | {rep_fp} if on_path is not None else None))
             if is_terminal:
                 for i, prop in enumerate(properties):
                     if i in ebits:

@@ -36,22 +36,29 @@ class HostChecker(Checker):
         self._start_lock = threading.Lock()
 
     def generated_fingerprints(self):
-        """All visited fingerprints (the dedup record)."""
-        return set(self._generated)
+        """All visited STATE fingerprints (the dedup record, translated
+        out of node-key space under ``sound_eventually``)."""
+        node_fp = getattr(self, "_node_fp", None)
+        if node_fp is None:
+            return set(self._generated)
+        return {node_fp.get(k, k) for k in self._generated}
 
     def _reconstruct_path(self, fp: int):
         """Walk parent pointers to an init state, then replay forward
-        (`bfs.rs:314-342`). Engines whose ``_generated`` maps fingerprint
-        -> parent fingerprint share this."""
+        (`bfs.rs:314-342`). Engines whose ``_generated`` maps dedup key
+        -> parent dedup key share this; under ``sound_eventually`` the
+        keys are (state, ebits) nodes and ``_node_fp`` translates each to
+        its state fingerprint for replay."""
         from collections import deque
 
         from .path import Path
 
+        node_fp = getattr(self, "_node_fp", None) or {}
         fingerprints: deque = deque()
         next_fp = fp
         while next_fp in self._generated:
             parent = self._generated[next_fp]
-            fingerprints.appendleft(next_fp)
+            fingerprints.appendleft(node_fp.get(next_fp, next_fp))
             if parent is None:
                 break
             next_fp = parent
@@ -88,6 +95,31 @@ class HostChecker(Checker):
         return frozenset(
             i for i, p in enumerate(self._properties)
             if p.expectation == Expectation.EVENTUALLY)
+
+    # --- sound_eventually() support (shared by BFS/DFS) -------------------
+    def _init_sound(self, builder, ebits) -> None:
+        """Node-keyed dedup setup: keys combine the state fingerprint
+        with the pending eventually-bits (``fp64_node``); ``_node_fp``
+        translates keys back to state fingerprints for replay."""
+        self._sound = bool(builder.sound_eventually_) and bool(ebits)
+        if self._sound:
+            self._node_fp: Dict[int, int] = {}
+
+    def _ebits_mask(self, ebits) -> int:
+        """Bitmask form of an ebits set (0 when sound mode is off) —
+        computed once per pop, not per child."""
+        if not self._sound:
+            return 0
+        return sum(1 << i for i in ebits)
+
+    def _node_key(self, fp: int, ebits_mask: int) -> int:
+        if not self._sound:
+            return fp
+        from ..fingerprint import fp64_node
+
+        key = fp64_node(fp, ebits_mask)
+        self._node_fp[key] = fp
+        return key
 
     # --- Checker interface ----------------------------------------------
     def model(self) -> Model:

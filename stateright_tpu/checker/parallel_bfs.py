@@ -99,6 +99,13 @@ class ParallelBfsChecker(HostChecker):
             raise ValueError(
                 "per-state visitors require the sequential engine; drop "
                 "threads(...) or the visitor")
+        if builder.sound_eventually_ and any(
+                p.expectation == Expectation.EVENTUALLY
+                for p in self._properties):
+            raise NotImplementedError(
+                "sound_eventually() is not supported by the multi-process "
+                "engine; use threads(1) spawn_bfs, spawn_dfs, or the "
+                "single-chip spawn_tpu")
         self._workers = max(2, builder.thread_count_)
         self._generated: Dict[int, Optional[int]] = {}
         # fork the worker pool at CONSTRUCTION, on the caller's thread:

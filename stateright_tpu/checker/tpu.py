@@ -279,6 +279,25 @@ class TpuChecker(HostChecker):
                     "supported on the TPU engine; evaluate them with the "
                     "host engines")
         self._host_prop_cache: Dict[bytes, List[bool]] = {}
+        # sound-eventually mode: dedup on (state, pending-ebits) NODE keys
+        # (`fingerprint.fp64_node`), fixing the reference's documented
+        # DAG-rejoin miss (`bfs.rs:239-244`)
+        self._sound = builder.sound_eventually_ and any(
+            p.expectation == Expectation.EVENTUALLY
+            for p in self._properties)
+        if self._sound:
+            if self._host_props:
+                raise NotImplementedError(
+                    "sound_eventually() with host-evaluated properties "
+                    "is not supported on the TPU engine")
+            if builder.resume_path_ is not None:
+                raise NotImplementedError(
+                    "checkpoint resume under sound_eventually() is not "
+                    "supported")
+            if builder.symmetry_fn_ is not None:
+                raise NotImplementedError(
+                    "sound_eventually() with symmetry reduction is not "
+                    "supported on the TPU engine; use spawn_dfs")
         # incremental post-hoc reduction state (device engine): the
         # history-key dedup table persists across chunks and only queue
         # rows appended since the last pass are reduced
@@ -353,6 +372,10 @@ class TpuChecker(HostChecker):
             raise NotImplementedError(
                 "resume_from() requires the device engine; drop the "
                 "visitor / tpu_options(mode='level')")
+        if self._sound and mode == "level":
+            raise NotImplementedError(
+                "sound_eventually() requires the device engine; drop the "
+                "visitor / tpu_options(mode='level')")
         if mode in ("auto", "device"):
             self._run_device()
         else:
@@ -383,14 +406,26 @@ class TpuChecker(HostChecker):
                         f"{dev.tolist()}. The device engines require the "
                         "two canonicalizations to be bit-identical.")
         init_rows: List[np.ndarray] = []
+        full_mask = 0
+        if self._sound:
+            from ..ops.expand import eventually_indices
+            full_mask = sum(1 << i
+                            for i in eventually_indices(self._properties))
         for s in init_states:
             if validate is not None:
                 validate(s)
             fp = self._canon_fp(s)
-            if fp not in self._generated:
-                self._generated[fp] = None
+            if self._sound:
+                from ..fingerprint import fp64_node
+                key = fp64_node(fp, full_mask)
+            else:
+                key = fp
+            if key not in self._generated:
+                self._generated[key] = None
                 if self._symmetry:
-                    self._orig_of[fp] = model.fingerprint(s)
+                    self._orig_of[key] = model.fingerprint(s)
+                elif self._sound:
+                    self._orig_of[key] = fp
                 init_rows.append(model.encode(s))
         self._unique_state_count = len(self._generated)
         return init_rows
@@ -461,15 +496,17 @@ class TpuChecker(HostChecker):
             # launching the chunk (which donates the carry) while the
             # seed/insert programs are still in flight was measured to
             # slow the whole chunk loop ~2.5x on the tunneled device
-            carry = seed_carry(model, qcap, self._capacity, init_rows,
-                               seed_ebits, symmetry=self._symmetry)
+            carry = seed_carry(
+                model, qcap, self._capacity, init_rows, seed_ebits,
+                symmetry=self._symmetry or self._sound)
             key_hi, key_lo, seed_ovf = self._bulk_insert_async(
                 insert_fn, carry.key_hi, carry.key_lo,
                 list(generated.keys()))
             carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
             jax.block_until_ready(carry)
         chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
-                                  kmax, symmetry=self._symmetry)
+                                  kmax, symmetry=self._symmetry,
+                                  sound=self._sound)
 
         # --- chunk loop -------------------------------------------------
         while True:
@@ -517,7 +554,8 @@ class TpuChecker(HostChecker):
                 kmax = min(kmax * 2, fa)
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax,
-                                          symmetry=self._symmetry)
+                                          symmetry=self._symmetry,
+                                          sound=self._sound)
                 carry = carry._replace(kovf=jnp.bool_(False))
                 continue
             if self._host_props and any(
@@ -543,7 +581,8 @@ class TpuChecker(HostChecker):
                                                     headroom, insert_fn)
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax,
-                                          symmetry=self._symmetry)
+                                          symmetry=self._symmetry,
+                                          sound=self._sound)
 
         if self._host_props and any(
                 p.name not in discoveries for _i, p in self._host_props):
@@ -597,7 +636,7 @@ class TpuChecker(HostChecker):
         self._capacity = old_capacity * 4
         new_qcap = self._device_qcap(n_init, headroom)
 
-        symmetry = self._symmetry
+        symmetry = self._symmetry or self._sound
 
         def rebuild(q_rows, q_eb, q_head, q_tail,
                     log_chi, log_clo, log_phi, log_plo,
@@ -802,7 +841,7 @@ class TpuChecker(HostChecker):
             child = _combine64(chi[:log_n], clo[:log_n])
             parent = _combine64(phi[:log_n], plo[:log_n])
             self._generated.update(zip(child.tolist(), parent.tolist()))
-            if self._symmetry:
+            if self._symmetry or self._sound:
                 ohi, olo = jax.device_get(take2_fn(log_ohi, log_olo, n))
                 orig = _combine64(ohi[:log_n], olo[:log_n])
                 self._orig_of.update(zip(child.tolist(), orig.tolist()))
@@ -1026,8 +1065,13 @@ class TpuChecker(HostChecker):
         return self._model.fingerprint(state)
 
     def generated_fingerprints(self):
-        """All visited fingerprints (pulls the device log if pending)."""
+        """All visited STATE fingerprints (pulls the device log if
+        pending; under ``sound_eventually`` the node-keyed dedup record is
+        translated back to state fingerprints)."""
         self._ensure_mirror()
+        if self._sound:
+            return {self._orig_of.get(k, k)
+                    for k in self._generated.keys()}
         return set(self._generated.keys())
 
     # ------------------------------------------------------------------
@@ -1048,6 +1092,9 @@ class TpuChecker(HostChecker):
         if self._symmetry:
             raise NotImplementedError(
                 "checkpointing under symmetry reduction is not supported")
+        if self._sound:
+            raise NotImplementedError(
+                "checkpointing under sound_eventually() is not supported")
         self._ensure_mirror()
         rows, ebits = self._resume_frontier
         child = np.fromiter(self._generated.keys(), np.uint64,
@@ -1106,7 +1153,7 @@ class TpuChecker(HostChecker):
 
     def _reconstruct_path(self, fp: int) -> Path:
         self._ensure_mirror()
-        if not self._symmetry:
+        if not (self._symmetry or self._sound):
             return super()._reconstruct_path(fp)
         # the mirror chain is canonical; translate each node to the
         # ORIGINAL explored state's fingerprint (recorded device-side), so

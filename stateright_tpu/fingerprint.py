@@ -125,6 +125,19 @@ def fp64_words(words: Iterable[int]) -> int:
     return _fp64_words_py(words)
 
 
+def fp64_node(fp: int, ebits_mask: int) -> int:
+    """Dedup identity of a search NODE under sound-eventually checking:
+    the state fingerprint combined with the pending eventually-bits.
+
+    The reference deliberately leaves ebits out of the state identity and
+    documents the resulting missed counterexamples
+    (`/root/reference/src/checker/bfs.rs:239-244`);
+    ``CheckerBuilder.sound_eventually()`` opts into including them. The
+    word order ``[lo, hi, ebits]`` is mirrored bit-for-bit by
+    ``ops.hash_kernel.fp64_node_device``."""
+    return fp64_words([fp & M32, (fp >> 32) & M32, ebits_mask & M32])
+
+
 def fp64_rows(rows) -> "list":
     """Fingerprint a batch of packed states on the host.
 

@@ -98,13 +98,19 @@ def expand_frontier(model, frontier, fvalid, ebits,
                      phi=phi, plo=plo, terminal=terminal, xovf=xovf)
 
 
-def discovery_candidates(properties, exp: Expansion, fvalid):
+def discovery_candidates(properties, exp: Expansion, fvalid,
+                         whi=None, wlo=None):
     """Per-property (hit, fp_hi, fp_lo) selection on the frontier batch.
 
     ALWAYS: a row where the condition is false; SOMETIMES: a row where it
     holds; EVENTUALLY: a terminal row whose bit is still set
-    (`bfs.rs:192-226`, `:265-272`).
+    (`bfs.rs:192-226`, `:265-272`). ``whi``/``wlo`` override the witness
+    identity per row (default: the frontier fingerprints) — the
+    sound-eventually engine passes node keys so witnesses stay resolvable
+    in its node-keyed mirror.
     """
+    if whi is None:
+        whi, wlo = exp.phi, exp.plo
     hit_l, hi_l, lo_l = [], [], []
     term_flush = exp.terminal & (exp.ebits != 0)
     for i, prop in enumerate(properties):
@@ -116,8 +122,8 @@ def discovery_candidates(properties, exp: Expansion, fvalid):
             mask = term_flush & ((exp.ebits >> i) & 1).astype(bool)
         k = jnp.argmax(mask)
         hit_l.append(mask.any())
-        hi_l.append(exp.phi[k])
-        lo_l.append(exp.plo[k])
+        hi_l.append(whi[k])
+        lo_l.append(wlo[k])
     if not hit_l:
         z32 = jnp.zeros((0,), jnp.uint32)
         return jnp.zeros((0,), bool), z32, z32

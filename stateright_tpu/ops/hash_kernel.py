@@ -63,3 +63,10 @@ def fp64_device(words: jax.Array):
     iszero = (h1 == 0) & (h2 == 0)
     h2 = jnp.where(iszero, jnp.uint32(1), h2)
     return h1, h2
+
+
+def fp64_node_device(hi, lo, ebits):
+    """Device analog of ``fingerprint.fp64_node``: the dedup identity of a
+    search node under sound-eventually checking. Bit-identical to the host
+    (same ``[lo, hi, ebits]`` word order)."""
+    return fp64_device(jnp.stack([lo, hi, ebits], axis=1))

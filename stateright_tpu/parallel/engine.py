@@ -55,6 +55,10 @@ class ShardedTpuChecker(TpuChecker):
             raise NotImplementedError(
                 "checkpoint resume is not supported on the sharded "
                 "engine; use single-chip spawn_tpu")
+        if getattr(self, "_sound", False):
+            raise NotImplementedError(
+                "sound_eventually() is not supported on the sharded "
+                "engine; use single-chip spawn_tpu or the host engines")
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
