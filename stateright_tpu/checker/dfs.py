@@ -106,9 +106,10 @@ class DfsChecker(HostChecker):
                 self._state_count += 1
                 if symmetry is not None:
                     rep_fp = model.fingerprint(symmetry(next_state))
-                    # Continue the path with the pre-canonicalized state's
-                    # fingerprint (dfs.rs:266-269).
-                    next_fp = model.fingerprint(next_state)
+                    # The pre-canonicalized state's fingerprint continues
+                    # the path (dfs.rs:266-269) — computed lazily: dedup
+                    # hits (the common case) never need it
+                    next_fp = None
                 else:
                     rep_fp = next_fp = model.fingerprint(next_state)
                 if on_path is not None and ebits and rep_fp in on_path:
@@ -121,6 +122,8 @@ class DfsChecker(HostChecker):
                     # current path are seen: a cycle entered via a cross
                     # edge into a sibling branch dedups at push time and
                     # is not detected — see the pinned limitation test.)
+                    if next_fp is None:
+                        next_fp = model.fingerprint(next_state)
                     for i, prop in enumerate(properties):
                         if i in ebits and prop.name not in discoveries:
                             discoveries[prop.name] = \
@@ -132,6 +135,8 @@ class DfsChecker(HostChecker):
                 generated.add(next_key)
                 self._unique_state_count = len(generated)
                 is_terminal = False
+                if next_fp is None:
+                    next_fp = model.fingerprint(next_state)
                 pending.append(
                     (next_state, fingerprints + [next_fp], ebits,
                      on_path | {rep_fp} if on_path is not None else None))

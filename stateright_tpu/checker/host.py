@@ -15,6 +15,7 @@ import threading
 from typing import Dict, Optional
 
 from ..core import Expectation, Model
+from ..fingerprint import fp64_node
 from .builder import Checker, CheckerBuilder
 
 
@@ -103,6 +104,12 @@ class HostChecker(Checker):
         translates keys back to state fingerprints for replay."""
         self._sound = bool(builder.sound_eventually_) and bool(ebits)
         if self._sound:
+            if max(ebits) > 31:
+                # fp64_node hashes a 32-bit mask; truncating silently
+                # would quietly reintroduce the miss this mode removes
+                raise NotImplementedError(
+                    "sound_eventually() supports eventually-property "
+                    "indices 0..31")
             self._node_fp: Dict[int, int] = {}
 
     def _ebits_mask(self, ebits) -> int:
@@ -115,8 +122,6 @@ class HostChecker(Checker):
     def _node_key(self, fp: int, ebits_mask: int) -> int:
         if not self._sound:
             return fp
-        from ..fingerprint import fp64_node
-
         key = fp64_node(fp, ebits_mask)
         self._node_fp[key] = fp
         return key

@@ -286,6 +286,11 @@ class TpuChecker(HostChecker):
             p.expectation == Expectation.EVENTUALLY
             for p in self._properties)
         if self._sound:
+            if any(i > 31 for i, p in enumerate(self._properties)
+                   if p.expectation == Expectation.EVENTUALLY):
+                raise NotImplementedError(
+                    "sound_eventually() supports eventually-property "
+                    "indices 0..31")
             if self._host_props:
                 raise NotImplementedError(
                     "sound_eventually() with host-evaluated properties "
@@ -408,6 +413,7 @@ class TpuChecker(HostChecker):
         init_rows: List[np.ndarray] = []
         full_mask = 0
         if self._sound:
+            from ..fingerprint import fp64_node
             from ..ops.expand import eventually_indices
             full_mask = sum(1 << i
                             for i in eventually_indices(self._properties))
@@ -415,11 +421,7 @@ class TpuChecker(HostChecker):
             if validate is not None:
                 validate(s)
             fp = self._canon_fp(s)
-            if self._sound:
-                from ..fingerprint import fp64_node
-                key = fp64_node(fp, full_mask)
-            else:
-                key = fp
+            key = fp64_node(fp, full_mask) if self._sound else fp
             if key not in self._generated:
                 self._generated[key] = None
                 if self._symmetry:
