@@ -26,8 +26,17 @@ with a canonical struct-of-words state layout:
   is maintained incrementally with one suffix shift per insert/remove
   instead of a full ``lax.sort`` per (state, action) lane — measured ~5
   ms/iteration cheaper inside the engine's device loop.
-  Currently implements the ``UnorderedNonDuplicating`` semantics (the
-  default for every register-protocol example and the paxos north star).
+  Both unordered semantics are implemented (`network.rs:44-64`):
+  ``UnorderedNonDuplicating`` (the default for every register-protocol
+  example and the paxos north star) keeps per-envelope counts;
+  ``UnorderedDuplicating`` is a set — delivery leaves the envelope in
+  flight (redelivery is always possible) and a re-send of a present
+  envelope is a network no-op.
+* **Lossy networks** extend the action axis: action ``E + e`` drops one
+  copy of slot ``e`` (`model.rs:217-220`; for duplicating networks a drop
+  removes the envelope outright — "never deliver again",
+  `network.rs:238-275`), so message-loss interleavings are explored
+  exhaustively on device exactly like on the host.
 * **History** (e.g. a linearizability tester) rides as packed words with
   JAX record hooks mirroring ``record_msg_out``/``record_msg_in``
   (`model.rs:157-184`, `:261-264`), so history distinctions stay part of
@@ -50,7 +59,7 @@ import numpy as np
 from ..models.packed import PackedModel
 from .core import Envelope, Id
 from .model import ActorModel, ActorModelState
-from .network import UnorderedNonDuplicating
+from .network import UnorderedDuplicating, UnorderedNonDuplicating
 
 _OCC = 1 << 16  # slot-occupied flag in the hdr word
 _EMPTY_SORT_KEY = 0xFFFFFFFF  # empties sort last
@@ -89,10 +98,22 @@ class PackedActorModel(ActorModel, PackedModel):
         self._timer_off = self._net_off + self.net_capacity * self._sw
         self._hist_off = self._timer_off + 1
         self.packed_width = self._hist_off + self.history_width
-        self.max_actions = self.net_capacity
+        self._net_dup = isinstance(self.init_network_,
+                                   UnorderedDuplicating)
         if self.history_width:
             # host properties (e.g. consistency testers) read the history
             self.host_property_cols = (self._hist_off, self.history_width)
+
+    @property
+    def max_actions(self) -> int:
+        # a lossy network doubles the axis: action E + e drops slot e;
+        # ``device_timers`` appends one Timeout lane per actor. Computed
+        # on demand because ``lossy_network(...)`` may be set after
+        # construction (the compiled-program caches key on it).
+        n = self.net_capacity * (2 if self.lossy_network_ else 1)
+        if self.device_timers:
+            n += len(self.actor_widths)
+        return n
 
     # --- subclass interface ----------------------------------------------
     def encode_actor(self, index: int, state: Any) -> List[int]:
@@ -126,6 +147,23 @@ class PackedActorModel(ActorModel, PackedModel):
         """
         raise NotImplementedError
 
+    #: opt-in Timeout lanes: models whose actors use timers set this True
+    #: and implement :meth:`packed_on_timeout`
+    device_timers: bool = False
+
+    def packed_on_timeout(self, actors, aidx):
+        """JAX timeout kernel (``on_timeout``, `model.rs:288-306`).
+
+        Args:
+          actors: uint32[AW] concatenated actor states;
+          aidx: traced uint32 actor index whose timer fired.
+        Returns:
+          (new_actors uint32[AW], changed bool,
+           sends like :meth:`packed_deliver`,
+           keep_timer bool — True iff the handler re-set its timer).
+        """
+        raise NotImplementedError
+
     def packed_record_out(self, history, src, dst, msg):
         """JAX analog of ``record_msg_out`` (applied per valid Send)."""
         return history
@@ -155,11 +193,18 @@ class PackedActorModel(ActorModel, PackedModel):
             assert len(words) == self.actor_widths[i]
             out[off:off + len(words)] = words
         network = state.network
-        assert isinstance(network, UnorderedNonDuplicating), \
-            "PackedActorModel currently packs the unordered " \
-            "non-duplicating network semantics"
         slots = []
-        for env, count in network._counts:
+        if isinstance(network, UnorderedNonDuplicating):
+            assert not self._net_dup, \
+                "model was configured with a duplicating init network"
+            entries = [(env, count) for env, count in network._counts]
+        else:
+            assert isinstance(network, UnorderedDuplicating) \
+                and self._net_dup, \
+                "PackedActorModel packs the two unordered network " \
+                f"semantics; got {type(network).__name__}"
+            entries = [(env, 1) for env in network._set]
+        for env, count in entries:
             hdr = _OCC | (int(env.src) << 8) | int(env.dst)
             slots.append(tuple([hdr, count] + self.encode_msg(env.msg)))
         assert len(slots) <= self.net_capacity, \
@@ -194,7 +239,10 @@ class PackedActorModel(ActorModel, PackedModel):
             env = Envelope(src=Id((hdr >> 8) & 0xFF), dst=Id(hdr & 0xFF),
                            msg=self.decode_msg(words[off + 2:off + self._sw]))
             counts[env] = words[off + 1]
-        network = UnorderedNonDuplicating(frozenset(counts.items()))
+        if self._net_dup:
+            network = UnorderedDuplicating(frozenset(counts.keys()))
+        else:
+            network = UnorderedNonDuplicating(frozenset(counts.items()))
         timer = words[self._timer_off]
         is_timer_set = tuple(bool((timer >> i) & 1)
                              for i in range(len(self.actor_widths)))
@@ -224,6 +272,16 @@ class PackedActorModel(ActorModel, PackedModel):
                              axis=0)
         return jnp.where((emptied & (idx >= e))[:, None], up, slots)
 
+    def _net_remove(self, slots, e):
+        """Remove slot ``e`` outright (a drop on a duplicating network —
+        "never deliver again", `network.rs:238-275`): shift the suffix up
+        one row, pushing a zeroed row onto the empty tail."""
+        import jax.numpy as jnp
+        idx = jnp.arange(self.net_capacity)
+        up = jnp.concatenate([slots[1:], jnp.zeros_like(slots[:1])],
+                             axis=0)
+        return jnp.where((idx >= e)[:, None], up, slots)
+
     def _net_send(self, slots, src, dst, msg, valid):
         """Send one envelope: bump the matching slot's count in place, or
         insert a fresh ``[hdr, 1, msg]`` row at its (hdr, msg)-sorted
@@ -242,9 +300,12 @@ class PackedActorModel(ActorModel, PackedModel):
             & jnp.all(slots[:, 2:] == msg[None, :], axis=1)
         has_match = match.any()
         has_empty = (~occupied).any()
-        # matched: bump the count column in place (no reorder)
-        col1 = jnp.where(match & valid, slots[:, 1] + 1, slots[:, 1])
-        slots = slots.at[:, 1].set(col1)
+        if not self._net_dup:
+            # matched: bump the count column in place (no reorder); a
+            # duplicating network is a set — re-sending a present
+            # envelope is a no-op
+            col1 = jnp.where(match & valid, slots[:, 1] + 1, slots[:, 1])
+            slots = slots.at[:, 1].set(col1)
         # fresh: lexicographic rank of (hdr, msg) among occupied rows
         lt = jnp.zeros((e_cap,), bool)
         eq = jnp.ones((e_cap,), bool)
@@ -269,34 +330,41 @@ class PackedActorModel(ActorModel, PackedModel):
         """Refuse configurations whose transitions the packed action axis
         cannot express (the device would silently under-explore what the
         host model checks exhaustively). Called by ``spawn_tpu`` on every
-        init state; the device itself can never *create* a set timer since
-        ``packed_deliver`` has no timer interface."""
-        if any(state.is_timer_set):
+        init state. With ``device_timers`` the Timeout lanes cover
+        timer-driven actors (``packed_on_timeout``); ``packed_deliver``
+        still has no set-timer interface, so a model whose MESSAGE
+        handlers set timers stays host-only (the packed contract
+        validator catches the successor mismatch)."""
+        if any(state.is_timer_set) and not self.device_timers:
             raise NotImplementedError(
-                "PackedActorModel does not support timers on the device "
-                "engine (Timeout actions are not in the packed action "
-                "axis); use the host engines for timer-driven actors")
+                "PackedActorModel needs device_timers=True (and a "
+                "packed_on_timeout kernel) to explore Timeout actions on "
+                "the device engine; use the host engines otherwise")
 
     def packed_step(self, words):
         import jax
         import jax.numpy as jnp
-        if self.lossy_network_:
-            raise NotImplementedError(
-                "lossy networks are not supported on the device engine "
-                "(Drop actions are not in the packed action axis); use "
-                "the host engines for lossy checks")
         aw, sw, e_cap = self._aw, self._sw, self.net_capacity
         hw = self.history_width
+        lossy = self.lossy_network_
+        dup = self._net_dup
+        timers_on = self.device_timers
+        base = e_cap * (2 if lossy else 1)
         actors = words[:aw]
         slots = words[self._net_off:self._timer_off].reshape(e_cap, sw)
         hist = words[self._hist_off:] if hw else None
         n_actors = len(self.actor_widths)
+        timer = words[self._timer_off:self._timer_off + 1]
 
-        def one_action(e):
+        def one_action(a):
             # the action axis is vmapped (not unrolled): one traced copy
-            # of the delivery body serves all E slots, which keeps the
-            # XLA graph — and compile time — independent of net_capacity.
-            # The slot row is read by masked sum, not dynamic gather.
+            # of the delivery body serves all E slots (plus E drop lanes
+            # when lossy), which keeps the XLA graph - and compile time -
+            # independent of net_capacity. The slot row is read by masked
+            # sum, not dynamic gather.
+            is_drop = (a >= e_cap) & (a < 2 * e_cap)  # lossy lanes
+            e = jnp.minimum(jnp.where(is_drop, a - e_cap, a),
+                            e_cap - 1)
             rowsel = (jnp.arange(e_cap) == e).astype(jnp.uint32)
             row = (slots * rowsel[:, None]).sum(axis=0)
             hdr = row[0]
@@ -313,7 +381,9 @@ class PackedActorModel(ActorModel, PackedModel):
             # no-op pruning (model.rs:259-260) + recipient existence
             valid = occupied & (dst < n_actors) & (changed | any_send)
 
-            new_slots = self._net_consume(slots, e)
+            # a duplicating delivery leaves the envelope in flight
+            # (redelivery stays possible, `network.rs:199-236`)
+            new_slots = slots if dup else self._net_consume(slots, e)
             new_hist = None
             if hw:
                 new_hist = self.packed_record_in(hist, src, dst, msg)
@@ -329,22 +399,83 @@ class PackedActorModel(ActorModel, PackedModel):
                     sdst.astype(jnp.uint32), smsg, svalid)
                 overflow = overflow | ovf
 
-            parts = [new_actors, new_slots.reshape(-1),
-                     words[self._timer_off:self._timer_off + 1]]
+            parts = [new_actors, new_slots.reshape(-1), timer]
             if hw:
                 parts.append(new_hist)
-            row = jnp.concatenate(parts).astype(jnp.uint32)
+            row_out = jnp.concatenate(parts).astype(jnp.uint32)
+
+            if lossy:
+                # Drop action (`model.rs:217-220`): remove one copy (the
+                # whole envelope for duplicating networks); actors and
+                # history are untouched, and the network always changes,
+                # so validity is just occupancy
+                drop_slots = (self._net_remove(slots, e) if dup
+                              else self._net_consume(slots, e))
+                drop_parts = [actors, drop_slots.reshape(-1), timer]
+                if hw:
+                    drop_parts.append(hist)
+                drop_row = jnp.concatenate(drop_parts).astype(jnp.uint32)
+                row_out = jnp.where(is_drop, drop_row, row_out)
+                valid = jnp.where(is_drop, occupied, valid)
+                overflow = overflow & ~is_drop
+
+            if timers_on:
+                # Timeout lane (`model.rs:288-306`): the timer must be
+                # set; the fired timer clears unless the handler re-set
+                # it. NOTE the host (like the reference, `model.rs:295`)
+                # never actually prunes a Timeout: its no-op check needs
+                # an empty command list while keep-timer needs a SetTimer
+                # command, which is unsatisfiable — so a no-op handler
+                # that re-sets its timer yields a self-loop successor
+                # (harmless: dedup eats it), and validity here is just
+                # the timer bit
+                is_timeout = a >= base
+                aidx = jnp.minimum(a - base, n_actors - 1) \
+                    .astype(jnp.uint32)
+                tw = timer[0]
+                tbit = ((tw >> aidx) & 1).astype(bool)
+                t_actors, t_changed, t_sends, keep = \
+                    self.packed_on_timeout(actors, aidx)
+                t_any = jnp.bool_(False)
+                for _d, _m, sv in t_sends:
+                    t_any = t_any | sv
+                t_slots = slots
+                t_hist = hist
+                t_ovf = jnp.bool_(False)
+                for sdst, smsg, svalid in t_sends:
+                    smsg = smsg.astype(jnp.uint32)
+                    if hw:
+                        rec = self.packed_record_out(
+                            t_hist, aidx, sdst, smsg)
+                        t_hist = jnp.where(svalid, rec, t_hist)
+                    t_slots, ovf2 = self._net_send(
+                        t_slots, aidx, sdst.astype(jnp.uint32), smsg,
+                        svalid)
+                    t_ovf = t_ovf | ovf2
+                new_tw = (tw & ~(jnp.uint32(1) << aidx)) \
+                    | (keep.astype(jnp.uint32) << aidx)
+                t_parts = [t_actors, t_slots.reshape(-1), new_tw[None]]
+                if hw:
+                    t_parts.append(t_hist)
+                t_row = jnp.concatenate(t_parts).astype(jnp.uint32)
+                t_valid = tbit
+                row_out = jnp.where(is_timeout, t_row, row_out)
+                valid = jnp.where(is_timeout, t_valid, valid)
+                overflow = jnp.where(is_timeout, t_ovf, overflow)
+
             # an overflowing successor would silently drop a message and
             # under-explore the state graph: poison + invalidate the row
             # AND report the overflow, which every engine surfaces as a
             # hard error (a mis-sized net_capacity must never read as
             # "checked clean")
             overflow = valid & overflow
-            row = jnp.where(overflow, jnp.full_like(row, 0xDEADBEEF), row)
-            valid = valid & ~overflow & self.packed_boundary(row)
-            return row, valid, overflow
+            row_out = jnp.where(overflow,
+                                jnp.full_like(row_out, 0xDEADBEEF),
+                                row_out)
+            valid = valid & ~overflow & self.packed_boundary(row_out)
+            return row_out, valid, overflow
 
-        return jax.vmap(one_action)(jnp.arange(e_cap))
+        return jax.vmap(one_action)(jnp.arange(self.max_actions))
 
     # --- fingerprint ------------------------------------------------------
     def fingerprint(self, state: ActorModelState) -> int:

@@ -16,6 +16,7 @@ from typing import Any, Optional
 from ..core import Expectation
 from .core import Actor, Id, Out
 from .model import ActorModel
+from .packed import PackedActorModel
 
 
 @dataclass(frozen=True)
@@ -102,3 +103,189 @@ class PingPongCfg:
                     Expectation.EVENTUALLY, "#out <= #in + 1",
                     lambda _, state: state.history[1]
                     <= state.history[0] + 1))
+
+
+class PackedPingPong(PackedActorModel):
+    """Device encoding of the ping_pong fixture — the workload that pins
+    lossy/duplicating network semantics on the TPU engine (oracle counts
+    `src/actor/model.rs:611`, `:642`). History is not maintained (the
+    pinned configs use ``maintains_history=False``)."""
+
+    def __init__(self, max_nat: int, lossy: bool = False,
+                 duplicating: bool = True, net_capacity: int = 16):
+        from .network import Network
+
+        super().__init__(cfg=self, init_history=(0, 0))
+        self.max_nat = max_nat
+        self.actor(PingPongActor(serve_to=Id(1)))
+        self.actor(PingPongActor(serve_to=None))
+        self.init_network(Network.new_unordered_duplicating()
+                          if duplicating
+                          else Network.new_unordered_nonduplicating())
+        self.lossy_network(lossy)
+        self.within_boundary_fn(
+            lambda cfg, state: all(c <= cfg.max_nat
+                                   for c in state.actor_states))
+        self.property(Expectation.ALWAYS, "delta within 1",
+                      lambda _, s: (max(s.actor_states)
+                                    - min(s.actor_states)) <= 1)
+        self.property(Expectation.SOMETIMES, "can reach max",
+                      lambda m, s: any(c == m.cfg.max_nat
+                                       for c in s.actor_states))
+        self.property(Expectation.EVENTUALLY, "must reach max",
+                      lambda m, s: any(c == m.cfg.max_nat
+                                       for c in s.actor_states))
+        self.property(Expectation.EVENTUALLY, "must exceed max",
+                      lambda m, s: any(c == m.cfg.max_nat + 1
+                                       for c in s.actor_states))
+        self.actor_widths = [1, 1]
+        self.msg_width = 1
+        self.net_capacity = net_capacity
+        self.max_sends = 1
+        self.history_width = 0
+        self.finalize_layout()
+
+    def cache_key(self):
+        return ("ping_pong", self.max_nat, self.net_capacity,
+                self._net_dup)
+
+    # --- packing ----------------------------------------------------------
+    _T_PING, _T_PONG = 1, 2
+
+    def encode_actor(self, index, state):
+        return [int(state)]
+
+    def decode_actor(self, index, words):
+        return int(words[0])
+
+    def encode_msg(self, msg):
+        if isinstance(msg, Ping):
+            return [(self._T_PING << 8) | msg.value]
+        assert isinstance(msg, Pong)
+        return [(self._T_PONG << 8) | msg.value]
+
+    def decode_msg(self, words):
+        mtype, value = words[0] >> 8, words[0] & 0xFF
+        return Ping(value) if mtype == self._T_PING else Pong(value)
+
+    # --- device kernels ---------------------------------------------------
+    def packed_deliver(self, actors, src, dst, msg):
+        import jax.numpy as jnp
+
+        sel = jnp.arange(2, dtype=jnp.uint32) == dst
+        w = jnp.where(sel, actors, 0).sum()
+        mtype = msg[0] >> 8
+        value = msg[0] & 0xFF
+        changed = (w == value) & ((mtype == self._T_PING)
+                                  | (mtype == self._T_PONG))
+        new_actors = jnp.where(sel & changed, w + 1, actors) \
+            .astype(jnp.uint32)
+        # Pong(v) -> Ping(v+1); Ping(v) -> Pong(v)  (test_util.rs:20-33)
+        reply = jnp.where(
+            mtype == self._T_PONG,
+            (jnp.uint32(self._T_PING) << 8) | (value + 1),
+            (jnp.uint32(self._T_PONG) << 8) | value)
+        return new_actors, changed, [(src, reply[None], changed)]
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+
+        a, b = words[0], words[1]
+        mx = jnp.uint32(self.max_nat)
+        delta = (jnp.maximum(a, b) - jnp.minimum(a, b)) <= 1
+        reach = (a == mx) | (b == mx)
+        exceed = (a == mx + 1) | (b == mx + 1)
+        return jnp.stack([delta, reach, reach, exceed])
+
+    def packed_boundary(self, words):
+        mx = self.max_nat
+        return (words[0] <= mx) & (words[1] <= mx)
+
+
+class TimerCountActor(Actor):
+    """Counts timer firings: each ``on_timeout`` increments and re-sets
+    the timer until ``max_nat``. The interleavings of N independent
+    counters exercise ``Timeout`` actions exhaustively."""
+
+    def __init__(self, max_nat: int):
+        self.max_nat = max_nat
+
+    def on_start(self, id: Id, o: Out) -> int:
+        if self.max_nat > 0:
+            o.set_timer((0.0, 0.0))
+        return 0
+
+    def on_msg(self, id, state, src, msg, o):
+        return None
+
+    def on_timeout(self, id: Id, state: int, o: Out):
+        nxt = state + 1
+        if nxt < self.max_nat:
+            o.set_timer((0.0, 0.0))
+        return nxt
+
+
+class PackedTimerCount(PackedActorModel):
+    """Device encoding of N :class:`TimerCountActor`s — the fixture
+    pinning Timeout-action lanes on the TPU engine."""
+
+    device_timers = True
+
+    def __init__(self, n_actors: int, max_nat: int):
+        from .network import Network
+
+        super().__init__(cfg=self, init_history=None)
+        self.max_nat = max_nat
+        self.n_actors = n_actors
+        for _ in range(n_actors):
+            self.actor(TimerCountActor(max_nat))
+        self.init_network(Network.new_unordered_nonduplicating())
+        self.property(Expectation.ALWAYS, "bounded",
+                      lambda m, s: all(c <= m.cfg.max_nat
+                                       for c in s.actor_states))
+        self.property(Expectation.SOMETIMES, "all max",
+                      lambda m, s: all(c == m.cfg.max_nat
+                                       for c in s.actor_states))
+        self.actor_widths = [1] * n_actors
+        self.msg_width = 1
+        self.net_capacity = 1  # the network stays empty
+        self.max_sends = 1
+        self.history_width = 0
+        self.finalize_layout()
+
+    def cache_key(self):
+        return ("timer_count", self.n_actors, self.max_nat)
+
+    def encode_actor(self, index, state):
+        return [int(state)]
+
+    def decode_actor(self, index, words):
+        return int(words[0])
+
+    def encode_msg(self, msg):  # pragma: no cover - network unused
+        return [0]
+
+    def decode_msg(self, words):  # pragma: no cover - network unused
+        return None
+
+    def packed_deliver(self, actors, src, dst, msg):
+        import jax.numpy as jnp
+        zmsg = jnp.zeros((self.msg_width,), jnp.uint32)
+        return actors, jnp.bool_(False), \
+            [(jnp.uint32(0), zmsg, jnp.bool_(False))]
+
+    def packed_on_timeout(self, actors, aidx):
+        import jax.numpy as jnp
+        sel = jnp.arange(self.n_actors, dtype=jnp.uint32) == aidx
+        c = jnp.where(sel, actors, 0).sum()
+        new_actors = jnp.where(sel, c + 1, actors).astype(jnp.uint32)
+        keep = (c + 1) < self.max_nat
+        zmsg = jnp.zeros((self.msg_width,), jnp.uint32)
+        return new_actors, jnp.bool_(True), \
+            [(jnp.uint32(0), zmsg, jnp.bool_(False))], keep
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        counts = words[:self.n_actors]
+        mx = jnp.uint32(self.max_nat)
+        return jnp.stack([(counts <= mx).all(), (counts == mx).all()])

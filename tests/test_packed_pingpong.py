@@ -1,0 +1,78 @@
+"""Lossy + duplicating network semantics on the TPU engine, pinned by the
+ping_pong oracles (`/root/reference/src/actor/model.rs:603-646`): lossy
+duplicating max 5 -> 4,094 unique states; lossless non-duplicating
+max 5 -> 11. Drop actions are part of the packed action axis, so
+message-loss interleavings are explored exhaustively on device."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.actor.core import Envelope, Id  # noqa: E402
+from stateright_tpu.actor.model import Deliver, Drop  # noqa: E402
+from stateright_tpu.actor.test_util import PackedPingPong, Ping  # noqa: E402
+from stateright_tpu.models.packed import validate_packed_model  # noqa: E402
+
+
+class TestPackedPingPong:
+    def test_contract_lossy_duplicating_full(self):
+        # host/device step agreement over the whole 4,094-state space,
+        # including every Drop successor
+        assert validate_packed_model(
+            PackedPingPong(5, lossy=True, duplicating=True),
+            max_states=5000) == 4_094
+
+    def test_contract_lossless_nonduplicating(self):
+        assert validate_packed_model(
+            PackedPingPong(5, lossy=False, duplicating=False),
+            max_states=100) == 11
+
+    def test_device_lossy_duplicating_4094(self):
+        ck = (PackedPingPong(5, lossy=True, duplicating=True).checker()
+              .tpu_options(capacity=1 << 14).spawn_tpu().join())
+        assert ck.unique_state_count() == 4_094
+        assert ck.discovery("delta within 1") is None  # safety holds
+        assert ck.discovery("can reach max") is not None
+        # dropping messages can stall the protocol: the liveness
+        # counterexample surfaces at a terminal, and its witness replays
+        # through the host model (Drop actions included)
+        path = ck.assert_any_discovery("must reach max")
+        assert max(path.last_state().actor_states) < 5
+
+    def test_device_matches_host_reached_set(self):
+        model = PackedPingPong(5, lossy=True, duplicating=True)
+        host = model.checker().spawn_bfs().join()
+        dev = (PackedPingPong(5, lossy=True, duplicating=True).checker()
+               .tpu_options(capacity=1 << 14).spawn_tpu().join())
+        assert host.unique_state_count() == 4_094
+        assert (dev.generated_fingerprints()
+                == host.generated_fingerprints())
+
+    def test_device_lossless_nonduplicating_11(self):
+        ck = (PackedPingPong(5, lossy=False, duplicating=False).checker()
+              .tpu_options(capacity=1 << 10, fmax=16).spawn_tpu().join())
+        assert ck.unique_state_count() == 11
+        assert ck.discovery("delta within 1") is None
+        assert ck.discovery("can reach max") is not None
+        assert ck.discovery("must reach max") is None  # liveness holds
+
+    def test_drop_witness_replays_on_host(self):
+        # `model.rs:616-631`: dropping the first Ping gets stuck — the
+        # canonical witness must also be accepted by assert_discovery
+        ck = (PackedPingPong(5, lossy=True, duplicating=True).checker()
+              .tpu_options(capacity=1 << 14).spawn_tpu().join())
+        ck.assert_discovery("must reach max", [
+            Drop(Envelope(src=Id(0), dst=Id(1), msg=Ping(0))),
+        ])
+
+
+def test_remaining_network_quadrants_contract():
+    # lossless+duplicating (delivery leaves the envelope, no Drop lanes)
+    # and lossy+non-duplicating (Drop decrements a count) are distinct
+    # code paths from the two pinned configs
+    assert validate_packed_model(
+        PackedPingPong(5, lossy=False, duplicating=True),
+        max_states=100) == 11
+    assert validate_packed_model(
+        PackedPingPong(5, lossy=True, duplicating=False),
+        max_states=100) == 22

@@ -1,0 +1,81 @@
+"""Timeout actions on the TPU engine (``device_timers`` +
+``packed_on_timeout``): timer firings are part of the packed action axis,
+mirroring the host semantics (`/root/reference/src/actor/model.rs:288-306`
+— the fired timer clears unless the handler re-sets it; a no-op handler
+that keeps its timer is pruned)."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.actor.test_util import PackedTimerCount  # noqa: E402
+from stateright_tpu.models.packed import validate_packed_model  # noqa: E402
+
+
+class TestPackedTimers:
+    def test_contract_full_space(self):
+        # host/device step agreement over every state, including all
+        # Timeout successors and timer-bit updates
+        assert validate_packed_model(PackedTimerCount(2, 3),
+                                     max_states=100) == 16
+
+    def test_device_counts_and_parity(self):
+        host = PackedTimerCount(2, 3).checker().spawn_bfs().join()
+        assert host.unique_state_count() == 16  # (max+1)^2 interleavings
+        dev = (PackedTimerCount(2, 3).checker()
+               .tpu_options(capacity=1 << 10, fmax=16).spawn_tpu().join())
+        assert dev.unique_state_count() == 16
+        assert (dev.generated_fingerprints()
+                == host.generated_fingerprints())
+        dev.assert_properties()
+
+    def test_three_actors(self):
+        dev = (PackedTimerCount(3, 2).checker()
+               .tpu_options(capacity=1 << 10, fmax=16).spawn_tpu().join())
+        assert dev.unique_state_count() == 27
+        dev.assert_properties()
+
+    def test_timer_models_without_optin_still_rejected(self):
+        from stateright_tpu.actor.test_util import PackedPingPong
+
+        # a model whose init states carry timers but has no Timeout lanes
+        # must refuse device checking rather than under-explore
+        m = PackedPingPong(3)
+        state = m.init_states()[0]
+        state = type(state)(actor_states=state.actor_states,
+                            network=state.network,
+                            is_timer_set=(True, False),
+                            history=state.history)
+        with pytest.raises(NotImplementedError):
+            m.validate_device_state(state)
+
+
+def test_noop_keep_handler_matches_host_selfloop():
+    # the host (like the reference, model.rs:295) never prunes a Timeout:
+    # a no-op handler that re-sets its timer yields a self-loop successor,
+    # and the device contract must agree
+    import jax.numpy as jnp
+
+    from stateright_tpu.actor.test_util import (PackedTimerCount,
+                                                TimerCountActor)
+
+    class NoopKeep(PackedTimerCount):
+        def __init__(self):
+            super().__init__(1, 1)
+
+        def cache_key(self):
+            return ("noop_keep_timer",)
+
+        def packed_on_timeout(self, actors, aidx):
+            zmsg = jnp.zeros((self.msg_width,), jnp.uint32)
+            return actors, jnp.bool_(False), \
+                [(jnp.uint32(0), zmsg, jnp.bool_(False))], jnp.bool_(True)
+
+    class NoopKeepActor(TimerCountActor):
+        def on_timeout(self, id, state, o):
+            o.set_timer((0.0, 0.0))
+            return None
+
+    m = NoopKeep()
+    m.actors = [NoopKeepActor(1)]
+    assert validate_packed_model(m, max_states=10) == 1
