@@ -125,6 +125,19 @@ def context_remaining_configs() -> None:
           f"{ck.unique_state_count()} uniq in {dt:.2f}s "
           f"= {ck.unique_state_count()/dt:.0f}/s", file=sys.stderr)
 
+    from stateright_tpu.examples.abd_packed import PackedAbd
+
+    def tpu_abd_ordered():
+        return (PackedAbd(2, server_count=3, ordered=True,
+                          channel_depth=8)
+                .checker().tpu_options(capacity=1 << 20)
+                .target_state_count(100_000).spawn_tpu().join())
+    timed(tpu_abd_ordered)
+    dt, ck = timed(tpu_abd_ordered)
+    print(f"# tpu linearizable-register check 2 ordered (capped): "
+          f"{ck.unique_state_count()} uniq in {dt:.2f}s "
+          f"= {ck.unique_state_count()/dt:.0f}/s", file=sys.stderr)
+
 
 def main() -> None:
     host_rate = host_paxos_rate()

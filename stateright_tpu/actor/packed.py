@@ -59,7 +59,8 @@ import numpy as np
 from ..models.packed import PackedModel
 from .core import Envelope, Id
 from .model import ActorModel, ActorModelState
-from .network import UnorderedDuplicating, UnorderedNonDuplicating
+from .network import (Ordered, UnorderedDuplicating,
+                      UnorderedNonDuplicating)
 
 _OCC = 1 << 16  # slot-occupied flag in the hdr word
 _EMPTY_SORT_KEY = 0xFFFFFFFF  # empties sort last
@@ -88,18 +89,33 @@ class PackedActorModel(ActorModel, PackedModel):
     max_sends: int = 1
     host_property_indices: Tuple[int, ...] = ()
 
+    #: per-(src, dst) FIFO depth for ordered networks
+    channel_depth: int = 4
+
     def finalize_layout(self) -> None:
         """Compute offsets once the config fields are set."""
         self.actor_widths: List[int] = list(self.actor_widths)
         self._actor_off = np.cumsum([0] + self.actor_widths).tolist()
         self._aw = self._actor_off[-1]
-        self._sw = 2 + self.msg_width  # hdr, count, msg words
         self._net_off = self._aw
-        self._timer_off = self._net_off + self.net_capacity * self._sw
-        self._hist_off = self._timer_off + 1
-        self.packed_width = self._hist_off + self.history_width
         self._net_dup = isinstance(self.init_network_,
                                    UnorderedDuplicating)
+        self._net_ordered = isinstance(self.init_network_, Ordered)
+        if self._net_ordered:
+            # ordered layout: one FIFO per (src, dst) channel at a FIXED
+            # position — no sorting needed for canonicality, the channel
+            # index and queue order are the identity
+            a = len(self.actor_widths)
+            self._n_chan = a * a
+            self._msgs_off = self._net_off + self._n_chan
+            self._timer_off = self._msgs_off \
+                + self._n_chan * self.channel_depth * self.msg_width
+        else:
+            self._sw = 2 + self.msg_width  # hdr, count, msg words
+            self._timer_off = self._net_off \
+                + self.net_capacity * self._sw
+        self._hist_off = self._timer_off + 1
+        self.packed_width = self._hist_off + self.history_width
         if self.history_width:
             # host properties (e.g. consistency testers) read the history
             self.host_property_cols = (self._hist_off, self.history_width)
@@ -110,7 +126,14 @@ class PackedActorModel(ActorModel, PackedModel):
         # ``device_timers`` appends one Timeout lane per actor. Computed
         # on demand because ``lossy_network(...)`` may be set after
         # construction (the compiled-program caches key on it).
-        n = self.net_capacity * (2 if self.lossy_network_ else 1)
+        if self._net_ordered:
+            if self.lossy_network_:
+                raise NotImplementedError(
+                    "lossy ordered networks are host-only on the device "
+                    "engine (no Drop lanes for FIFO channels yet)")
+            n = self._n_chan
+        else:
+            n = self.net_capacity * (2 if self.lossy_network_ else 1)
         if self.device_timers:
             n += len(self.actor_widths)
         return n
@@ -193,27 +216,49 @@ class PackedActorModel(ActorModel, PackedModel):
             assert len(words) == self.actor_widths[i]
             out[off:off + len(words)] = words
         network = state.network
-        slots = []
-        if isinstance(network, UnorderedNonDuplicating):
-            assert not self._net_dup, \
-                "model was configured with a duplicating init network"
-            entries = [(env, count) for env, count in network._counts]
+        if self._net_ordered:
+            if not isinstance(network, Ordered):
+                raise TypeError(
+                    "model was configured with an ordered init network; "
+                    f"got {type(network).__name__}")
+            a = len(self.actor_widths)
+            d, mw = self.channel_depth, self.msg_width
+            for (src, dst), msgs in network._channels:
+                if int(src) >= a or int(dst) >= a:
+                    raise ValueError(
+                        f"ordered channel ({src}, {dst}) references an "
+                        f"actor index >= {a}; out-of-range recipients "
+                        "are not encodable on the device")
+                c = int(src) * a + int(dst)
+                assert len(msgs) <= d, \
+                    f"channel ({src}, {dst}) exceeds channel_depth={d}"
+                out[self._net_off + c] = len(msgs)
+                for j, msg in enumerate(msgs):
+                    off = self._msgs_off + (c * d + j) * mw
+                    out[off:off + mw] = self.encode_msg(msg)
         else:
-            assert isinstance(network, UnorderedDuplicating) \
-                and self._net_dup, \
-                "PackedActorModel packs the two unordered network " \
-                f"semantics; got {type(network).__name__}"
-            entries = [(env, 1) for env in network._set]
-        for env, count in entries:
-            hdr = _OCC | (int(env.src) << 8) | int(env.dst)
-            slots.append(tuple([hdr, count] + self.encode_msg(env.msg)))
-        assert len(slots) <= self.net_capacity, \
-            f"network exceeds net_capacity={self.net_capacity}: " \
-            f"{len(slots)} distinct envelopes"
-        slots.sort(key=self._slot_sort_key)
-        for e, slot in enumerate(slots):
-            off = self._net_off + e * self._sw
-            out[off:off + self._sw] = slot
+            slots = []
+            if isinstance(network, UnorderedNonDuplicating):
+                assert not self._net_dup, \
+                    "model was configured with a duplicating init network"
+                entries = [(env, count) for env, count in network._counts]
+            else:
+                assert isinstance(network, UnorderedDuplicating) \
+                    and self._net_dup, \
+                    "PackedActorModel packs the two unordered network " \
+                    f"semantics; got {type(network).__name__}"
+                entries = [(env, 1) for env in network._set]
+            for env, count in entries:
+                hdr = _OCC | (int(env.src) << 8) | int(env.dst)
+                slots.append(tuple([hdr, count]
+                                   + self.encode_msg(env.msg)))
+            assert len(slots) <= self.net_capacity, \
+                f"network exceeds net_capacity={self.net_capacity}: " \
+                f"{len(slots)} distinct envelopes"
+            slots.sort(key=self._slot_sort_key)
+            for e, slot in enumerate(slots):
+                off = self._net_off + e * self._sw
+                out[off:off + self._sw] = slot
         timer = 0
         for i, set_ in enumerate(state.is_timer_set):
             timer |= int(bool(set_)) << i
@@ -230,19 +275,36 @@ class PackedActorModel(ActorModel, PackedModel):
             self.decode_actor(i, words[self._actor_off[i]:
                                        self._actor_off[i + 1]])
             for i in range(len(self.actor_widths)))
-        counts = {}
-        for e in range(self.net_capacity):
-            off = self._net_off + e * self._sw
-            hdr = words[off]
-            if not hdr & _OCC:
-                continue
-            env = Envelope(src=Id((hdr >> 8) & 0xFF), dst=Id(hdr & 0xFF),
-                           msg=self.decode_msg(words[off + 2:off + self._sw]))
-            counts[env] = words[off + 1]
-        if self._net_dup:
-            network = UnorderedDuplicating(frozenset(counts.keys()))
+        if self._net_ordered:
+            a = len(self.actor_widths)
+            d, mw = self.channel_depth, self.msg_width
+            channels = {}
+            for c in range(self._n_chan):
+                ln = words[self._net_off + c]
+                if not ln:
+                    continue
+                msgs = []
+                for j in range(ln):
+                    off = self._msgs_off + (c * d + j) * mw
+                    msgs.append(self.decode_msg(words[off:off + mw]))
+                channels[(Id(c // a), Id(c % a))] = msgs
+            network = Ordered._freeze(channels)
         else:
-            network = UnorderedNonDuplicating(frozenset(counts.items()))
+            counts = {}
+            for e in range(self.net_capacity):
+                off = self._net_off + e * self._sw
+                hdr = words[off]
+                if not hdr & _OCC:
+                    continue
+                env = Envelope(
+                    src=Id((hdr >> 8) & 0xFF), dst=Id(hdr & 0xFF),
+                    msg=self.decode_msg(words[off + 2:off + self._sw]))
+                counts[env] = words[off + 1]
+            if self._net_dup:
+                network = UnorderedDuplicating(frozenset(counts.keys()))
+            else:
+                network = UnorderedNonDuplicating(
+                    frozenset(counts.items()))
         timer = words[self._timer_off]
         is_timer_set = tuple(bool((timer >> i) & 1)
                              for i in range(len(self.actor_widths)))
@@ -342,6 +404,122 @@ class PackedActorModel(ActorModel, PackedModel):
                 "the device engine; use the host engines otherwise")
 
     def packed_step(self, words):
+        if self._net_ordered:
+            return self._packed_step_ordered(words)
+        return self._packed_step_unordered(words)
+
+    def _packed_step_ordered(self, words):
+        """Ordered-network step: action ``c`` delivers the HEAD of
+        channel ``c = src * A + dst`` (`network.rs:157-170` — ordered
+        networks expose only channel heads); sends append at the
+        destination channel's tail; a full channel reports encoding
+        overflow. Lossy ordered checking stays host-only."""
+        import jax
+        import jax.numpy as jnp
+        aw, mw = self._aw, self.msg_width
+        d, n_chan = self.channel_depth, self._n_chan
+        hw = self.history_width
+        timers_on = self.device_timers
+        n_actors = len(self.actor_widths)
+        actors = words[:aw]
+        lens = words[self._net_off:self._net_off + n_chan]
+        msgs = words[self._msgs_off:self._timer_off] \
+            .reshape(n_chan, d, mw)
+        hist = words[self._hist_off:] if hw else None
+        timer = words[self._timer_off:self._timer_off + 1]
+
+        def append_send(lens, msgs, hist, overflow, sender, sdst, smsg,
+                        svalid):
+            smsg = smsg.astype(jnp.uint32)
+            if hw:
+                rec = self.packed_record_out(hist, sender, sdst, smsg)
+                hist = jnp.where(svalid, rec, hist)
+            cd = (sender * n_actors + sdst).astype(jnp.uint32)
+            csel = jnp.arange(n_chan, dtype=jnp.uint32) == cd
+            pos = jnp.where(csel, lens, 0).sum()
+            # a send to an out-of-range recipient has no channel: report
+            # it as encoding overflow rather than silently dropping it
+            ovf = svalid & ((pos >= d) | (cd >= n_chan))
+            esel = csel[:, None] & (jnp.arange(d, dtype=jnp.uint32)
+                                    == jnp.minimum(pos, d - 1))[None, :]
+            write = esel[:, :, None] & svalid & ~ovf
+            msgs = jnp.where(write, smsg[None, None, :], msgs)
+            lens = jnp.where(csel & svalid & ~ovf, lens + 1, lens)
+            return lens, msgs, hist, overflow | ovf
+
+        def one_action(a):
+            is_timeout = a >= n_chan  # lanes only exist with timers
+            c = jnp.minimum(a, n_chan - 1)
+            src = (c // n_actors).astype(jnp.uint32)
+            dst = (c % n_actors).astype(jnp.uint32)
+            csel = jnp.arange(n_chan) == c
+            ln = jnp.where(csel, lens, 0).sum()
+            occupied = ln > 0
+            head = (msgs[:, 0, :] * csel[:, None]).sum(axis=0) \
+                .astype(jnp.uint32)
+            new_actors, changed, sends = self.packed_deliver(
+                actors, src, dst, head)
+            assert len(sends) == self.max_sends
+            any_send = jnp.bool_(False)
+            for _d2, _m2, sv in sends:
+                any_send = any_send | sv
+            valid = occupied & (changed | any_send)
+
+            # pop the head: shift the channel left, zero the tail entry
+            shifted = jnp.concatenate(
+                [msgs[:, 1:, :], jnp.zeros_like(msgs[:, :1, :])], axis=1)
+            new_msgs = jnp.where(csel[:, None, None], shifted, msgs)
+            new_lens = jnp.where(csel, lens - 1, lens)
+            new_hist = None
+            if hw:
+                new_hist = self.packed_record_in(hist, src, dst, head)
+            overflow = jnp.bool_(False)
+            for sdst, smsg, svalid in sends:
+                new_lens, new_msgs, new_hist, overflow = append_send(
+                    new_lens, new_msgs, new_hist, overflow,
+                    dst, sdst.astype(jnp.uint32), smsg, svalid)
+            parts = [new_actors, new_lens, new_msgs.reshape(-1), timer]
+            if hw:
+                parts.append(new_hist)
+            row_out = jnp.concatenate(parts).astype(jnp.uint32)
+
+            if timers_on:
+                # same Timeout semantics as the unordered step (see
+                # _packed_step_unordered): a fired timer always yields a
+                # successor; sends append to ordered channels
+                aidx = jnp.minimum(a - n_chan, n_actors - 1) \
+                    .astype(jnp.uint32)
+                tw = timer[0]
+                tbit = ((tw >> aidx) & 1).astype(bool)
+                t_actors, t_changed, t_sends, keep = \
+                    self.packed_on_timeout(actors, aidx)
+                t_lens, t_msgs, t_hist = lens, msgs, hist
+                t_ovf = jnp.bool_(False)
+                for sdst, smsg, svalid in t_sends:
+                    t_lens, t_msgs, t_hist, t_ovf = append_send(
+                        t_lens, t_msgs, t_hist, t_ovf,
+                        aidx, sdst.astype(jnp.uint32), smsg, svalid)
+                new_tw = (tw & ~(jnp.uint32(1) << aidx)) \
+                    | (keep.astype(jnp.uint32) << aidx)
+                t_parts = [t_actors, t_lens, t_msgs.reshape(-1),
+                           new_tw[None]]
+                if hw:
+                    t_parts.append(t_hist)
+                t_row = jnp.concatenate(t_parts).astype(jnp.uint32)
+                row_out = jnp.where(is_timeout, t_row, row_out)
+                valid = jnp.where(is_timeout, tbit, valid)
+                overflow = jnp.where(is_timeout, t_ovf, overflow)
+
+            overflow = valid & overflow
+            row_out = jnp.where(overflow,
+                                jnp.full_like(row_out, 0xDEADBEEF),
+                                row_out)
+            valid = valid & ~overflow & self.packed_boundary(row_out)
+            return row_out, valid, overflow
+
+        return jax.vmap(one_action)(jnp.arange(self.max_actions))
+
+    def _packed_step_unordered(self, words):
         import jax
         import jax.numpy as jnp
         aw, sw, e_cap = self._aw, self._sw, self.net_capacity
@@ -436,9 +614,6 @@ class PackedActorModel(ActorModel, PackedModel):
                 tbit = ((tw >> aidx) & 1).astype(bool)
                 t_actors, t_changed, t_sends, keep = \
                     self.packed_on_timeout(actors, aidx)
-                t_any = jnp.bool_(False)
-                for _d, _m, sv in t_sends:
-                    t_any = t_any | sv
                 t_slots = slots
                 t_hist = hist
                 t_ovf = jnp.bool_(False)

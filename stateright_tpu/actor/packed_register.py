@@ -61,9 +61,14 @@ class PackedRegisterModel(PackedActorModel):
 
     def _init_register(self, client_count: int, server_count: int,
                        server_actor, server_width: int,
-                       net_capacity: int, max_sends: int) -> None:
+                       net_capacity: int, max_sends: int,
+                       ordered: bool = False,
+                       channel_depth: int = 4) -> None:
         """``server_actor`` is a factory ``(index) -> Actor`` (protocols
-        typically pass each server its peer list)."""
+        typically pass each server its peer list). ``ordered`` selects
+        the ordered network semantics (per-(src, dst) FIFO channels of
+        ``channel_depth``), the `check N ordered` CLI configuration of
+        the reference examples."""
         assert server_count <= 4, "accepts masks pack up to 4 servers"
         assert client_count <= 7, "last-completed codes pack up to 7 peers"
         super().__init__(cfg=self,
@@ -76,7 +81,9 @@ class PackedRegisterModel(PackedActorModel):
         for _ in range(client_count):
             self.actor(RegisterClient(put_count=1,
                                       server_count=server_count))
-        self.init_network(Network.new_unordered_nonduplicating())
+        self.channel_depth = channel_depth
+        self.init_network(Network.new_ordered() if ordered
+                          else Network.new_unordered_nonduplicating())
 
         def value_chosen(_model, state):
             for env in state.network.iter_deliverable():
@@ -258,7 +265,18 @@ class PackedRegisterModel(PackedActorModel):
 
     def packed_properties(self, words):
         import jax.numpy as jnp
-        # index 0 "linearizable" is host-evaluated: neutral True
+        # index 0 "linearizable" is host-evaluated: neutral True.
+        # "value chosen" scans DELIVERABLE envelopes (`network.rs:157-170`)
+        # — every distinct envelope for multisets, channel heads only for
+        # ordered networks, mirroring iter_deliverable
+        if self._net_ordered:
+            lens = words[self._net_off:self._net_off + self._n_chan]
+            heads = words[self._msgs_off:self._timer_off].reshape(
+                self._n_chan, self.channel_depth, self.msg_width)[:, 0, 0]
+            chosen = ((lens > 0)
+                      & ((heads >> 24) == T_GETOK)
+                      & ((heads & 0xF) != 0)).any()
+            return jnp.stack([jnp.bool_(True), chosen])
         slots = words[self._net_off:self._timer_off].reshape(
             self.net_capacity, self._sw)
         hdr, m0 = slots[:, 0], slots[:, 2]

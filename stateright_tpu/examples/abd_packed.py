@@ -53,9 +53,11 @@ class PackedAbd(PackedRegisterModel):
     """ABD with S replicas + C put-once register clients, packed."""
 
     def __init__(self, client_count: int, server_count: int = 2,
-                 net_capacity: int = 16):
+                 net_capacity: int = 16, ordered: bool = False,
+                 channel_depth: int = 4):
         self._init_register(
-            client_count, server_count,
+            client_count, server_count, ordered=ordered,
+            channel_depth=channel_depth,
             server_actor=lambda i: AbdActor(
                 [Id(j) for j in range(server_count) if j != i]),
             server_width=2 + server_count,
@@ -64,7 +66,7 @@ class PackedAbd(PackedRegisterModel):
 
     def cache_key(self):
         return ("abd", self.client_count, self.server_count,
-                self.net_capacity)
+                self.net_capacity, self._net_ordered, self.channel_depth)
 
     # ------------------------------------------------------------------
     # server state packing
@@ -291,18 +293,23 @@ def main(argv=None) -> None:
     args = list(sys.argv[1:] if argv is None else argv)
     cmd = args[0] if args else None
     client_count = int(args[1]) if len(args) > 1 else 2
+    ordered = len(args) > 2 and args[2] == "ordered"
+    kw = dict(ordered=True, channel_depth=8) if ordered else {}
+    net = "ordered" if ordered else "unordered"
     if cmd == "check-tpu":
         print(f"Model checking packed ABD with {client_count} clients "
-              "on the TPU engine.")
-        PackedAbd(client_count).checker().spawn_tpu().report(sys.stdout)
+              f"({net} network) on the TPU engine.")
+        PackedAbd(client_count, **kw).checker().spawn_tpu() \
+            .report(sys.stdout)
     elif cmd == "check":
         print(f"Model checking packed ABD with {client_count} clients "
-              "on the host engine.")
-        PackedAbd(client_count).checker().spawn_bfs().report(sys.stdout)
+              f"({net} network) on the host engine.")
+        PackedAbd(client_count, **kw).checker().spawn_bfs() \
+            .report(sys.stdout)
     else:
         print("USAGE:")
         print("  python -m stateright_tpu.examples.abd_packed "
-              "check[-tpu] [CLIENT_COUNT]")
+              "check[-tpu] [CLIENT_COUNT] [ordered]")
 
 
 if __name__ == "__main__":

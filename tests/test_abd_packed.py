@@ -60,3 +60,38 @@ class TestPackedAbdOnDevice:
                .tpu_options(capacity=1 << 13).spawn_tpu().join())
         assert dev.unique_state_count() == host.unique_state_count()
         dev.assert_properties()
+
+
+class TestOrderedOnDevice:
+    """The ordered network semantics (per-(src, dst) FIFO channels) on the
+    TPU engine — the reference's `check N ordered` CLI configuration
+    (`linearizable-register.rs`, `network.rs:157-170`: ordered networks
+    expose only channel heads)."""
+
+    def test_contract_full_space(self):
+        from stateright_tpu.models.packed import validate_packed_model
+
+        assert validate_packed_model(
+            PackedAbd(2, server_count=2, ordered=True),
+            max_states=600) == 564
+
+    def test_device_matches_host(self):
+        host = (PackedAbd(2, server_count=2, ordered=True).checker()
+                .spawn_bfs().join())
+        dev = (PackedAbd(2, server_count=2, ordered=True).checker()
+               .tpu_options(capacity=1 << 12).spawn_tpu().join())
+        assert host.unique_state_count() == 564
+        assert dev.unique_state_count() == 564
+        assert (dev.generated_fingerprints()
+                == host.generated_fingerprints())
+        dev.assert_properties()
+
+    def test_channel_overflow_is_loud(self):
+        import pytest
+
+        # 2+3 ordered overflows depth-4 channels within 100k states; the
+        # engine must hard-error, never silently under-explore
+        with pytest.raises(RuntimeError, match="capacity overflow"):
+            (PackedAbd(2, server_count=3, ordered=True, channel_depth=4)
+             .checker().tpu_options(capacity=1 << 18)
+             .target_state_count(100_000).spawn_tpu().join())
