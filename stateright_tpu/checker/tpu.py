@@ -501,9 +501,22 @@ class TpuChecker(HostChecker):
             carry = seed_carry(
                 model, qcap, self._capacity, init_rows, seed_ebits,
                 symmetry=self._symmetry or self._sound)
-            key_hi, key_lo, seed_ovf = self._bulk_insert_async(
-                insert_fn, carry.key_hi, carry.key_lo,
-                list(generated.keys()))
+            # the table is empty, so small seeds (the fresh-run case) are
+            # placed by a host plan + ONE scatter — a standalone
+            # table_insert dispatch (a data-dependent while_loop program)
+            # costs ~0.2 s on a tunneled device even for a handful of
+            # keys. Large seeds (checkpoint resume mirrors the whole
+            # reached set) keep the chunked device insert: the host
+            # plan's per-fingerprint Python loop would be the slow path
+            # there.
+            seed_keys = list(generated.keys())
+            if len(seed_keys) <= (1 << 15):
+                key_hi, key_lo = self._seed_table_scatter(
+                    carry.key_hi, carry.key_lo, seed_keys)
+                seed_ovf = None  # plan_insert_host raises on overflow
+            else:
+                key_hi, key_lo, seed_ovf = self._bulk_insert_async(
+                    insert_fn, carry.key_hi, carry.key_lo, seed_keys)
             carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
             jax.block_until_ready(carry)
         chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
@@ -1027,6 +1040,34 @@ class TpuChecker(HostChecker):
                 discoveries[prop.name] = fp
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
+
+    _SCATTER_JIT = None
+
+    def _seed_table_scatter(self, key_hi, key_lo, fps: List[int]):
+        """Insert seed fingerprints into the (empty) table via a
+        host-computed placement plan and one device scatter."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.hashtable import plan_insert_host
+
+        if not fps:
+            return key_hi, key_lo
+        if TpuChecker._SCATTER_JIT is None:
+            def scatter(khi, klo, idx, hi, lo):
+                return (khi.at[idx].set(hi, mode="drop"),
+                        klo.at[idx].set(lo, mode="drop"))
+            TpuChecker._SCATTER_JIT = jax.jit(scatter)
+        plan = plan_insert_host(fps, self._capacity)
+        n = _bucket(len(fps))
+        arr = np.zeros((n,), np.uint64)
+        arr[:len(fps)] = np.asarray(fps, np.uint64)
+        idx = np.full((n,), self._capacity, np.int64)  # oob rows dropped
+        idx[:len(fps)] = np.where(plan >= 0, plan, self._capacity)
+        return TpuChecker._SCATTER_JIT(
+            key_hi, key_lo, jnp.asarray(idx.astype(np.int32)),
+            jnp.asarray((arr >> np.uint64(32)).astype(np.uint32)),
+            jnp.asarray(arr.astype(np.uint32)))
 
     def _bulk_insert_async(self, insert_fn, key_hi, key_lo,
                            fps: List[int]):

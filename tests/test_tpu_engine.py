@@ -405,3 +405,31 @@ def test_posthoc_incremental_growth_paths():
           .spawn_tpu().join())
     assert ck.unique_state_count() == 93
     ck.assert_properties()
+
+
+def test_plan_insert_host_matches_device_probe():
+    # the host placement plan and the device probe implement the same
+    # invariant INDEPENDENTLY; every planned key must read as
+    # already-present to the device, or seeded states would silently be
+    # re-explored
+    from stateright_tpu.ops.hashtable import plan_insert_host
+
+    rng = np.random.default_rng(11)
+    fps = [int(f) for f in
+           rng.integers(1, 2**63, size=300, dtype=np.uint64)]
+    fps += fps[:20]  # duplicates plan to -1
+    plan = plan_insert_host(fps, 512)
+    assert (plan[-20:] == -1).all()
+    khi = np.zeros(512, np.uint32)
+    klo = np.zeros(512, np.uint32)
+    for fp, i in zip(fps, plan):
+        if i >= 0:
+            khi[i] = fp >> 32
+            klo[i] = fp & 0xFFFFFFFF
+    hi = jnp.asarray(np.array([f >> 32 for f in fps], np.uint32))
+    lo = jnp.asarray(np.array([f & 0xFFFFFFFF for f in fps], np.uint32))
+    inserted, _, _, ovf = table_insert(
+        jnp.asarray(khi), jnp.asarray(klo), hi, lo,
+        jnp.ones(len(fps), bool))
+    assert not bool(ovf)
+    assert int(np.asarray(inserted).sum()) == 0
