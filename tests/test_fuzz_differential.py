@@ -123,3 +123,53 @@ class TestDeviceFuzz:
         if d is not None:
             states = d.into_states()
             assert not any(s % 2 == 1 for s in states)
+
+
+@pytest.mark.slow
+class TestPackedActorFuzz:
+    """Random configurations of the packed actor fixtures through the
+    full host/device contract validator — every reachable state's
+    successor set, property bits, and fingerprint must agree bit-for-bit
+    across (network semantics x lossiness x timers x sizes)."""
+
+    @pytest.fixture(autouse=True)
+    def _jax(self):
+        pytest.importorskip("jax")
+
+    @pytest.mark.parametrize("max_nat,lossy,duplicating", [
+        (2, False, True), (2, True, False), (3, True, True),
+        (4, False, False),
+    ])
+    def test_ping_pong_grid(self, max_nat, lossy, duplicating):
+        from stateright_tpu.actor.test_util import PackedPingPong
+        from stateright_tpu.models.packed import validate_packed_model
+
+        validate_packed_model(
+            PackedPingPong(max_nat, lossy=lossy, duplicating=duplicating),
+            max_states=3000)
+
+    @pytest.mark.parametrize("n,mx", [(1, 4), (2, 2), (3, 3)])
+    def test_timer_grid(self, n, mx):
+        from stateright_tpu.actor.test_util import PackedTimerCount
+        from stateright_tpu.models.packed import validate_packed_model
+
+        assert validate_packed_model(
+            PackedTimerCount(n, mx), max_states=300) == (mx + 1) ** n
+
+    @pytest.mark.parametrize("clients,servers", [(1, 2), (2, 3)])
+    def test_abd_ordered_grid(self, clients, servers):
+        from stateright_tpu.examples.abd_packed import PackedAbd
+        from stateright_tpu.models.packed import validate_packed_model
+
+        validate_packed_model(
+            PackedAbd(clients, server_count=servers, ordered=True,
+                      channel_depth=8),
+            max_states=800)
+
+    @pytest.mark.parametrize("clients,servers", [(1, 3), (2, 2)])
+    def test_paxos_grid(self, clients, servers):
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+        from stateright_tpu.models.packed import validate_packed_model
+
+        validate_packed_model(
+            PackedPaxos(clients, server_count=servers), max_states=800)
