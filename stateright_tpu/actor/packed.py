@@ -213,7 +213,10 @@ class PackedActorModel(ActorModel, PackedModel):
         for i, actor_state in enumerate(state.actor_states):
             off = self._actor_off[i]
             words = self.encode_actor(i, actor_state)
-            assert len(words) == self.actor_widths[i]
+            if len(words) != self.actor_widths[i]:
+                raise ValueError(
+                    f"encode_actor({i}) returned {len(words)} words; the "
+                    f"declared actor width is {self.actor_widths[i]}")
             out[off:off + len(words)] = words
         network = state.network
         if self._net_ordered:
@@ -230,8 +233,11 @@ class PackedActorModel(ActorModel, PackedModel):
                         f"actor index >= {a}; out-of-range recipients "
                         "are not encodable on the device")
                 c = int(src) * a + int(dst)
-                assert len(msgs) <= d, \
-                    f"channel ({src}, {dst}) exceeds channel_depth={d}"
+                if len(msgs) > d:
+                    raise ValueError(
+                        f"channel ({src}, {dst}) holds {len(msgs)} "
+                        f"messages, exceeding channel_depth={d}; raise "
+                        "channel_depth to encode this state")
                 out[self._net_off + c] = len(msgs)
                 for j, msg in enumerate(msgs):
                     off = self._msgs_off + (c * d + j) * mw
@@ -252,9 +258,11 @@ class PackedActorModel(ActorModel, PackedModel):
                 hdr = _OCC | (int(env.src) << 8) | int(env.dst)
                 slots.append(tuple([hdr, count]
                                    + self.encode_msg(env.msg)))
-            assert len(slots) <= self.net_capacity, \
-                f"network exceeds net_capacity={self.net_capacity}: " \
-                f"{len(slots)} distinct envelopes"
+            if len(slots) > self.net_capacity:
+                raise ValueError(
+                    f"network exceeds net_capacity={self.net_capacity}: "
+                    f"{len(slots)} distinct envelopes; raise net_capacity "
+                    "to encode this state")
             slots.sort(key=self._slot_sort_key)
             for e, slot in enumerate(slots):
                 off = self._net_off + e * self._sw
@@ -381,11 +389,16 @@ class PackedActorModel(ActorModel, PackedModel):
             [jnp.stack([hdr, jnp.uint32(1)]), msg])
         down = jnp.concatenate([jnp.zeros_like(slots[:1]), slots[:-1]],
                                axis=0)
-        do_ins = valid & ~has_match & has_empty
+        # dst rides an 8-bit hdr field; a recipient >= 256 would bleed
+        # into the src bits and alias a different envelope — report it as
+        # encoding overflow instead (recipients in [n_actors, 256) are
+        # fine: like the host network, the envelope sits undeliverable)
+        oob = dst >= jnp.uint32(256)
+        do_ins = valid & ~has_match & has_empty & ~oob
         slots = jnp.where((do_ins & (idx > pos))[:, None], down, slots)
         slots = jnp.where((do_ins & (idx == pos))[:, None],
                           new_slot[None, :], slots)
-        overflowed = valid & ~has_match & ~has_empty
+        overflowed = valid & ((~has_match & ~has_empty) | oob)
         return slots, overflowed
 
     def validate_device_state(self, state: ActorModelState) -> None:
@@ -438,8 +451,10 @@ class PackedActorModel(ActorModel, PackedModel):
             csel = jnp.arange(n_chan, dtype=jnp.uint32) == cd
             pos = jnp.where(csel, lens, 0).sum()
             # a send to an out-of-range recipient has no channel: report
-            # it as encoding overflow rather than silently dropping it
-            ovf = svalid & ((pos >= d) | (cd >= n_chan))
+            # it as encoding overflow rather than silently dropping it.
+            # Guard on sdst itself — for sender < n_actors-1 the flat
+            # index cd stays < n_chan and would alias a real channel.
+            ovf = svalid & ((pos >= d) | (sdst >= n_actors))
             esel = csel[:, None] & (jnp.arange(d, dtype=jnp.uint32)
                                     == jnp.minimum(pos, d - 1))[None, :]
             write = esel[:, :, None] & svalid & ~ovf

@@ -95,3 +95,73 @@ class TestOrderedOnDevice:
             (PackedAbd(2, server_count=3, ordered=True, channel_depth=4)
              .checker().tpu_options(capacity=1 << 18)
              .target_state_count(100_000).spawn_tpu().join())
+
+    def test_out_of_range_recipient_is_loud(self):
+        # Regression: a send to sdst >= n_actors from a non-last sender
+        # has a flat index cd = sender*A + sdst < n_chan, which used to
+        # alias into a real channel (e.g. A=3, sender=0, sdst=4 lands in
+        # channel (1,1)) and silently corrupt exploration. It must be
+        # reported as encoding overflow like any other unencodable send.
+        import pytest
+
+        from stateright_tpu.actor.core import Actor, Id, Out
+        from stateright_tpu.actor.network import Network
+        from stateright_tpu.actor.packed import PackedActorModel
+        from stateright_tpu.core import Expectation
+
+        class Misaddressing(Actor):
+            def on_start(self, id, o: Out):
+                if int(id) == 0:
+                    o.send(Id(0), 1)  # seed channel (0, 0)
+                return 0
+
+            def on_msg(self, id, state, src, msg, o: Out):
+                o.send(Id(4), 2)  # recipient does not exist
+                return state + 1
+
+        class BadModel(PackedActorModel):
+            def __init__(self):
+                super().__init__(cfg=self, init_history=None)
+                for _ in range(3):
+                    self.actor(Misaddressing())
+                self.init_network(Network.new_ordered())
+                self.property(Expectation.ALWAYS, "true",
+                              lambda m, s: True)
+                self.actor_widths = [1, 1, 1]
+                self.msg_width = 1
+                self.net_capacity = 4
+                self.max_sends = 1
+                self.history_width = 0
+                self.finalize_layout()
+
+            def cache_key(self):
+                return ("bad_recipient_ordered",)
+
+            def encode_actor(self, index, state):
+                return [int(state)]
+
+            def decode_actor(self, index, words):
+                return int(words[0])
+
+            def encode_msg(self, msg):
+                return [int(msg)]
+
+            def decode_msg(self, words):
+                return int(words[0])
+
+            def packed_deliver(self, actors, src, dst, msg):
+                import jax.numpy as jnp
+                sel = jnp.arange(3, dtype=jnp.uint32) == dst
+                new_actors = jnp.where(sel, actors + 1, actors) \
+                    .astype(jnp.uint32)
+                send = (jnp.uint32(4), jnp.full((1,), 2, jnp.uint32),
+                        jnp.bool_(True))
+                return new_actors, jnp.bool_(True), [send]
+
+            def packed_properties(self, words):
+                import jax.numpy as jnp
+                return jnp.stack([jnp.bool_(True)])
+
+        with pytest.raises(RuntimeError, match="capacity overflow"):
+            (BadModel().checker().tpu_options(capacity=1 << 10)
+             .spawn_tpu().join())
