@@ -255,6 +255,11 @@ class PackedActorModel(ActorModel, PackedModel):
                     f"semantics; got {type(network).__name__}"
                 entries = [(env, 1) for env in network._set]
             for env, count in entries:
+                if int(env.src) >= 256 or int(env.dst) >= 256:
+                    raise ValueError(
+                        f"envelope ({env.src} -> {env.dst}) does not fit "
+                        "the 8-bit src/dst header fields; actor ids >= "
+                        "256 are not encodable on the device")
                 hdr = _OCC | (int(env.src) << 8) | int(env.dst)
                 slots.append(tuple([hdr, count]
                                    + self.encode_msg(env.msg)))

@@ -60,7 +60,7 @@ import jax.numpy as jnp
 
 from ..ops.expand import (discovery_candidates, eventually_indices,
                           expand_frontier)
-from ..ops.hash_kernel import fp64_node_device
+from ..ops.hash_kernel import fp64_device, fp64_node_device
 from ..ops.hashtable import table_insert
 
 
@@ -88,6 +88,21 @@ class ChunkCarry(NamedTuple):
     kovf: jax.Array     # bool[]   kmax candidate-buffer overflow (host
     #                              rebuilds with doubled kmax; no data loss)
     steps: jax.Array    # int32[]  remaining step budget for this chunk
+    # --- host-property history dedup (models with host_property_indices;
+    # 1-element dummies otherwise). The table dedups inserted states by
+    # their host-property key columns IN the loop body, so the host's
+    # per-chunk work shrinks from a standalone reduction dispatch (the
+    # ~0.2-0.3s while_loop dispatch floor, NOTES.md) to one small gather
+    # of the fresh representatives.
+    hkey_hi: jax.Array  # uint32[hcap | 1]  history-key table
+    hkey_lo: jax.Array  # uint32[hcap | 1]
+    hidx: jax.Array     # int32[logcap | 1] queue index of each distinct
+    #                                       key's first (representative) row
+    h_n: jax.Array      # int32[]  representatives logged so far
+    hovf: jax.Array     # bool[]   history-table probe overflow: the loop
+    #                              exits; the host grows hcap, re-seeds the
+    #                              table from hidx, and resumes (no loss —
+    #                              the iteration aborts before mutation)
 
 
 def shrink_indices(mask, k: int):
@@ -121,7 +136,8 @@ def model_cache_key(model):
 
 
 def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
-                   symmetry: bool = False, sound: bool = False):
+                   symmetry: bool = False, sound: bool = False,
+                   hcap: int = 0, n_init: int = 0):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit) ->
@@ -140,13 +156,14 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     (and already-compiled) chunk across instances of the same model config.
     """
     mkey = model_cache_key(model)
-    key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound)
+    key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound, hcap,
+           n_init)
     if mkey is not None:
         cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
-                         sound)
+                         sound, hcap, n_init)
     if mkey is not None:
         if len(_CHUNK_CACHE) >= _CACHE_LIMIT:
             _CHUNK_CACHE.clear()
@@ -155,7 +172,8 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 
 
 def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
-                    symmetry: bool, sound: bool = False):
+                    symmetry: bool, sound: bool = False, hcap: int = 0,
+                    n_init: int = 0):
     n_actions = model.max_actions
     properties = model.properties()
     prop_count = len(properties)
@@ -167,6 +185,17 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     device_prop_idx = [i for i in range(prop_count) if i not in host_idx]
     fa = fmax * n_actions
     kmax = min(kmax, fa)
+    # in-loop history-key dedup for host-evaluated properties
+    hist_on = hcap > 0
+    if hist_on:
+        cols = getattr(model, "host_property_cols", None)
+        hoff, hwidth = cols if cols is not None \
+            else (0, model.packed_width)
+        # a full-of-foreign probe advances one bucket per round, so the
+        # scan is bounded by the bucket count; claim-loser retries add a
+        # small constant. Hitting the bound reports hovf (the growth
+        # signal) instead of spinning out the default 4096 rounds.
+        h_rounds = min(4096, hcap + 64)
     # thin BFS levels (a few hundred pending states) are common at the
     # start and tail of every search, and for narrow models they dominate
     # the iteration count; paying the full fmax*max_actions lane width for
@@ -179,7 +208,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     def cond(state):
         c, target_remaining, grow_limit = state
         go = (c.q_tail > c.q_head) & (c.steps > 0) \
-            & ~c.ovf & ~c.xovf & ~c.kovf \
+            & ~c.ovf & ~c.xovf & ~c.kovf & ~c.hovf \
             & (c.gen < target_remaining) \
             & (c.log_n < grow_limit) \
             & (c.q_tail <= qcap - kmax)
@@ -252,40 +281,74 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 n_clo = k_clo[src2]
                 n_phi = k_phi[src2]
                 n_plo = k_plo[src2]
-                q_rows = jax.lax.dynamic_update_slice(
-                    c.q_rows, n_flat, (c.q_tail, 0))
-                q_eb = jax.lax.dynamic_update_slice(
-                    c.q_eb, n_eb, (c.q_tail,))
-                log_chi = jax.lax.dynamic_update_slice(
-                    c.log_chi, n_chi, (c.log_n,))
-                log_clo = jax.lax.dynamic_update_slice(
-                    c.log_clo, n_clo, (c.log_n,))
-                log_phi = jax.lax.dynamic_update_slice(
-                    c.log_phi, n_phi, (c.log_n,))
-                log_plo = jax.lax.dynamic_update_slice(
-                    c.log_plo, n_plo, (c.log_n,))
-                log_ohi, log_olo = c.log_ohi, c.log_olo
-                if symmetry or sound:
-                    # the replayable STATE fingerprint per logged node
-                    # (exp.ohi aliases the state fp without symmetry)
-                    k_ohi = exp.ohi[src]
-                    k_olo = exp.olo[src]
-                    log_ohi = jax.lax.dynamic_update_slice(
-                        log_ohi, k_ohi[src2], (c.log_n,))
-                    log_olo = jax.lax.dynamic_update_slice(
-                        log_olo, k_olo[src2], (c.log_n,))
-                return c._replace(
-                    q_rows=q_rows, q_eb=q_eb,
-                    q_head=c.q_head + take,
-                    q_tail=c.q_tail + cnt,
-                    key_hi=key_hi, key_lo=key_lo,
-                    log_chi=log_chi, log_clo=log_clo,
-                    log_phi=log_phi, log_plo=log_plo,
-                    log_ohi=log_ohi, log_olo=log_olo,
-                    log_n=c.log_n + cnt,
-                    gen=c.gen + vcount,
-                    ovf=c.ovf | t_ovf,
-                    xovf=c.xovf | exp.xovf)
+
+                if hist_on:
+                    # dedup the fresh rows by host-property key against
+                    # the persistent history table; the queue index of
+                    # each NEW key's first row is logged for the host's
+                    # post-chunk pull. Garbage lanes (>= cnt) are masked.
+                    hhi, hlo = fp64_device(
+                        n_flat[:, hoff:hoff + hwidth])
+                    hval = jnp.arange(kmax_b, dtype=jnp.int32) < cnt
+                    h_ins, hkey_hi, hkey_lo, h_ovf = table_insert(
+                        c.hkey_hi, c.hkey_lo, hhi, hlo, hval,
+                        max_rounds=h_rounds)
+                else:
+                    h_ovf = jnp.bool_(False)
+
+                def append(c):
+                    q_rows = jax.lax.dynamic_update_slice(
+                        c.q_rows, n_flat, (c.q_tail, 0))
+                    q_eb = jax.lax.dynamic_update_slice(
+                        c.q_eb, n_eb, (c.q_tail,))
+                    log_chi = jax.lax.dynamic_update_slice(
+                        c.log_chi, n_chi, (c.log_n,))
+                    log_clo = jax.lax.dynamic_update_slice(
+                        c.log_clo, n_clo, (c.log_n,))
+                    log_phi = jax.lax.dynamic_update_slice(
+                        c.log_phi, n_phi, (c.log_n,))
+                    log_plo = jax.lax.dynamic_update_slice(
+                        c.log_plo, n_plo, (c.log_n,))
+                    log_ohi, log_olo = c.log_ohi, c.log_olo
+                    if symmetry or sound:
+                        # the replayable STATE fingerprint per logged node
+                        # (exp.ohi aliases the state fp without symmetry)
+                        k_ohi = exp.ohi[src]
+                        k_olo = exp.olo[src]
+                        log_ohi = jax.lax.dynamic_update_slice(
+                            log_ohi, k_ohi[src2], (c.log_n,))
+                        log_olo = jax.lax.dynamic_update_slice(
+                            log_olo, k_olo[src2], (c.log_n,))
+                    hkh, hkl, hidx, h_n = (c.hkey_hi, c.hkey_lo,
+                                           c.hidx, c.h_n)
+                    if hist_on:
+                        hsrc = shrink_indices(h_ins, kmax_b)
+                        hcnt = h_ins.sum(dtype=jnp.int32)
+                        hidx = jax.lax.dynamic_update_slice(
+                            c.hidx, (c.q_tail + hsrc).astype(jnp.int32),
+                            (c.h_n,))
+                        hkh, hkl, h_n = hkey_hi, hkey_lo, c.h_n + hcnt
+                    return c._replace(
+                        q_rows=q_rows, q_eb=q_eb,
+                        q_head=c.q_head + take,
+                        q_tail=c.q_tail + cnt,
+                        key_hi=key_hi, key_lo=key_lo,
+                        log_chi=log_chi, log_clo=log_clo,
+                        log_phi=log_phi, log_plo=log_plo,
+                        log_ohi=log_ohi, log_olo=log_olo,
+                        log_n=c.log_n + cnt,
+                        hkey_hi=hkh, hkey_lo=hkl, hidx=hidx, h_n=h_n,
+                        gen=c.gen + vcount,
+                        ovf=c.ovf | t_ovf,
+                        xovf=c.xovf | exp.xovf)
+
+                # hovf: abort BEFORE any mutation (like kovf) — the host
+                # grows the history table, re-seeds it from hidx, and the
+                # resumed chunk re-expands this same frontier segment
+                return jax.lax.cond(
+                    h_ovf,
+                    lambda c: c._replace(hovf=jnp.bool_(True)),
+                    append, c)
 
             # kovf: abort BEFORE any mutation; the host doubles kmax and
             # the rebuilt chunk re-expands the same frontier
@@ -311,18 +374,39 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             return (step_large(state), state[1], state[2])
 
     def chunk(carry: ChunkCarry, target_remaining, grow_limit):
+        # the window anchor is the entry h_n: the engine maintains the
+        # invariant that everything logged before this chunk has been
+        # host-evaluated (window or fallback pull) before the next launch
+        h0 = carry.h_n
         out, _, _ = jax.lax.while_loop(
             cond, body, (carry, target_remaining, grow_limit))
-        return out
+        if not hist_on:
+            z = jnp.zeros((1,), jnp.uint32)
+            return out, jnp.zeros((1, 1), jnp.uint32), z, z
+        # window over the representatives logged this chunk: rides the
+        # host's per-chunk sync, so the common case (few fresh distinct
+        # histories) needs NO standalone pull dispatch. Overflow beyond
+        # HIST_WINDOW falls back to TpuChecker._pull_host_reps.
+        sel = out.hidx[jnp.minimum(h0 + jnp.arange(HIST_WINDOW),
+                                   out.hidx.shape[0] - 1)]
+        rows = out.q_rows[jnp.minimum(sel, out.q_rows.shape[0] - 1)]
+        li = jnp.clip(sel - n_init, 0, out.log_chi.shape[0] - 1)
+        return out, rows, out.log_chi[li], out.log_clo[li]
 
     return jax.jit(chunk, donate_argnums=(0,))
+
+
+#: representatives returned inline with each chunk's sync; beyond this the
+#: host issues a standalone pull for the remainder (rare — distinct
+#: host-property keys grow far slower than states)
+HIST_WINDOW = 256
 
 
 _SEED_CACHE: dict = {}
 
 
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
-               steps: int = 0, symmetry: bool = False):
+               steps: int = 0, symmetry: bool = False, hcap: int = 0):
     """Host-side construction of the initial carry (init states enqueued;
     the caller bulk-inserts their fingerprints into the table).
     ``full_ebits`` is a scalar for fresh runs or a per-row array when
@@ -341,7 +425,7 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     width = model.packed_width
     prop_count = len(model.properties())
     k = len(init_rows)
-    key = (qcap, capacity, width, prop_count, symmetry, k)
+    key = (qcap, capacity, width, prop_count, symmetry, k, hcap)
     fn = _SEED_CACHE.get(key)
     if fn is None:
         logcap = capacity
@@ -372,7 +456,11 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 disc_lo=jnp.zeros((prop_count,), jnp.uint32),
                 gen=jnp.int32(0), ovf=jnp.bool_(False),
                 xovf=jnp.bool_(False), kovf=jnp.bool_(False),
-                steps=steps_s)
+                steps=steps_s,
+                hkey_hi=jnp.zeros((hcap if hcap else 1,), jnp.uint32),
+                hkey_lo=jnp.zeros((hcap if hcap else 1,), jnp.uint32),
+                hidx=jnp.zeros((logcap if hcap else 1,), jnp.int32),
+                h_n=jnp.int32(0), hovf=jnp.bool_(False))
 
         fn = jax.jit(build)
         if len(_SEED_CACHE) >= _CACHE_LIMIT:

@@ -303,13 +303,12 @@ class TpuChecker(HostChecker):
                 raise NotImplementedError(
                     "sound_eventually() with symmetry reduction is not "
                     "supported on the TPU engine; use spawn_dfs")
-        # incremental post-hoc reduction state (device engine): the
-        # history-key dedup table persists across chunks and only queue
-        # rows appended since the last pass are reduced
-        self._posthoc_table = None
-        self._posthoc_start = 0
-        self._posthoc_hmax = int(opts.get("hmax", 1 << 14))
+        # host-property history dedup (device engine): the history-key
+        # table rides IN the chunk carry (device_loop.ChunkCarry.hkey_*);
+        # hcap is its capacity, grown on occupancy pressure or hovf.
+        # (The 'hmax' option is read by the sharded engine only.)
         self._posthoc_cap = int(opts.get("hcap", 1 << 16))
+        self._h_pulled = 0  # representatives already host-evaluated
         # wall-time per engine phase (seconds), for report()/bench tuning
         self._prof: Dict[str, float] = {}
         # device-resident search record, pulled lazily by _ensure_mirror
@@ -477,6 +476,16 @@ class TpuChecker(HostChecker):
         # everything known at seed time must be re-inserted on growth (the
         # device log only records states found since)
         self._base_fps = list(generated.keys())
+        if self._host_props and self._resume_path is None:
+            # seed rows never enter the in-loop history log (only fresh
+            # inserts do); evaluate them host-side once, like the
+            # reference evaluates properties on every popped state. A
+            # resumed frontier needs no pass: every pre-checkpoint state
+            # was already evaluated and its discoveries ride the
+            # checkpoint metadata.
+            for row, fp in zip(init_rows, seed_fps):
+                self._eval_host_props_row(np.asarray(row), fp,
+                                          discoveries)
         if prop_count == 0:
             # nothing to search for: mirror the reference's immediate stop
             # once discoveries (vacuously) cover all properties
@@ -493,6 +502,7 @@ class TpuChecker(HostChecker):
         # append-only queue: must hold every state enqueued before the next
         # growth point (n_init + grow_limit) plus one iteration of appends
         qcap = self._device_qcap(n_init, headroom)
+        hcap = self._posthoc_cap if self._host_props else 0
         with self._timed("seed"):
             # the block before the first chunk launch is deliberate:
             # launching the chunk (which donates the carry) while the
@@ -500,7 +510,7 @@ class TpuChecker(HostChecker):
             # slow the whole chunk loop ~2.5x on the tunneled device
             carry = seed_carry(
                 model, qcap, self._capacity, init_rows, seed_ebits,
-                symmetry=self._symmetry or self._sound)
+                symmetry=self._symmetry or self._sound, hcap=hcap)
             # the table is empty, so small seeds (the fresh-run case) are
             # placed by a host plan + ONE scatter — a standalone
             # table_insert dispatch (a data-dependent while_loop program)
@@ -521,7 +531,8 @@ class TpuChecker(HostChecker):
             jax.block_until_ready(carry)
         chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
                                   kmax, symmetry=self._symmetry,
-                                  sound=self._sound)
+                                  sound=self._sound, hcap=hcap,
+                                  n_init=n_init)
 
         # --- chunk loop -------------------------------------------------
         while True:
@@ -533,13 +544,35 @@ class TpuChecker(HostChecker):
                 if target is not None else 2**31 - 1)
             carry = carry._replace(gen=jnp.int32(0),
                                    steps=jnp.int32(k_steps))
+            want_reps = self._host_props and any(
+                p.name not in discoveries for _i, p in self._host_props)
+            if hcap and not want_reps:
+                # every host property has its discovery: the in-loop
+                # history dedup is dead work now (and, saturated, would
+                # stall the loop via hovf) — rebuild without it
+                hcap = 0
+                chunk_fn = build_chunk_fn(model, qcap, self._capacity,
+                                          fmax, kmax,
+                                          symmetry=self._symmetry,
+                                          sound=self._sound, hcap=0,
+                                          n_init=n_init)
             with self._timed("chunk"):
-                carry = chunk_fn(carry, remaining, grow_limit)
-                (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo, gen,
-                 ovf, xovf, kovf) = jax.device_get(
-                    (carry.q_head, carry.q_tail, carry.log_n,
-                     carry.disc_hit, carry.disc_hi, carry.disc_lo,
-                     carry.gen, carry.ovf, carry.xovf, carry.kovf))
+                carry, hrows_d, hwhi_d, hwlo_d = chunk_fn(
+                    carry, remaining, grow_limit)
+                scalars = (carry.q_head, carry.q_tail, carry.log_n,
+                           carry.disc_hit, carry.disc_hi, carry.disc_lo,
+                           carry.gen, carry.ovf, carry.xovf, carry.kovf,
+                           carry.h_n, carry.hovf)
+                if want_reps:
+                    # the representative window rides the same sync
+                    (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo,
+                     gen, ovf, xovf, kovf, h_n, hovf, hrows, hwhi,
+                     hwlo) = jax.device_get(
+                        scalars + (hrows_d, hwhi_d, hwlo_d))
+                else:
+                    (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo,
+                     gen, ovf, xovf, kovf, h_n,
+                     hovf) = jax.device_get(scalars)
             q_size = int(q_tail) - int(q_head)
             self._prof["chunks"] = self._prof.get("chunks", 0) + 1
             self._state_count += int(gen)
@@ -563,6 +596,52 @@ class TpuChecker(HostChecker):
                     "device hash table probe overflow below the growth "
                     f"limit (capacity {self._capacity}); raise via "
                     "checker_builder.tpu_options(capacity=...)")
+            if want_reps:
+                # host properties are evaluated on the distinct-history
+                # representatives the chunk loop logged (memoized per
+                # key), so a shallow host counterexample still exits
+                # early instead of waiting for full exhaustion. This runs
+                # BEFORE any retry `continue`: the chunk's window is
+                # anchored at its entry h_n, so every logged
+                # representative must be consumed before the next launch.
+                from .device_loop import HIST_WINDOW
+                with self._timed("posthoc"):
+                    fresh = int(h_n) - self._h_pulled
+                    wfp = _combine64(hwhi, hwlo)
+                    for j in range(min(fresh, HIST_WINDOW)):
+                        if all(p.name in discoveries
+                               for _i, p in self._host_props):
+                            break
+                        self._eval_host_props_row(hrows[j], int(wfp[j]),
+                                                  discoveries)
+                    self._h_pulled += min(fresh, HIST_WINDOW)
+                    if fresh > HIST_WINDOW:
+                        # more fresh keys than the inline window: pull
+                        # the remainder with a standalone gather
+                        self._pull_host_reps(carry, int(h_n), n_init,
+                                             discoveries)
+                if bool(hovf) or int(h_n) >= self._grow_at * hcap:
+                    # grow the history-key table: proactively at the same
+                    # occupancy threshold as the fingerprint table (a
+                    # near-full open table crawls through thousands of
+                    # probe rounds per insert), or reactively on hovf
+                    # (the aborted iteration mutated nothing). Re-seed
+                    # from the logged representatives and resume.
+                    new_hcap = self._posthoc_cap
+                    while new_hcap * self._grow_at <= int(h_n):
+                        new_hcap *= 4
+                    if new_hcap == self._posthoc_cap:
+                        new_hcap *= 4  # hovf without occupancy pressure
+                    hcap = self._posthoc_cap = new_hcap
+                    with self._timed("hgrow"):
+                        carry = self._regrow_history_table(
+                            carry, int(h_n), hcap)
+                    chunk_fn = build_chunk_fn(
+                        model, qcap, self._capacity, fmax, kmax,
+                        symmetry=self._symmetry, sound=self._sound,
+                        hcap=hcap, n_init=n_init)
+                    if bool(hovf):
+                        continue
             if bool(kovf):
                 # a batch produced more valid children than the candidate
                 # buffer; nothing was committed — double kmax and resume
@@ -570,18 +649,10 @@ class TpuChecker(HostChecker):
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax,
                                           symmetry=self._symmetry,
-                                          sound=self._sound)
+                                          sound=self._sound, hcap=hcap,
+                                          n_init=n_init)
                 carry = carry._replace(kovf=jnp.bool_(False))
                 continue
-            if self._host_props and any(
-                    p.name not in discoveries for _i, p in self._host_props):
-                # evaluate host properties over the reached-so-far set each
-                # chunk (memoized per distinct key), so a shallow host
-                # counterexample still exits early instead of waiting for
-                # full exhaustion
-                with self._timed("posthoc"):
-                    self._posthoc_eval(carry, qcap, n_init, seed_fps,
-                                       discoveries, int(q_tail))
             done = (q_size == 0
                     or len(discoveries) == prop_count
                     or (target is not None
@@ -597,13 +668,9 @@ class TpuChecker(HostChecker):
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax,
                                           symmetry=self._symmetry,
-                                          sound=self._sound)
+                                          sound=self._sound, hcap=hcap,
+                                          n_init=n_init)
 
-        if self._host_props and any(
-                p.name not in discoveries for _i, p in self._host_props):
-            with self._timed("posthoc"):
-                self._posthoc_eval(carry, qcap, n_init, seed_fps,
-                                   discoveries, int(q_tail))
         if self._tpu_options.get("resumable"):
             # pull the pending frontier eagerly so save() needs no pinned
             # device buffers
@@ -652,10 +719,11 @@ class TpuChecker(HostChecker):
         new_qcap = self._device_qcap(n_init, headroom)
 
         symmetry = self._symmetry or self._sound
+        hist_on = carry.hidx.shape[0] > 1
 
         def rebuild(q_rows, q_eb, q_head, q_tail,
                     log_chi, log_clo, log_phi, log_plo,
-                    log_ohi, log_olo, log_n):
+                    log_ohi, log_olo, log_n, hidx):
             # copy the whole queue prefix into the larger buffer at the
             # same positions: the [0, tail) region doubles as the list of
             # every unique state's packed row (post-hoc property eval,
@@ -682,6 +750,11 @@ class TpuChecker(HostChecker):
                                                       (0,))
             else:
                 nl_ohi, nl_olo = log_ohi, log_olo
+            if hist_on:
+                nh_idx = jnp.zeros((self._capacity,), jnp.int32)
+                nh_idx = jax.lax.dynamic_update_slice(nh_idx, hidx, (0,))
+            else:
+                nh_idx = hidx
             # fresh table; re-insert every logged fingerprint
             key_hi = jnp.zeros((self._capacity,), jnp.uint32)
             key_lo = jnp.zeros((self._capacity,), jnp.uint32)
@@ -689,15 +762,16 @@ class TpuChecker(HostChecker):
             _, key_hi, key_lo, ovf = table_insert_local(
                 key_hi, key_lo, log_chi, log_clo, valid)
             return (nq_rows, nq_eb, key_hi, key_lo,
-                    nl_chi, nl_clo, nl_phi, nl_plo, nl_ohi, nl_olo, ovf)
+                    nl_chi, nl_clo, nl_phi, nl_plo, nl_ohi, nl_olo,
+                    nh_idx, ovf)
 
         rebuild = jax.jit(rebuild)
         (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo, nl_phi,
-         nl_plo, nl_ohi, nl_olo, ovf) = rebuild(
+         nl_plo, nl_ohi, nl_olo, nh_idx, ovf) = rebuild(
             carry.q_rows, carry.q_eb, carry.q_head,
             carry.q_tail, carry.log_chi, carry.log_clo,
             carry.log_phi, carry.log_plo, carry.log_ohi, carry.log_olo,
-            carry.log_n)
+            carry.log_n, carry.hidx)
         if bool(jax.device_get(ovf)):
             raise RuntimeError("overflow while re-inserting during growth")
         # fingerprints known at seed time (inits, or a resumed snapshot)
@@ -708,129 +782,94 @@ class TpuChecker(HostChecker):
             q_rows=nq_rows, q_eb=nq_eb,
             key_hi=key_hi, key_lo=key_lo,
             log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
-            log_plo=nl_plo, log_ohi=nl_ohi, log_olo=nl_olo)
+            log_plo=nl_plo, log_ohi=nl_ohi, log_olo=nl_olo,
+            hidx=nh_idx)
         return carry, new_qcap
 
     # ------------------------------------------------------------------
-    _POSTHOC_CACHE: dict = {}
+    _HPULL_JIT = None
 
-    def _posthoc_fn(self, rmax: int, capacity: int, hmax: int):
-        """Jitted device reduction for post-hoc host-property evaluation:
-        dedup a queue region ``[q_start, q_start + rmax)`` by host-property
-        columns against a PERSISTENT history-key table and emit one
-        representative row + witness fingerprint per newly seen key. The
-        device work is O(region), not O(queue): only the rows appended
-        since the last pass are sliced out, hashed, and probed."""
+    @classmethod
+    def _hpull_jit(cls):
+        """Process-wide jitted gather of fresh history representatives:
+        rows + witness fingerprints for ``hidx[start : start + bucket)``.
+        A pure gather program — it avoids the standalone-dispatch floor a
+        while_loop program (the old post-hoc reduction) paid per chunk."""
+        if cls._HPULL_JIT is None:
+            import jax
+            import jax.numpy as jnp
+
+            def fn(q_rows, hidx, log_chi, log_clo, start, n_init,
+                   bucket):
+                sel = hidx[jnp.minimum(start + jnp.arange(bucket),
+                                       hidx.shape[0] - 1)]
+                rows = q_rows[jnp.minimum(sel, q_rows.shape[0] - 1)]
+                # queue row i >= n_init is log entry i - n_init (queue
+                # and log append in lockstep); seed rows never appear in
+                # hidx (they are evaluated host-side at seed time)
+                li = jnp.clip(sel - n_init, 0, log_chi.shape[0] - 1)
+                return rows, log_chi[li], log_clo[li]
+
+            cls._HPULL_JIT = jax.jit(fn, static_argnums=(6,))
+        return cls._HPULL_JIT
+
+    def _pull_host_reps(self, carry, h_n: int, n_init: int,
+                        discoveries: Dict[str, int]) -> None:
+        """Host-evaluate the distinct-history representatives the chunk
+        loop logged since the last pull (memoized per key)."""
         import jax
         import jax.numpy as jnp
 
-        from .device_loop import model_cache_key, shrink_indices
+        if all(p.name in discoveries for _i, p in self._host_props):
+            return
+        start = self._h_pulled
+        if h_n <= start:
+            return
+        count = h_n - start
+        bucket = _bucket(count)
+        rows_d, whi_d, wlo_d = self._hpull_jit()(
+            carry.q_rows, carry.hidx, carry.log_chi, carry.log_clo,
+            jnp.int32(start), jnp.int32(n_init), bucket)
+        rows_h, whi_h, wlo_h = jax.device_get((rows_d, whi_d, wlo_d))
+        wfp = _combine64(whi_h, wlo_h)
+        for j in range(count):
+            if all(p.name in discoveries for _i, p in self._host_props):
+                break
+            self._eval_host_props_row(rows_h[j], int(wfp[j]), discoveries)
+        self._h_pulled = h_n
+
+    def _regrow_history_table(self, carry, h_n: int, hcap: int):
+        """Re-seed a larger history-key table from the logged
+        representatives' queue rows (one rare standalone dispatch)."""
+        import jax
+        import jax.numpy as jnp
+
         from ..ops.hash_kernel import fp64_device
         from ..ops.hashtable import table_insert
 
         model = self._model
-        width = model.packed_width
         cols = getattr(model, "host_property_cols", None)
-        off, hw = cols if cols is not None else (0, width)
-        mkey = model_cache_key(model)
-        ckey = (mkey, rmax, capacity, hmax)
-        if mkey is not None:
-            cached = self._POSTHOC_CACHE.get(ckey)
-            if cached is not None:
-                return cached
+        off, hw = cols if cols is not None else (0, model.packed_width)
 
-        def fn(q_rows, s0, q_off, q_len, log_chi, log_clo, n_init,
-               khi, klo):
-            # region [s0, s0 + rmax) with the live rows at
-            # [s0 + q_off, s0 + q_off + q_len); the caller guarantees
-            # s0 + rmax <= qcap so dynamic_slice never clamp-shifts
-            region = jax.lax.dynamic_slice(q_rows, (s0, 0),
-                                           (rmax, width))
-            hhi, hlo = fp64_device(region[:, off:off + hw])
-            idx = jnp.arange(rmax, dtype=jnp.int32)
-            valid = (idx >= q_off) & (idx < q_off + q_len)
-            inserted, khi, klo, ovf = table_insert(khi, klo, hhi, hlo,
-                                                   valid)
-            hcount = inserted.sum(dtype=jnp.int32)
-            src = shrink_indices(inserted, hmax)   # region-relative
-            out_rows = region[src]
-            src_abs = src + s0
-            # witness fp: queue row i >= n_init corresponds to log entry
-            # i - n_init (queue and log append in lockstep); init rows are
-            # resolved host-side from the seed order
-            li = jnp.maximum(src_abs - n_init, 0)
-            w_hi = log_chi[li]
-            w_lo = log_clo[li]
-            return out_rows, src_abs, w_hi, w_lo, hcount, ovf, khi, klo
+        def reseed(q_rows, hidx, n):
+            khi = jnp.zeros((hcap,), jnp.uint32)
+            klo = jnp.zeros((hcap,), jnp.uint32)
+            sel = jnp.minimum(hidx, q_rows.shape[0] - 1)
+            hhi, hlo = fp64_device(q_rows[sel][:, off:off + hw])
+            valid = jnp.arange(hidx.shape[0], dtype=jnp.int32) < n
+            _, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
+            return khi, klo, ovf
 
-        fn = jax.jit(fn, static_argnums=())
-        if mkey is not None:
-            if len(self._POSTHOC_CACHE) >= 64:
-                self._POSTHOC_CACHE.clear()
-            self._POSTHOC_CACHE[ckey] = fn
-        return fn
-
-    def _posthoc_eval(self, carry, qcap: int, n_init: int,
-                      init_fps: List[int],
-                      discoveries: Dict[str, int], q_tail: int) -> None:
-        """Evaluate host properties once per distinct host-property key
-        over the reached set (device dedup, host predicates). Incremental:
-        only queue rows appended since the last pass are reduced, against
-        the persistent key table — the common case for every chunk after
-        the first is near-zero device work."""
-        import jax
-        import jax.numpy as jnp
-
-        from ..ops.hashtable import make_table
-
-        if self._posthoc_start >= q_tail:
-            return
-        while True:
-            hmax = self._posthoc_hmax
-            if self._posthoc_table is None:
-                self._posthoc_table = make_table(self._posthoc_cap)
-                self._posthoc_start = 0
-            khi, klo = self._posthoc_table
-            start = self._posthoc_start
-            rmax = min(_bucket(q_tail - start), qcap)
-            s0 = min(start, qcap - rmax)
-            fn = self._posthoc_fn(rmax, self._posthoc_cap, hmax)
-            (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf_d,
-             khi, klo) = fn(
-                carry.q_rows, jnp.int32(s0), jnp.int32(start - s0),
-                jnp.int32(q_tail - start), carry.log_chi, carry.log_clo,
-                jnp.int32(n_init), khi, klo)
-            hcount, tovf = jax.device_get((hcount_d, tovf_d))
-            if bool(tovf):
-                # key table saturated: quadruple it and rescan from the
-                # start (reinsertion is idempotent; host eval is memoized)
-                self._posthoc_cap *= 4
-                self._posthoc_table = None
-                continue
-            if int(hcount) > hmax:
-                # more fresh keys than representative lanes: some keys are
-                # now in the table but their rows were dropped — grow hmax
-                # and rescan with a fresh table
-                self._posthoc_hmax = hmax * 2
-                self._posthoc_table = None
-                continue
-            self._posthoc_table = (khi, klo)
-            self._posthoc_start = q_tail
-            break
-        hcount = int(hcount)
-        if not hcount:
-            return
-        n = min(_bucket(hcount), hmax)
-        rows_h, src_h, whi_h, wlo_h = jax.device_get((
-            rows_d[:n], src_d[:n], whi_d[:n], wlo_d[:n]))
-        wfp = _combine64(whi_h, wlo_h)
-        for j in range(hcount):
-            if all(p.name in discoveries for _i, p in self._host_props):
-                break
-            src_j = int(src_h[j])
-            fp = (init_fps[src_j] if src_j < n_init
-                  else int(wfp[j]))
-            self._eval_host_props_row(rows_h[j], fp, discoveries)
+        bucket = min(_bucket(max(h_n, 1)), carry.hidx.shape[0])
+        khi, klo, ovf = jax.jit(reseed)(carry.q_rows,
+                                        carry.hidx[:bucket],
+                                        jnp.int32(h_n))
+        if bool(jax.device_get(ovf)):
+            raise RuntimeError(
+                "history-key table overflow while re-seeding after "
+                "growth; raise tpu_options(hcap=...)")
+        return carry._replace(hkey_hi=khi, hkey_lo=klo,
+                              hovf=jnp.bool_(False))
 
     def _ensure_mirror(self) -> None:
         """Pull the device-resident (child fp, parent fp) log — lazily, on

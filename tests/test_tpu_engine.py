@@ -389,19 +389,20 @@ def test_make_table_rejects_tiny_capacity():
 
 
 def test_posthoc_incremental_growth_paths():
-    # tiny hmax/hcap force every growth path of the incremental post-hoc
-    # reduction (hmax doubling + rescan, key-table quadrupling) while the
-    # verdicts must stay identical to the defaults
+    # a tiny hcap forces the in-carry history-key table through its
+    # growth protocol (occupancy-pressure growth and/or hovf
+    # abort-and-reseed, checker/tpu.py) while the verdicts must stay
+    # identical to the defaults
     from stateright_tpu.examples.single_copy_packed import PackedSingleCopy
 
     ck = (PackedSingleCopy(2, server_count=2).checker()
-          .tpu_options(capacity=1 << 12, hmax=1, hcap=4)
+          .tpu_options(capacity=1 << 12, hcap=4)
           .spawn_tpu().join())
     path = ck.assert_any_discovery("linearizable")
     assert path.last_state().history.serialized_history() is None
 
     ck = (PackedSingleCopy(2, server_count=1).checker()
-          .tpu_options(capacity=1 << 10, hmax=1, hcap=4)
+          .tpu_options(capacity=1 << 10, hcap=4)
           .spawn_tpu().join())
     assert ck.unique_state_count() == 93
     ck.assert_properties()
