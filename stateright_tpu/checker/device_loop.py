@@ -67,6 +67,10 @@ from ..ops.hashtable import table_insert
 class ChunkCarry(NamedTuple):
     q_rows: jax.Array   # uint32[qcap, W] append-only queue of pending states
     q_eb: jax.Array     # uint32[qcap]    their eventually-bits
+    q_fph: jax.Array    # uint32[qcap]    their STATE fingerprints, cached
+    q_fpl: jax.Array    #                 at insert time (canonical under
+    #                                     symmetry) so expansion never
+    #                                     re-hashes the frontier
     q_head: jax.Array   # int32[]         next row to expand
     q_tail: jax.Array   # int32[]         next free row (q_size = tail-head)
     key_hi: jax.Array   # uint32[cap]     visited table
@@ -88,6 +92,9 @@ class ChunkCarry(NamedTuple):
     kovf: jax.Array     # bool[]   kmax candidate-buffer overflow (host
     #                              rebuilds with doubled kmax; no data loss)
     steps: jax.Array    # int32[]  remaining step budget for this chunk
+    vmax: jax.Array     # int32[]  max valid children in one iteration
+    #                              this chunk — the host right-sizes kmax
+    #                              from it (gather cost scales with kmax)
     # --- host-property history dedup (models with host_property_indices;
     # 1-element dummies otherwise). The table dedups inserted states by
     # their host-property key columns IN the loop body, so the host's
@@ -225,12 +232,16 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             frontier = jax.lax.dynamic_slice(
                 c.q_rows, (c.q_head, 0), (fmax_b, c.q_rows.shape[1]))
             ebits = jax.lax.dynamic_slice(c.q_eb, (c.q_head,), (fmax_b,))
+            pfp = (jax.lax.dynamic_slice(c.q_fph, (c.q_head,), (fmax_b,)),
+                   jax.lax.dynamic_slice(c.q_fpl, (c.q_head,), (fmax_b,)))
             take = jnp.minimum(c.q_tail - c.q_head, fmax_b)
             fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
-            # the shared check_block analog (ops/expand.py)
+            # the shared check_block analog (ops/expand.py); the frontier
+            # fingerprints come from the queue cache, not a re-hash
             exp = expand_frontier(model, frontier, fvalid, ebits,
-                                  eventually_idx, symmetry=symmetry)
+                                  eventually_idx, symmetry=symmetry,
+                                  pfp=pfp)
             vcount = exp.cvalid.sum(dtype=jnp.int32)
             kovf = vcount > kmax_b
 
@@ -254,109 +265,129 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 disc_lo = jnp.where(keep, disc_lo, cand_lo)
                 disc_hit = disc_hit | new_hit
 
-            def commit(c):
-                # shrink the valid children to kmax_b lanes (gathers
-                # only); all downstream ops run at kmax_b lanes
-                src = shrink_indices(exp.cvalid, kmax_b)
-                kvalid = jnp.arange(kmax_b, dtype=jnp.int32) < vcount
-                k_flat = exp.flat[src]
-                k_chi = exp.chi[src]
-                k_clo = exp.clo[src]
-                row = src // n_actions  # parent frontier row per child
-                k_phi = p_whi[row]
-                k_plo = p_wlo[row]
-                k_ceb = exp.ebits[row]
-                if sound:
-                    k_chi, k_clo = fp64_node_device(k_chi, k_clo, k_ceb)
+            # Abort protocol WITHOUT lax.cond: on this platform each
+            # branch of a conditional that threads the big carried
+            # buffers costs a full buffer copy EVERY iteration (~25 ms at
+            # engine shapes, profiler-verified), so overflow handling is
+            # expressed as masks instead. kovf pre-gates the table
+            # insert's valid lanes, so nothing mutates and the host can
+            # re-expand the same frontier after resizing. hovf COMMITS
+            # the iteration (its inserted keys and rows are real) and
+            # only stops the loop; the unresolved lanes' keys went
+            # unlogged, which the host recovers by rescanning this
+            # chunk's queue span (TpuChecker._rescan_history). Garbage
+            # rows block-written past an un-advanced tail are never
+            # observed: the tail only moves on commit and the next
+            # commit overwrites them.
+            src = shrink_indices(exp.cvalid, kmax_b)
+            kvalid = (jnp.arange(kmax_b, dtype=jnp.int32) < vcount) \
+                & ~kovf
+            k_flat = exp.flat[src]
+            k_chi = exp.chi[src]
+            k_clo = exp.clo[src]
+            row = src // n_actions  # parent frontier row per child
+            k_phi = p_whi[row]
+            k_plo = p_wlo[row]
+            k_ceb = exp.ebits[row]
+            if sound:
+                k_chi, k_clo = fp64_node_device(k_chi, k_clo, k_ceb)
 
-                inserted, key_hi, key_lo, t_ovf = table_insert(
-                    c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
-                cnt = inserted.sum(dtype=jnp.int32)
+            inserted, key_hi, key_lo, t_ovf = table_insert(
+                c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
+            t_ovf = t_ovf & ~kovf
+            cnt = inserted.sum(dtype=jnp.int32)
 
-                # compact the fresh rows; block-append to queue + log
-                src2 = shrink_indices(inserted, kmax_b)
-                n_flat = k_flat[src2]
-                n_eb = k_ceb[src2]
-                n_chi = k_chi[src2]
-                n_clo = k_clo[src2]
-                n_phi = k_phi[src2]
-                n_plo = k_plo[src2]
+            # compact the fresh rows for the block appends
+            src2 = shrink_indices(inserted, kmax_b)
+            n_flat = k_flat[src2]
+            n_eb = k_ceb[src2]
+            n_chi = k_chi[src2]
+            n_clo = k_clo[src2]
+            n_phi = k_phi[src2]
+            n_plo = k_plo[src2]
 
-                if hist_on:
-                    # dedup the fresh rows by host-property key against
-                    # the persistent history table; the queue index of
-                    # each NEW key's first row is logged for the host's
-                    # post-chunk pull. Garbage lanes (>= cnt) are masked.
-                    hhi, hlo = fp64_device(
-                        n_flat[:, hoff:hoff + hwidth])
-                    hval = jnp.arange(kmax_b, dtype=jnp.int32) < cnt
-                    h_ins, hkey_hi, hkey_lo, h_ovf = table_insert(
-                        c.hkey_hi, c.hkey_lo, hhi, hlo, hval,
-                        max_rounds=h_rounds)
-                else:
-                    h_ovf = jnp.bool_(False)
+            if hist_on:
+                # dedup the fresh rows by host-property key against the
+                # persistent history table; the queue index of each NEW
+                # key's first row is logged for the host's post-chunk
+                # pull. Garbage lanes (>= cnt) are masked. On h_ovf the
+                # iteration still COMMITS (inserted keys/rows are real;
+                # rolling back the big tables would cost a full copy per
+                # iteration) — only the unresolved lanes' keys go
+                # unlogged, and the host recovers them with a standalone
+                # rescan of this chunk's queue span after growing the
+                # table (TpuChecker._rescan_history).
+                hhi, hlo = fp64_device(n_flat[:, hoff:hoff + hwidth])
+                hval = jnp.arange(kmax_b, dtype=jnp.int32) < cnt
+                h_ins, hkey_hi, hkey_lo, h_ovf = table_insert(
+                    c.hkey_hi, c.hkey_lo, hhi, hlo, hval,
+                    max_rounds=h_rounds)
+                h_ovf = h_ovf & ~kovf
+                hsrc = shrink_indices(h_ins, kmax_b)
+                hcnt = h_ins.sum(dtype=jnp.int32)
+                hidx = jax.lax.dynamic_update_slice(
+                    c.hidx, (c.q_tail + hsrc).astype(jnp.int32),
+                    (c.h_n,))
+                h_n = c.h_n + hcnt
+            else:
+                h_ovf = jnp.bool_(False)
+                hkey_hi, hkey_lo = c.hkey_hi, c.hkey_lo
+                hidx, h_n = c.hidx, c.h_n
 
-                def append(c):
-                    q_rows = jax.lax.dynamic_update_slice(
-                        c.q_rows, n_flat, (c.q_tail, 0))
-                    q_eb = jax.lax.dynamic_update_slice(
-                        c.q_eb, n_eb, (c.q_tail,))
-                    log_chi = jax.lax.dynamic_update_slice(
-                        c.log_chi, n_chi, (c.log_n,))
-                    log_clo = jax.lax.dynamic_update_slice(
-                        c.log_clo, n_clo, (c.log_n,))
-                    log_phi = jax.lax.dynamic_update_slice(
-                        c.log_phi, n_phi, (c.log_n,))
-                    log_plo = jax.lax.dynamic_update_slice(
-                        c.log_plo, n_plo, (c.log_n,))
-                    log_ohi, log_olo = c.log_ohi, c.log_olo
-                    if symmetry or sound:
-                        # the replayable STATE fingerprint per logged node
-                        # (exp.ohi aliases the state fp without symmetry)
-                        k_ohi = exp.ohi[src]
-                        k_olo = exp.olo[src]
-                        log_ohi = jax.lax.dynamic_update_slice(
-                            log_ohi, k_ohi[src2], (c.log_n,))
-                        log_olo = jax.lax.dynamic_update_slice(
-                            log_olo, k_olo[src2], (c.log_n,))
-                    hkh, hkl, hidx, h_n = (c.hkey_hi, c.hkey_lo,
-                                           c.hidx, c.h_n)
-                    if hist_on:
-                        hsrc = shrink_indices(h_ins, kmax_b)
-                        hcnt = h_ins.sum(dtype=jnp.int32)
-                        hidx = jax.lax.dynamic_update_slice(
-                            c.hidx, (c.q_tail + hsrc).astype(jnp.int32),
-                            (c.h_n,))
-                        hkh, hkl, h_n = hkey_hi, hkey_lo, c.h_n + hcnt
-                    return c._replace(
-                        q_rows=q_rows, q_eb=q_eb,
-                        q_head=c.q_head + take,
-                        q_tail=c.q_tail + cnt,
-                        key_hi=key_hi, key_lo=key_lo,
-                        log_chi=log_chi, log_clo=log_clo,
-                        log_phi=log_phi, log_plo=log_plo,
-                        log_ohi=log_ohi, log_olo=log_olo,
-                        log_n=c.log_n + cnt,
-                        hkey_hi=hkh, hkey_lo=hkl, hidx=hidx, h_n=h_n,
-                        gen=c.gen + vcount,
-                        ovf=c.ovf | t_ovf,
-                        xovf=c.xovf | exp.xovf)
+            take = jnp.where(kovf, 0, take)
+            vgen = jnp.where(kovf, 0, vcount)
 
-                # hovf: abort BEFORE any mutation (like kovf) — the host
-                # grows the history table, re-seeds it from hidx, and the
-                # resumed chunk re-expands this same frontier segment
-                return jax.lax.cond(
-                    h_ovf,
-                    lambda c: c._replace(hovf=jnp.bool_(True)),
-                    append, c)
+            q_rows = jax.lax.dynamic_update_slice(
+                c.q_rows, n_flat, (c.q_tail, 0))
+            q_eb = jax.lax.dynamic_update_slice(
+                c.q_eb, n_eb, (c.q_tail,))
+            if sound:
+                # the cache holds STATE fps (node keys are re-derived
+                # from them plus the row's ebits)
+                cf_hi = exp.ohi[src][src2]
+                cf_lo = exp.olo[src][src2]
+            else:
+                cf_hi, cf_lo = n_chi, n_clo
+            q_fph = jax.lax.dynamic_update_slice(
+                c.q_fph, cf_hi, (c.q_tail,))
+            q_fpl = jax.lax.dynamic_update_slice(
+                c.q_fpl, cf_lo, (c.q_tail,))
+            log_chi = jax.lax.dynamic_update_slice(
+                c.log_chi, n_chi, (c.log_n,))
+            log_clo = jax.lax.dynamic_update_slice(
+                c.log_clo, n_clo, (c.log_n,))
+            log_phi = jax.lax.dynamic_update_slice(
+                c.log_phi, n_phi, (c.log_n,))
+            log_plo = jax.lax.dynamic_update_slice(
+                c.log_plo, n_plo, (c.log_n,))
+            log_ohi, log_olo = c.log_ohi, c.log_olo
+            if symmetry or sound:
+                # the replayable STATE fingerprint per logged node
+                # (exp.ohi aliases the state fp without symmetry)
+                k_ohi = exp.ohi[src]
+                k_olo = exp.olo[src]
+                log_ohi = jax.lax.dynamic_update_slice(
+                    log_ohi, k_ohi[src2], (c.log_n,))
+                log_olo = jax.lax.dynamic_update_slice(
+                    log_olo, k_olo[src2], (c.log_n,))
 
-            # kovf: abort BEFORE any mutation; the host doubles kmax and
-            # the rebuilt chunk re-expands the same frontier
-            nc = jax.lax.cond(kovf, lambda c: c, commit, c)
-            return nc._replace(disc_hit=disc_hit, disc_hi=disc_hi,
-                               disc_lo=disc_lo, kovf=c.kovf | kovf,
-                               xovf=nc.xovf | exp.xovf,
-                               steps=c.steps - 1)
+            return c._replace(
+                q_rows=q_rows, q_eb=q_eb, q_fph=q_fph, q_fpl=q_fpl,
+                q_head=c.q_head + take,
+                q_tail=c.q_tail + cnt,
+                key_hi=key_hi, key_lo=key_lo,
+                log_chi=log_chi, log_clo=log_clo,
+                log_phi=log_phi, log_plo=log_plo,
+                log_ohi=log_ohi, log_olo=log_olo,
+                log_n=c.log_n + cnt,
+                hkey_hi=hkey_hi, hkey_lo=hkey_lo, hidx=hidx, h_n=h_n,
+                gen=c.gen + vgen,
+                ovf=c.ovf | t_ovf,
+                disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
+                kovf=c.kovf | kovf, hovf=c.hovf | h_ovf,
+                xovf=c.xovf | exp.xovf,
+                steps=c.steps - 1,
+                vmax=jnp.maximum(c.vmax, vcount))
         return step
 
     step_large = make_step(fmax, kmax)
@@ -380,18 +411,37 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         h0 = carry.h_n
         out, _, _ = jax.lax.while_loop(
             cond, body, (carry, target_remaining, grow_limit))
+        # ALL host-read scalars packed into ONE uint32 vector: on a
+        # tunneled device every device->host transfer is a round trip
+        # (profiler-measured ~10-60 ms each), and a per-leaf device_get
+        # of a dozen scalars dominated the whole chunk sync. Layout:
+        # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
+        #  disc_hit[P], disc_hi[P], disc_lo[P]]
+        stats = jnp.concatenate([
+            jnp.stack([out.q_head, out.q_tail, out.log_n, out.gen,
+                       out.ovf.astype(jnp.int32),
+                       out.xovf.astype(jnp.int32),
+                       out.kovf.astype(jnp.int32),
+                       out.h_n,
+                       out.hovf.astype(jnp.int32),
+                       out.vmax]).astype(jnp.uint32),
+            out.disc_hit.astype(jnp.uint32),
+            out.disc_hi, out.disc_lo])
         if not hist_on:
-            z = jnp.zeros((1,), jnp.uint32)
-            return out, jnp.zeros((1, 1), jnp.uint32), z, z
+            return out, stats, jnp.zeros((1, 1), jnp.uint32)
         # window over the representatives logged this chunk: rides the
         # host's per-chunk sync, so the common case (few fresh distinct
         # histories) needs NO standalone pull dispatch. Overflow beyond
-        # HIST_WINDOW falls back to TpuChecker._pull_host_reps.
+        # HIST_WINDOW falls back to TpuChecker._pull_host_reps. The rows
+        # and witness fps ride ONE matrix (one transfer).
         sel = out.hidx[jnp.minimum(h0 + jnp.arange(HIST_WINDOW),
                                    out.hidx.shape[0] - 1)]
         rows = out.q_rows[jnp.minimum(sel, out.q_rows.shape[0] - 1)]
         li = jnp.clip(sel - n_init, 0, out.log_chi.shape[0] - 1)
-        return out, rows, out.log_chi[li], out.log_clo[li]
+        win = jnp.concatenate(
+            [rows, out.log_chi[li][:, None], out.log_clo[li][:, None]],
+            axis=1)
+        return out, stats, win
 
     return jax.jit(chunk, donate_argnums=(0,))
 
@@ -406,7 +456,8 @@ _SEED_CACHE: dict = {}
 
 
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
-               steps: int = 0, symmetry: bool = False, hcap: int = 0):
+               steps: int = 0, symmetry: bool = False, hcap: int = 0,
+               init_fps=None, table_plan=None):
     """Host-side construction of the initial carry (init states enqueued;
     the caller bulk-inserts their fingerprints into the table).
     ``full_ebits`` is a scalar for fresh runs or a per-row array when
@@ -425,23 +476,37 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     width = model.packed_width
     prop_count = len(model.properties())
     k = len(init_rows)
-    key = (qcap, capacity, width, prop_count, symmetry, k, hcap)
+    kt = 0 if table_plan is None else 1 << max(
+        (len(table_plan[1]) - 1).bit_length(), 0)
+    key = (qcap, capacity, width, prop_count, symmetry, k, hcap, kt)
     fn = _SEED_CACHE.get(key)
     if fn is None:
         logcap = capacity
 
-        def build(init_arr, eb_arr, steps_s):
+        def build(init_arr, eb_arr, fp_hi, fp_lo, t_idx, t_hi, t_lo,
+                  steps_s):
             q_rows = jnp.zeros((qcap, width), jnp.uint32)
             q_eb = jnp.zeros((qcap,), jnp.uint32)
+            q_fph = jnp.zeros((qcap,), jnp.uint32)
+            q_fpl = jnp.zeros((qcap,), jnp.uint32)
             if k:
                 q_rows = jax.lax.dynamic_update_slice(q_rows, init_arr,
                                                       (0, 0))
                 q_eb = jax.lax.dynamic_update_slice(q_eb, eb_arr, (0,))
+                q_fph = jax.lax.dynamic_update_slice(q_fph, fp_hi, (0,))
+                q_fpl = jax.lax.dynamic_update_slice(q_fpl, fp_lo, (0,))
+            key_hi = jnp.zeros((capacity,), jnp.uint32)
+            key_lo = jnp.zeros((capacity,), jnp.uint32)
+            if kt:
+                # seed the visited table from the host placement plan —
+                # part of this single program, no separate dispatch
+                key_hi = key_hi.at[t_idx].set(t_hi, mode="drop")
+                key_lo = key_lo.at[t_idx].set(t_lo, mode="drop")
             return ChunkCarry(
-                q_rows=q_rows, q_eb=q_eb,
+                q_rows=q_rows, q_eb=q_eb, q_fph=q_fph, q_fpl=q_fpl,
                 q_head=jnp.int32(0), q_tail=jnp.int32(k),
-                key_hi=jnp.zeros((capacity,), jnp.uint32),
-                key_lo=jnp.zeros((capacity,), jnp.uint32),
+                key_hi=key_hi,
+                key_lo=key_lo,
                 log_chi=jnp.zeros((logcap,), jnp.uint32),
                 log_clo=jnp.zeros((logcap,), jnp.uint32),
                 log_phi=jnp.zeros((logcap,), jnp.uint32),
@@ -460,7 +525,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 hkey_hi=jnp.zeros((hcap if hcap else 1,), jnp.uint32),
                 hkey_lo=jnp.zeros((hcap if hcap else 1,), jnp.uint32),
                 hidx=jnp.zeros((logcap if hcap else 1,), jnp.int32),
-                h_n=jnp.int32(0), hovf=jnp.bool_(False))
+                h_n=jnp.int32(0), hovf=jnp.bool_(False),
+                vmax=jnp.int32(0))
 
         fn = jax.jit(build)
         if len(_SEED_CACHE) >= _CACHE_LIMIT:
@@ -470,7 +536,25 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
         init_arr = np.stack(init_rows).astype(np.uint32)
         eb_arr = np.broadcast_to(np.asarray(full_ebits, np.uint32),
                                  (k,)).copy()
+        fps = np.asarray(init_fps if init_fps is not None
+                         else [0] * k, np.uint64)
+        fp_hi = (fps >> np.uint64(32)).astype(np.uint32)
+        fp_lo = fps.astype(np.uint32)
     else:
         init_arr = np.zeros((0, width), np.uint32)
         eb_arr = np.zeros((0,), np.uint32)
-    return fn(init_arr, eb_arr, jnp.int32(steps))
+        fp_hi = fp_lo = np.zeros((0,), np.uint32)
+    if kt:
+        plan, seed_keys = table_plan
+        arr = np.zeros((kt,), np.uint64)
+        arr[:len(seed_keys)] = np.asarray(seed_keys, np.uint64)
+        t_idx = np.full((kt,), capacity, np.int64)  # oob rows dropped
+        t_idx[:len(plan)] = np.where(plan >= 0, plan, capacity)
+        t_idx = t_idx.astype(np.int32)
+        t_hi = (arr >> np.uint64(32)).astype(np.uint32)
+        t_lo = arr.astype(np.uint32)
+    else:
+        t_idx = np.zeros((0,), np.int32)
+        t_hi = t_lo = np.zeros((0,), np.uint32)
+    return fn(init_arr, eb_arr, fp_hi, fp_lo, t_idx, t_hi, t_lo,
+              jnp.int32(steps))

@@ -308,7 +308,13 @@ class TpuChecker(HostChecker):
         # hcap is its capacity, grown on occupancy pressure or hovf.
         # (The 'hmax' option is read by the sharded engine only.)
         self._posthoc_cap = int(opts.get("hcap", 1 << 16))
+        if self._posthoc_cap & (self._posthoc_cap - 1) \
+                or self._posthoc_cap < 4:
+            raise ValueError(
+                "tpu_options(hcap=...) must be a power of two >= 4 "
+                "(the open-addressing probe ring masks by bucket count)")
         self._h_pulled = 0  # representatives already host-evaluated
+        self._hscan_tail = 0  # queue rows known fully history-deduped
         # wall-time per engine phase (seconds), for report()/bench tuning
         self._prof: Dict[str, float] = {}
         # device-resident search record, pulled lazily by _ensure_mirror
@@ -459,7 +465,18 @@ class TpuChecker(HostChecker):
         opts = self._tpu_options
         fmax = int(opts.get("fmax", auto_fmax(model)))
         fa = fmax * model.max_actions
-        kmax = min(int(opts.get("kmax", max(1 << 12, fa // 2))), fa)
+        # candidate-buffer width: every gather/probe in the loop body
+        # scales with it, so models that know their branching (max valid
+        # children per state) can shrink it well below the fa//2 default
+        # via ``branching_hint``; a frontier that spikes past it triggers
+        # the cheap kovf resize
+        hint = getattr(model, "branching_hint", None)
+        if hint:
+            k_default = min(fa, max(
+                1 << 12, -(-(fmax * hint * 5 // 4) // 256) * 256))
+        else:
+            k_default = max(1 << 12, fa // 2)
+        kmax = min(int(opts.get("kmax", k_default)), fa)
         k_steps = int(opts.get("chunk_steps", 64))
         insert_fn = _insert_jit()
 
@@ -472,6 +489,7 @@ class TpuChecker(HostChecker):
             seed_ebits = full_ebits
             seed_fps = list(generated.keys())
         n_init = len(init_rows)
+        self._hscan_tail = n_init
         base_unique = len(generated)
         # everything known at seed time must be re-inserted on growth (the
         # device log only records states found since)
@@ -508,27 +526,38 @@ class TpuChecker(HostChecker):
             # launching the chunk (which donates the carry) while the
             # seed/insert programs are still in flight was measured to
             # slow the whole chunk loop ~2.5x on the tunneled device
+            # the queue's cached fingerprints are STATE fps (sound mode
+            # deduped on node keys but re-derives them from state fps)
+            cache_fps = ([self._orig_of[k] for k in seed_fps]
+                         if self._sound else seed_fps)
+            # the table is empty, so small seeds (the fresh-run case) are
+            # placed by a host plan scattered INSIDE the seed program —
+            # zero extra dispatches (a standalone table_insert dispatch,
+            # a data-dependent while_loop program, costs ~0.2 s on a
+            # tunneled device even for a handful of keys). Large seeds
+            # (checkpoint resume mirrors the whole reached set) keep the
+            # chunked device insert: the host plan's per-fingerprint
+            # Python loop would be the slow path there.
+            seed_keys = list(generated.keys())
+            table_plan = None
+            if len(seed_keys) <= (1 << 15):
+                from ..ops.hashtable import plan_insert_host
+                plan = plan_insert_host(seed_keys, self._capacity)
+                table_plan = (plan, seed_keys)
             carry = seed_carry(
                 model, qcap, self._capacity, init_rows, seed_ebits,
-                symmetry=self._symmetry or self._sound, hcap=hcap)
-            # the table is empty, so small seeds (the fresh-run case) are
-            # placed by a host plan + ONE scatter — a standalone
-            # table_insert dispatch (a data-dependent while_loop program)
-            # costs ~0.2 s on a tunneled device even for a handful of
-            # keys. Large seeds (checkpoint resume mirrors the whole
-            # reached set) keep the chunked device insert: the host
-            # plan's per-fingerprint Python loop would be the slow path
-            # there.
-            seed_keys = list(generated.keys())
-            if len(seed_keys) <= (1 << 15):
-                key_hi, key_lo = self._seed_table_scatter(
-                    carry.key_hi, carry.key_lo, seed_keys)
-                seed_ovf = None  # plan_insert_host raises on overflow
-            else:
+                symmetry=self._symmetry or self._sound, hcap=hcap,
+                init_fps=cache_fps, table_plan=table_plan)
+            if table_plan is None:
                 key_hi, key_lo, seed_ovf = self._bulk_insert_async(
                     insert_fn, carry.key_hi, carry.key_lo, seed_keys)
-            carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
-            jax.block_until_ready(carry)
+                carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
+            else:
+                seed_ovf = None  # plan_insert_host raises on overflow
+            # one readiness wait per program (not per leaf — each wait
+            # can round-trip on a tunneled device): the seed build and
+            # the table scatter are the two programs in flight
+            jax.block_until_ready((carry.q_head, carry.key_hi))
         chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
                                   kmax, symmetry=self._symmetry,
                                   sound=self._sound, hcap=hcap,
@@ -543,7 +572,8 @@ class TpuChecker(HostChecker):
                 min(max(target - self._state_count, 0), 2**31 - 1)
                 if target is not None else 2**31 - 1)
             carry = carry._replace(gen=jnp.int32(0),
-                                   steps=jnp.int32(k_steps))
+                                   steps=jnp.int32(k_steps),
+                                   vmax=jnp.int32(0))
             want_reps = self._host_props and any(
                 p.name not in discoveries for _i, p in self._host_props)
             if hcap and not want_reps:
@@ -557,24 +587,29 @@ class TpuChecker(HostChecker):
                                           sound=self._sound, hcap=0,
                                           n_init=n_init)
             with self._timed("chunk"):
-                carry, hrows_d, hwhi_d, hwlo_d = chunk_fn(
-                    carry, remaining, grow_limit)
-                scalars = (carry.q_head, carry.q_tail, carry.log_n,
-                           carry.disc_hit, carry.disc_hi, carry.disc_lo,
-                           carry.gen, carry.ovf, carry.xovf, carry.kovf,
-                           carry.h_n, carry.hovf)
-                if want_reps:
-                    # the representative window rides the same sync
-                    (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo,
-                     gen, ovf, xovf, kovf, h_n, hovf, hrows, hwhi,
-                     hwlo) = jax.device_get(
-                        scalars + (hrows_d, hwhi_d, hwlo_d))
-                else:
-                    (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo,
-                     gen, ovf, xovf, kovf, h_n,
-                     hovf) = jax.device_get(scalars)
+                carry, stats_d, win_d = chunk_fn(carry, remaining,
+                                                 grow_limit)
+                # ONE transfer for all scalars (packed vector)
+                stats = np.asarray(stats_d)
+            (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
+             vmax) = (int(stats[0]), int(stats[1]), int(stats[2]),
+                      int(stats[3]), bool(stats[4]), bool(stats[5]),
+                      bool(stats[6]), int(stats[7]), bool(stats[8]),
+                      int(stats[9]))
+            disc_hit = stats[10:10 + prop_count].astype(bool)
+            disc_hi = stats[10 + prop_count:10 + 2 * prop_count]
+            disc_lo = stats[10 + 2 * prop_count:10 + 3 * prop_count]
+            if want_reps and h_n > self._h_pulled:
+                # the representative window transfers only when this
+                # chunk actually logged fresh keys (the link is slow)
+                with self._timed("chunk"):
+                    win = np.asarray(win_d)
+                hrows = win[:, :-2]
+                hwhi, hwlo = win[:, -2], win[:, -1]
             q_size = int(q_tail) - int(q_head)
             self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+            # observed branching, for tuning model.branching_hint
+            self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
             self._state_count += int(gen)
             self._unique_state_count = base_unique + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
@@ -605,47 +640,60 @@ class TpuChecker(HostChecker):
                 # anchored at its entry h_n, so every logged
                 # representative must be consumed before the next launch.
                 from .device_loop import HIST_WINDOW
-                with self._timed("posthoc"):
-                    fresh = int(h_n) - self._h_pulled
-                    wfp = _combine64(hwhi, hwlo)
-                    for j in range(min(fresh, HIST_WINDOW)):
-                        if all(p.name in discoveries
-                               for _i, p in self._host_props):
-                            break
-                        self._eval_host_props_row(hrows[j], int(wfp[j]),
-                                                  discoveries)
-                    self._h_pulled += min(fresh, HIST_WINDOW)
-                    if fresh > HIST_WINDOW:
-                        # more fresh keys than the inline window: pull
-                        # the remainder with a standalone gather
-                        self._pull_host_reps(carry, int(h_n), n_init,
-                                             discoveries)
+                fresh = int(h_n) - self._h_pulled
+                if fresh > 0:
+                    with self._timed("posthoc"):
+                        wfp = _combine64(hwhi, hwlo)
+                        for j in range(min(fresh, HIST_WINDOW)):
+                            if all(p.name in discoveries
+                                   for _i, p in self._host_props):
+                                break
+                            self._eval_host_props_row(
+                                hrows[j], int(wfp[j]), discoveries)
+                        self._h_pulled += min(fresh, HIST_WINDOW)
+                        if fresh > HIST_WINDOW:
+                            # more fresh keys than the inline window:
+                            # pull the remainder standalone
+                            self._pull_host_reps(carry, int(h_n),
+                                                 n_init, discoveries)
                 if bool(hovf) or int(h_n) >= self._grow_at * hcap:
                     # grow the history-key table: proactively at the same
                     # occupancy threshold as the fingerprint table (a
                     # near-full open table crawls through thousands of
-                    # probe rounds per insert), or reactively on hovf
-                    # (the aborted iteration mutated nothing). Re-seed
-                    # from the logged representatives and resume.
-                    new_hcap = self._posthoc_cap
-                    while new_hcap * self._grow_at <= int(h_n):
-                        new_hcap *= 4
-                    if new_hcap == self._posthoc_cap:
-                        new_hcap *= 4  # hovf without occupancy pressure
-                    hcap = self._posthoc_cap = new_hcap
+                    # probe rounds per insert), or reactively on hovf.
+                    # Re-seed from the logged representatives; after an
+                    # hovf the overflowing iteration still committed, so
+                    # rescan its queue span for the keys that went
+                    # unlogged (growing further if even the bigger table
+                    # overflows on that span).
                     with self._timed("hgrow"):
-                        carry = self._regrow_history_table(
-                            carry, int(h_n), hcap)
+                        while True:
+                            new_hcap = self._posthoc_cap
+                            while new_hcap * self._grow_at <= int(h_n):
+                                new_hcap *= 4
+                            if new_hcap == self._posthoc_cap:
+                                new_hcap *= 4  # hovf w/o occupancy
+                            hcap = self._posthoc_cap = new_hcap
+                            carry = self._regrow_history_table(
+                                carry, int(h_n), hcap)
+                            if not bool(hovf):
+                                break
+                            carry, rescan_ovf = self._rescan_history(
+                                carry, self._hscan_tail, int(q_tail),
+                                qcap, n_init, discoveries)
+                            if not rescan_ovf:
+                                break
                     chunk_fn = build_chunk_fn(
                         model, qcap, self._capacity, fmax, kmax,
                         symmetry=self._symmetry, sound=self._sound,
                         hcap=hcap, n_init=n_init)
-                    if bool(hovf):
-                        continue
+                self._hscan_tail = int(q_tail)
             if bool(kovf):
                 # a batch produced more valid children than the candidate
-                # buffer; nothing was committed — double kmax and resume
-                kmax = min(kmax * 2, fa)
+                # buffer; nothing was committed — resize to the observed
+                # branching (at least doubling) and resume
+                kmax = min(max(kmax * 2,
+                               -(-(vmax + vmax // 4) // 256) * 256), fa)
                 chunk_fn = build_chunk_fn(model, qcap, self._capacity,
                                           fmax, kmax,
                                           symmetry=self._symmetry,
@@ -721,7 +769,7 @@ class TpuChecker(HostChecker):
         symmetry = self._symmetry or self._sound
         hist_on = carry.hidx.shape[0] > 1
 
-        def rebuild(q_rows, q_eb, q_head, q_tail,
+        def rebuild(q_rows, q_eb, q_fph, q_fpl, q_head, q_tail,
                     log_chi, log_clo, log_phi, log_plo,
                     log_ohi, log_olo, log_n, hidx):
             # copy the whole queue prefix into the larger buffer at the
@@ -732,6 +780,10 @@ class TpuChecker(HostChecker):
             nq_rows = jax.lax.dynamic_update_slice(nq_rows, q_rows, (0, 0))
             nq_eb = jnp.zeros((new_qcap,), jnp.uint32)
             nq_eb = jax.lax.dynamic_update_slice(nq_eb, q_eb, (0,))
+            nq_fph = jnp.zeros((new_qcap,), jnp.uint32)
+            nq_fph = jax.lax.dynamic_update_slice(nq_fph, q_fph, (0,))
+            nq_fpl = jnp.zeros((new_qcap,), jnp.uint32)
+            nq_fpl = jax.lax.dynamic_update_slice(nq_fpl, q_fpl, (0,))
             # bigger log
             nl_chi = jnp.zeros((self._capacity,), jnp.uint32)
             nl_chi = jax.lax.dynamic_update_slice(nl_chi, log_chi, (0,))
@@ -761,15 +813,15 @@ class TpuChecker(HostChecker):
             valid = jnp.arange(old_capacity, dtype=jnp.int32) < log_n
             _, key_hi, key_lo, ovf = table_insert_local(
                 key_hi, key_lo, log_chi, log_clo, valid)
-            return (nq_rows, nq_eb, key_hi, key_lo,
+            return (nq_rows, nq_eb, nq_fph, nq_fpl, key_hi, key_lo,
                     nl_chi, nl_clo, nl_phi, nl_plo, nl_ohi, nl_olo,
                     nh_idx, ovf)
 
         rebuild = jax.jit(rebuild)
-        (nq_rows, nq_eb, key_hi, key_lo, nl_chi, nl_clo, nl_phi,
-         nl_plo, nl_ohi, nl_olo, nh_idx, ovf) = rebuild(
-            carry.q_rows, carry.q_eb, carry.q_head,
-            carry.q_tail, carry.log_chi, carry.log_clo,
+        (nq_rows, nq_eb, nq_fph, nq_fpl, key_hi, key_lo, nl_chi, nl_clo,
+         nl_phi, nl_plo, nl_ohi, nl_olo, nh_idx, ovf) = rebuild(
+            carry.q_rows, carry.q_eb, carry.q_fph, carry.q_fpl,
+            carry.q_head, carry.q_tail, carry.log_chi, carry.log_clo,
             carry.log_phi, carry.log_plo, carry.log_ohi, carry.log_olo,
             carry.log_n, carry.hidx)
         if bool(jax.device_get(ovf)):
@@ -779,7 +831,7 @@ class TpuChecker(HostChecker):
         key_hi, key_lo = self._bulk_insert(insert_fn, key_hi, key_lo,
                                            self._base_fps)
         carry = carry._replace(
-            q_rows=nq_rows, q_eb=nq_eb,
+            q_rows=nq_rows, q_eb=nq_eb, q_fph=nq_fph, q_fpl=nq_fpl,
             key_hi=key_hi, key_lo=key_lo,
             log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
             log_plo=nl_plo, log_ohi=nl_ohi, log_olo=nl_olo,
@@ -870,6 +922,66 @@ class TpuChecker(HostChecker):
                 "growth; raise tpu_options(hcap=...)")
         return carry._replace(hkey_hi=khi, hkey_lo=klo,
                               hovf=jnp.bool_(False))
+
+    def _rescan_history(self, carry, start: int, end: int, qcap: int,
+                        n_init: int, discoveries: Dict[str, int]):
+        """Recovery after an in-chunk history-table overflow: the
+        overflowing iteration committed its rows, but its unresolved
+        keys were neither inserted nor logged. Re-dedup the queue span
+        ``[start, end)`` against the (re-grown) table, insert the
+        missing keys, and host-evaluate their representatives (rare
+        standalone dispatch; duplicate evaluations are memoized).
+        Returns ``(carry, overflowed)`` — on overflow the caller grows
+        the table again and retries."""
+        import jax
+        import jax.numpy as jnp
+
+        from .device_loop import shrink_indices
+        from ..ops.hash_kernel import fp64_device
+        from ..ops.hashtable import table_insert
+
+        if end <= start:
+            return carry, False
+        model = self._model
+        width = model.packed_width
+        cols = getattr(model, "host_property_cols", None)
+        off, hw = cols if cols is not None else (0, width)
+        rmax = min(_bucket(end - start), qcap)
+        s0 = min(start, qcap - rmax)
+
+        def fn(q_rows, log_chi, log_clo, khi, klo, s0_, q_off, q_len):
+            region = jax.lax.dynamic_slice(q_rows, (s0_, 0),
+                                           (rmax, width))
+            hhi, hlo = fp64_device(region[:, off:off + hw])
+            idx = jnp.arange(rmax, dtype=jnp.int32)
+            valid = (idx >= q_off) & (idx < q_off + q_len)
+            ins, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
+            src = shrink_indices(ins, rmax)
+            rows = region[src]
+            li = jnp.clip(src + s0_ - n_init, 0, log_chi.shape[0] - 1)
+            return (rows, log_chi[li], log_clo[li],
+                    ins.sum(dtype=jnp.int32), ovf, khi, klo)
+
+        (rows_d, whi_d, wlo_d, hcnt_d, ovf_d, khi, klo) = jax.jit(fn)(
+            carry.q_rows, carry.log_chi, carry.log_clo,
+            carry.hkey_hi, carry.hkey_lo, jnp.int32(s0),
+            jnp.int32(start - s0), jnp.int32(end - start))
+        hcnt, ovf = jax.device_get((hcnt_d, ovf_d))
+        if bool(ovf):
+            return carry, True
+        hcnt = int(hcnt)
+        if hcnt:
+            n = min(_bucket(hcnt), rmax)
+            rows_h, whi_h, wlo_h = jax.device_get(
+                (rows_d[:n], whi_d[:n], wlo_d[:n]))
+            wfp = _combine64(whi_h, wlo_h)
+            for j in range(hcnt):
+                if all(p.name in discoveries
+                       for _i, p in self._host_props):
+                    break
+                self._eval_host_props_row(rows_h[j], int(wfp[j]),
+                                          discoveries)
+        return carry._replace(hkey_hi=khi, hkey_lo=klo), False
 
     def _ensure_mirror(self) -> None:
         """Pull the device-resident (child fp, parent fp) log — lazily, on

@@ -43,6 +43,9 @@ class TwoPhaseSys(PackedModel):
         assert 1 <= n <= 16, "packed 2pc supports up to 16 RMs"
         self.n = n
         self.max_actions = 2 + 5 * n
+        # measured batch branching is ~12 valid children per state at
+        # n=7 (profile()['vmax'] / fmax) — high enough that the engine's
+        # fa//2 default candidate buffer is already right; no hint
 
     def cache_key(self):
         return ("twopc", self.n)
@@ -172,6 +175,17 @@ class TwoPhaseSys(PackedModel):
         return jnp.stack([nrmw, tm, nprep, nmsgs]).astype(jnp.uint32)
 
     def packed_step(self, words):
+        """Successor kernel, vectorized over the RM axis.
+
+        The per-iteration cost of the device loop is dominated by the
+        SEQUENTIAL op count of the traced graph (dependent-op latency —
+        NOTES.md), not lane width, so the 5 per-RM action families are
+        computed as (n,)-shaped array ops (~40 ops total) instead of a
+        Python loop emitting ~8 ops per action lane (~300 ops for n=7).
+        Action-lane ORDER differs from the host ``actions`` enumeration;
+        engines treat lanes as an unordered nondeterminism axis, so only
+        the successor multiset matters (pinned by the packed contract
+        tests)."""
         import jax.numpy as jnp
         n = self.n
         rmw, tm, prep, msgs = words[0], words[1], words[2], words[3]
@@ -182,43 +196,52 @@ class TwoPhaseSys(PackedModel):
         has_commit = (msgs & commit_bit) != 0
         has_abort = (msgs & abort_bit) != 0
 
-        succs = []
-        valids = []
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        shift = 2 * idx
+        fields = (rmw >> shift) & 3
+        is_working = fields == WORKING
+        cleared = rmw & ~(jnp.uint32(3) << shift)
+        rm_bit = jnp.uint32(1) << idx
+        rmw_v = jnp.broadcast_to(rmw, (n,))
+        tm_v = jnp.broadcast_to(tm, (n,))
+        prep_v = jnp.broadcast_to(prep, (n,))
+        msgs_v = jnp.broadcast_to(msgs, (n,))
 
-        def emit(valid, w0=None, w1=None, w2=None, w3=None):
-            succs.append(jnp.stack([
-                rmw if w0 is None else w0,
-                tm if w1 is None else w1,
-                prep if w2 is None else w2,
-                msgs if w3 is None else w3,
-            ]).astype(jnp.uint32))
-            valids.append(valid)
+        def rows(w0, w1, w2, w3):
+            return jnp.stack([w0, w1, w2, w3], axis=1).astype(jnp.uint32)
 
-        # TmCommit / TmAbort
-        emit(tm_init & ((prep & all_mask) == all_mask),
-             w1=jnp.uint32(TM_COMMITTED), w3=msgs | commit_bit)
-        emit(tm_init, w1=jnp.uint32(TM_ABORTED), w3=msgs | abort_bit)
-
-        for rm in range(n):
-            shift = 2 * rm
-            field = (rmw >> shift) & 3
-            is_working = field == WORKING
-            cleared = rmw & jnp.uint32(~(3 << shift) & 0xFFFFFFFF)
-            rm_bit = jnp.uint32(1 << rm)
-            # TmRcvPrepared(rm)
-            emit(tm_init & ((msgs & rm_bit) != 0), w2=prep | rm_bit)
-            # RmPrepare(rm)
-            emit(is_working,
-                 w0=cleared | jnp.uint32(PREPARED << shift),
-                 w3=msgs | rm_bit)
-            # RmChooseToAbort(rm)
-            emit(is_working, w0=cleared | jnp.uint32(ABORTED << shift))
-            # RmRcvCommitMsg(rm)
-            emit(has_commit, w0=cleared | jnp.uint32(COMMITTED << shift))
-            # RmRcvAbortMsg(rm)
-            emit(has_abort, w0=cleared | jnp.uint32(ABORTED << shift))
-
-        return jnp.stack(succs), jnp.stack(valids)
+        # two TM lanes + five per-RM families, one block each
+        tm_rows = jnp.stack([
+            jnp.stack([rmw, jnp.uint32(TM_COMMITTED), prep,
+                       msgs | commit_bit]),
+            jnp.stack([rmw, jnp.uint32(TM_ABORTED), prep,
+                       msgs | abort_bit]),
+        ]).astype(jnp.uint32)
+        tm_valid = jnp.stack([
+            tm_init & ((prep & all_mask) == all_mask),   # TmCommit
+            tm_init,                                     # TmAbort
+        ])
+        succs = jnp.concatenate([
+            tm_rows,
+            rows(rmw_v, tm_v, prep | rm_bit, msgs_v),    # TmRcvPrepared
+            rows(cleared | (jnp.uint32(PREPARED) << shift), tm_v, prep_v,
+                 msgs | rm_bit),                         # RmPrepare
+            rows(cleared | (jnp.uint32(ABORTED) << shift), tm_v, prep_v,
+                 msgs_v),                                # RmChooseToAbort
+            rows(cleared | (jnp.uint32(COMMITTED) << shift), tm_v,
+                 prep_v, msgs_v),                        # RmRcvCommitMsg
+            rows(cleared | (jnp.uint32(ABORTED) << shift), tm_v, prep_v,
+                 msgs_v),                                # RmRcvAbortMsg
+        ])
+        valids = jnp.concatenate([
+            tm_valid,
+            tm_init & ((msgs & rm_bit) != 0),
+            is_working,
+            is_working,
+            jnp.broadcast_to(has_commit, (n,)),
+            jnp.broadcast_to(has_abort, (n,)),
+        ])
+        return succs, valids
 
     def packed_properties(self, words):
         import jax.numpy as jnp
@@ -229,14 +252,10 @@ class TwoPhaseSys(PackedModel):
         for i in range(n):
             pat_aborted |= ABORTED << (2 * i)
             pat_committed |= COMMITTED << (2 * i)
-        any_aborted = jnp.bool_(False)
-        any_committed = jnp.bool_(False)
-        for i in range(n):
-            field = (rmw >> (2 * i)) & 3
-            any_aborted = any_aborted | (field == ABORTED)
-            any_committed = any_committed | (field == COMMITTED)
+        idx = jnp.arange(n, dtype=jnp.uint32)
+        fields = (rmw >> (2 * idx)) & 3
         return jnp.stack([
             rmw == pat_aborted,
             rmw == pat_committed,
-            ~(any_aborted & any_committed),
+            ~((fields == ABORTED).any() & (fields == COMMITTED).any()),
         ])

@@ -46,7 +46,7 @@ def eventually_indices(properties) -> list:
 
 def expand_frontier(model, frontier, fvalid, ebits,
                     eventually_idx: Sequence[int],
-                    symmetry: bool = False) -> Expansion:
+                    symmetry: bool = False, pfp=None) -> Expansion:
     """Evaluate properties and expand one frontier batch (pure JAX).
 
     With ``symmetry``, fingerprints are taken over
@@ -63,7 +63,15 @@ def expand_frontier(model, frontier, fvalid, ebits,
     DFS-sym counts are specific to DFS order. Reduction stays sound
     either way (never coarser than the orbit partition); value-complete
     representatives (e.g. increment's full-word sort) give engine-
-    independent counts."""
+    independent counts.
+
+    ``pfp`` (optional ``(hi, lo)`` uint32[F] pair) supplies the frontier
+    fingerprints from the caller's cache — the device queue stores each
+    state's fingerprint from when it was inserted, so re-hashing the
+    frontier every iteration (a ~W-column hash graph, the single biggest
+    op-count item for wide models) is skipped. Under symmetry the cached
+    values are the CANONICAL fingerprints (the queue appends exactly what
+    dedup inserted)."""
     fcount = frontier.shape[0]
     width = model.packed_width
     pbits = jax.vmap(model.packed_properties)(frontier)
@@ -86,11 +94,12 @@ def expand_frontier(model, frontier, fvalid, ebits,
         canon = jax.vmap(model.packed_representative)
         chi, clo = fp64_device(canon(flat))
         ohi, olo = fp64_device(flat)
-        phi, plo = fp64_device(canon(frontier))
+        phi, plo = pfp if pfp is not None \
+            else fp64_device(canon(frontier))
     else:
         chi, clo = fp64_device(flat)
         ohi, olo = chi, clo
-        phi, plo = fp64_device(frontier)
+        phi, plo = pfp if pfp is not None else fp64_device(frontier)
     terminal = fvalid & ~avalid.any(axis=1)
     return Expansion(pbits=pbits, ebits=ebits, flat=flat,
                      cvalid=avalid.reshape(-1), chi=chi, clo=clo,

@@ -57,6 +57,7 @@ def make_table(capacity: int):
 
 
 _BUCKET = 4  # slots probed per round (one contiguous row gather)
+_MIN_NARROW = 256  # floor for the narrow-tail probe width
 _CLAIM_CELLS = 1 << 16  # claim-arena floor: full capacity would memset
 #                         MBs per probe round; hashed cells only cost a
 #                         false claim-loss (the loser retries next round)
@@ -86,50 +87,46 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
     n_buckets = capacity // _BUCKET
     n = fhi.shape[0]
     gmask = n_buckets - 1
-    token = jnp.arange(1, n + 1, dtype=jnp.uint32)
     offs = jnp.arange(_BUCKET, dtype=jnp.uint32)
-    group = ((flo ^ (fhi * jnp.uint32(_PHI)))
-             & jnp.uint32(gmask)).astype(jnp.int32)
+    group0 = ((flo ^ (fhi * jnp.uint32(_PHI)))
+              & jnp.uint32(gmask)).astype(jnp.int32)
 
-    def cond(carry):
-        unresolved, _inserted, _group, _khi, _klo, rounds = carry
-        return unresolved.any() & (rounds < max_rounds)
-
-    def body(carry):
-        unresolved, inserted, group, khi, klo, rounds = carry
-        bucket_hi = khi.reshape(n_buckets, _BUCKET)[group]  # (n, 4)
-        bucket_lo = klo.reshape(n_buckets, _BUCKET)[group]
+    def round_(unresolved, inserted, group, khi2, klo2, fhi, flo, token,
+               claim_cells):
+        """One probe round at whatever lane width the inputs carry.
+        khi2/klo2 stay (n_buckets, 4) throughout: reshaping the flat
+        table per round was a full-table relayout each round
+        (profiler-measured ~0.9 ms x2 per round at engine sizes)."""
+        cmask = jnp.uint32(claim_cells - 1)
+        bucket_hi = khi2[group]  # (lanes, 4)
+        bucket_lo = klo2[group]
         is_empty = (bucket_hi == 0) & (bucket_lo == 0)
         is_match = (bucket_hi == fhi[:, None]) & (bucket_lo == flo[:, None])
         unresolved = unresolved & ~is_match.any(axis=1)
 
         has_empty = is_empty.any(axis=1)
-        # first empty slot in the bucket, as an absolute table index
         first_empty = jnp.where(is_empty, offs[None, :],
                                 jnp.uint32(_BUCKET)).min(axis=1)
         slot = group.astype(jnp.uint32) * jnp.uint32(_BUCKET) + first_empty
         attempt = unresolved & has_empty
-        oob = jnp.uint32(capacity)
         # claim race in a small hashed arena: XLA's scatter picks one
         # winner per cell (the CAS analog). Two lanes CLAIMING different
         # slots can hash to the same cell — the loser just retries next
         # round, exactly like losing a genuine same-slot race; winning a
         # cell always writes the lane's own slot, so no false *win*
-        # exists. Sized to the batch (>= 4x the lanes) so false
-        # collisions stay rare, but never the full capacity, whose
-        # per-round memset dominated small inserts
-        claim_cells = min(capacity,
-                          max(_CLAIM_CELLS, _next_pow2(4 * n)))
-        cmask = jnp.uint32(claim_cells - 1)
+        # exists. Sized to the batch (>= 4x the lanes), never the full
+        # capacity, whose per-round memset dominated small inserts.
         claim_idx = jnp.where(attempt, slot & cmask,
                               jnp.uint32(claim_cells))
         claim = jnp.zeros((claim_cells,), dtype=jnp.uint32)
         claim = claim.at[claim_idx].set(token, mode="drop")
         won = attempt & (claim[(slot & cmask).astype(jnp.int32)] == token)
 
-        write_idx = jnp.where(won, slot, oob)
-        khi = khi.at[write_idx].set(fhi, mode="drop")
-        klo = klo.at[write_idx].set(flo, mode="drop")
+        # race-free 2-D write: one winner per slot
+        wg = jnp.where(won, group, n_buckets)
+        wl = first_empty.astype(jnp.int32)
+        khi2 = khi2.at[wg, wl].set(fhi, mode="drop")
+        klo2 = klo2.at[wg, wl].set(flo, mode="drop")
         inserted = inserted | won
         unresolved = unresolved & ~won
 
@@ -138,15 +135,74 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
         # winner's key, or take the bucket's next empty slot).
         advance = unresolved & ~has_empty
         group = jnp.where(advance, (group + 1) & gmask, group)
-        return unresolved, inserted, group, khi, klo, rounds + 1
+        return unresolved, inserted, group, khi2, klo2
 
-    unresolved = valid
+    khi2 = key_hi.reshape(n_buckets, _BUCKET)
+    klo2 = key_lo.reshape(n_buckets, _BUCKET)
+    claim_full = min(capacity, max(_CLAIM_CELLS, _next_pow2(4 * n)))
+    token = jnp.arange(1, n + 1, dtype=jnp.uint32)
+
+    # --- round 1 at full width -----------------------------------------
     inserted = jnp.zeros((n,), dtype=bool)
-    carry = (unresolved, inserted, group, key_hi, key_lo,
-             jnp.int32(0))
-    unresolved, inserted, _group, key_hi, key_lo, _rounds = lax.while_loop(
-        cond, body, carry)
-    return inserted, key_hi, key_lo, unresolved.any()
+    unresolved, inserted, group, khi2, klo2 = round_(
+        valid, inserted, group0, khi2, klo2, fhi, flo, token, claim_full)
+
+    # --- narrow tail ----------------------------------------------------
+    # After one round, duplicates have matched and most fresh keys have
+    # claimed a slot; the unresolved remainder (claim losers and multi-
+    # fresh-keys-per-bucket tails) is a small fraction, but the
+    # while_loop's every round used to run at FULL lane width. Compact
+    # the stragglers to n/8 lanes and finish narrow; a full-width
+    # fallback loop covers the rare over-n/8 case.
+    n2 = min(n, max(_MIN_NARROW, _next_pow2((n + 7) // 8)))
+    ucount = unresolved.sum(dtype=jnp.int32)
+    narrow_ok = ucount <= n2
+    pos = jnp.cumsum(unresolved.astype(jnp.int32)) - 1
+    sidx = jnp.where(unresolved & (pos < n2), pos, n2)
+    src = jnp.zeros((n2 + 1,), jnp.int32).at[sidx].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")[:n2]
+    u2 = (jnp.arange(n2, dtype=jnp.int32) < ucount) & narrow_ok
+    fhi2 = fhi[src]
+    flo2 = flo[src]
+    group2 = group[src]
+    token2 = token[src]
+    claim_narrow = min(capacity, max(_CLAIM_CELLS, _next_pow2(4 * n2)))
+
+    def cond2(c):
+        unres2, _ins2, _g2, _khi2, _klo2, rounds = c
+        return unres2.any() & (rounds < max_rounds)
+
+    def body2(c):
+        unres2, ins2, g2, khi2, klo2, rounds = c
+        unres2, ins2, g2, khi2, klo2 = round_(
+            unres2, ins2, g2, khi2, klo2, fhi2, flo2, token2,
+            claim_narrow)
+        return unres2, ins2, g2, khi2, klo2, rounds + 1
+
+    ins2 = jnp.zeros((n2,), dtype=bool)
+    unres2, ins2, _g2, khi2, klo2, rounds2 = lax.while_loop(
+        cond2, body2, (u2, ins2, group2, khi2, klo2, jnp.int32(1)))
+    inserted = inserted.at[jnp.where(ins2, src, n)].set(
+        True, mode="drop")
+
+    # --- full-width fallback (ucount > n2; runs zero rounds otherwise) --
+    def cond3(c):
+        unres, _ins, _g, _khi2, _klo2, rounds = c
+        return unres.any() & (rounds < max_rounds)
+
+    def body3(c):
+        unres, ins, g, khi2, klo2, rounds = c
+        unres, ins, g, khi2, klo2 = round_(
+            unres, ins, g, khi2, klo2, fhi, flo, token, claim_full)
+        return unres, ins, g, khi2, klo2, rounds + 1
+
+    unres3, inserted, _g, khi2, klo2, _r = lax.while_loop(
+        cond3, body3,
+        (unresolved & ~narrow_ok, inserted, group, khi2, klo2,
+         jnp.int32(1)))
+    overflowed = (unres2 & (rounds2 >= max_rounds)).any() | unres3.any()
+    return (inserted, khi2.reshape(capacity), klo2.reshape(capacity),
+            overflowed)
 
 
 def plan_insert_host(fps, capacity: int):
