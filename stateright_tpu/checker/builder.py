@@ -46,8 +46,9 @@ class CheckerBuilder:
     def threads(self, thread_count: int) -> "CheckerBuilder":
         """Host-engine worker count (`src/checker.rs:171-173`). With
         ``thread_count > 1``, ``spawn_bfs`` runs the level-synchronous
-        multi-process engine (the GIL rules out shared-memory threads);
-        ``spawn_dfs`` stays sequential, as symmetry requires."""
+        multi-process engine and ``spawn_dfs`` the job-market
+        multi-process DFS (the GIL rules out shared-memory threads;
+        workers are separate processes sharing the visited table)."""
         self.thread_count_ = thread_count
         return self
 
@@ -100,7 +101,13 @@ class CheckerBuilder:
 
     def spawn_dfs(self) -> "Checker":
         """Depth-first host engine (`src/checker.rs:132-145`). The only host
-        engine supporting symmetry reduction, as in the reference."""
+        engine supporting symmetry reduction, as in the reference; with
+        ``threads(n > 1)``, the job-market multi-process DFS
+        (`dfs.rs:76-159`)."""
+        if (self.thread_count_ > 1 and self.visitor_ is None
+                and not self.sound_eventually_):
+            from .parallel_dfs import ParallelDfsChecker
+            return ParallelDfsChecker(self)
         from .dfs import DfsChecker
         return DfsChecker(self)
 

@@ -414,9 +414,10 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # ALL host-read scalars packed into ONE uint32 vector: on a
         # tunneled device every device->host transfer is a round trip
         # (profiler-measured ~10-60 ms each), and a per-leaf device_get
-        # of a dozen scalars dominated the whole chunk sync. Layout:
+        # of a dozen scalars dominated the whole chunk sync. Layout
+        # (tpu.py unpacks positionally — keep in sync):
         # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-        #  disc_hit[P], disc_hi[P], disc_lo[P]]
+        #  vmax, disc_hit[P], disc_hi[P], disc_lo[P]]
         stats = jnp.concatenate([
             jnp.stack([out.q_head, out.q_tail, out.log_n, out.gen,
                        out.ovf.astype(jnp.int32),

@@ -11,18 +11,16 @@ filtering — everything the reference does per state in ``check_block``,
 `bfs.rs:165-274`); the master merges children, dedups, and records
 discoveries first-wins.
 
-Workers inherit the model by ``fork`` (models hold lambdas, which do not
-pickle); only states cross process boundaries. Like the reference's
-multithreaded runs, which worker wins a discovery (and which parent a
-state records) is nondeterministic; full-enumeration unique counts match
-exactly.
-
-CAVEAT — fork + native threads: the pool forks at checker construction on
-the caller's thread, which avoids forking from the engine's background
-thread; but a process that has already started native threads (e.g. any
-``spawn_tpu`` run initializes XLA) is still fundamentally fork-unsafe per
-POSIX. Construct ``threads(n)`` checkers before touching the device
-engines, or keep host-parallel checking in its own process.
+Workers receive the model once, via **cloudpickle over a ``forkserver``
+start** (models hold lambdas, which the stdlib pickler rejects); only
+states cross process boundaries afterwards. The forkserver process never
+inherits this process's native threads, so constructing a ``threads(n)``
+checker after an XLA engine (``spawn_tpu``) initialized in-process is
+safe — unlike a raw ``fork``, which POSIX makes undefined with live
+threads (and which Python 3.12+ deprecates from threaded processes).
+Like the reference's multithreaded runs, which worker wins a discovery
+(and which parent a state records) is nondeterministic; full-enumeration
+unique counts match exactly.
 
 The ``eventually`` semantics ride per-frontier-entry bit sets with the
 same documented caveats as the sequential engines (`bfs.rs:239-256`).
@@ -30,20 +28,23 @@ same documented caveats as the sequential engines (`bfs.rs:239-256`).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, FrozenSet, List, Optional, Tuple
 
 from ..core import Expectation
 from .builder import CheckerBuilder
 from .host import HostChecker
 
-# worker globals, populated in the parent immediately before the fork so
-# the children inherit them (lambda-laden models cannot pickle). _FORK_LOCK
-# serializes (set globals -> fork pool -> clear globals) so concurrently
-# constructed checkers cannot hand a worker the wrong model.
+# worker globals, populated by the pool initializer from the cloudpickle
+# payload shipped at pool construction
 _WORK_MODEL = None
 _WORK_PROPS = None
-_FORK_LOCK = threading.Lock()
+
+
+def _init_worker(payload: bytes) -> None:
+    import cloudpickle
+
+    global _WORK_MODEL, _WORK_PROPS
+    _WORK_MODEL, _WORK_PROPS = cloudpickle.loads(payload)
 
 
 def _expand_block(batch: List[Tuple[Any, int, FrozenSet[int]]]):
@@ -108,21 +109,14 @@ class ParallelBfsChecker(HostChecker):
                 "single-chip spawn_tpu")
         self._workers = max(2, builder.thread_count_)
         self._generated: Dict[int, Optional[int]] = {}
-        # fork the worker pool at CONSTRUCTION, on the caller's thread:
-        # forking from the engine's background thread — or after other
-        # checkers spin up native (e.g. XLA) threads — is the classic
-        # fork+threads deadlock. The workers inherit the model via the
-        # fork; only states cross process boundaries afterwards.
         import multiprocessing as mp
 
-        global _WORK_MODEL, _WORK_PROPS
-        with _FORK_LOCK:
-            _WORK_MODEL = self._model
-            _WORK_PROPS = self._properties
-            try:
-                self._pool = mp.get_context("fork").Pool(self._workers)
-            finally:
-                _WORK_MODEL = _WORK_PROPS = None
+        import cloudpickle
+
+        payload = cloudpickle.dumps((self._model, self._properties))
+        self._pool = mp.get_context("forkserver").Pool(
+            self._workers, initializer=_init_worker,
+            initargs=(payload,))
 
     def _run(self) -> None:
         model = self._model

@@ -1,0 +1,307 @@
+"""Multi-core host DFS: ``spawn_dfs()`` honoring ``threads(n)``.
+
+The reference DFS has the same worker/job-market parallelism as its BFS
+(`/root/reference/src/checker/dfs.rs:28-29`, worker loop `dfs.rs:76-159`,
+work sharing `dfs.rs:145-157`): threads pop stack jobs, share spare work
+when peers idle, and dedup against a shared concurrent set. Python
+threads serialize on the GIL, so the host-parallel analog here is
+**process workers over stack jobs**:
+
+  * the visited set is a shared-memory open-addressed table of uint64
+    fingerprints (linear probing). Inserts are plain aligned stores —
+    racing workers can each claim the same state and both explore it,
+    the process analog of the reference's benign DashSet races
+    ("Races other threads, but that's fine", `dfs.rs:210,218,297`);
+    the final unique count deduplicates the table exactly.
+  * jobs are lists of DFS stack entries ``(state, fingerprint-path,
+    ebits)``; a worker whose local stack grows splits its bottom half
+    back to the job queue whenever the queue runs dry — the reference's
+    proactive share step (`dfs.rs:145-157`).
+  * workers receive the model once, via cloudpickle over a
+    ``forkserver`` start (models hold lambdas; the forkserver never
+    inherits this process's native threads, so running after an XLA
+    engine initialized in-process is safe — unlike ``fork``).
+
+Like the reference's multithreaded runs, which worker wins a discovery
+and the total generated count are nondeterministic (duplicate
+exploration from insert races adds to ``state_count``); full-enumeration
+``unique_state_count`` matches the sequential engine exactly. Symmetry
+reduction is supported with the same enqueue-original rule as the
+sequential DFS; ``sound_eventually`` and visitors require ``threads(1)``.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+from typing import Dict, List
+
+import numpy as np
+
+from ..core import Expectation
+from .builder import CheckerBuilder
+from .host import HostChecker
+from .path import Path
+
+#: probes before declaring the shared table full
+_MAX_PROBE = 1 << 14
+#: expansions between share-step checks
+_SHARE_PERIOD = 256
+
+
+def _shared_insert(table, mask: int, fp: int) -> bool:
+    """Insert ``fp``; True iff this worker claimed it first (racy but
+    aligned-atomic per slot; a lost race is benign duplicate work)."""
+    i = fp & mask
+    for _ in range(_MAX_PROBE):
+        v = int(table[i])
+        if v == fp:
+            return False
+        if v == 0:
+            table[i] = fp
+            if int(table[i]) == fp:
+                return True
+            continue  # slot stolen mid-write: re-read, keep probing
+        i = (i + 1) & mask
+    raise RuntimeError(
+        "shared DFS visited table is full; raise threads-DFS capacity "
+        "via tpu_options(host_table_capacity=...) or bound the run with "
+        "target_state_count(...)")
+
+
+def _dfs_worker(payload: bytes, shm_name: str, capacity: int, jobq,
+                resq, stop, counter, nworkers: int) -> None:
+    """Worker loop: pop a stack job, run DFS on it, share spare work."""
+    import cloudpickle
+    from multiprocessing import shared_memory
+
+    model, properties, symmetry = cloudpickle.loads(payload)
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        table = np.ndarray((capacity,), dtype=np.uint64, buffer=shm.buf)
+        mask = capacity - 1
+        local_disc: set = set()
+
+        def run_job(pending: List) -> int:
+            gen = 0
+            ticks = 0
+            while pending:
+                if stop.is_set():
+                    return gen
+                ticks += 1
+                if (ticks % _SHARE_PERIOD == 0 and len(pending) > 2
+                        and jobq.qsize() < nworkers):
+                    # share step (dfs.rs:145-157): give the bottom of
+                    # the stack (shallowest, largest subtrees) away
+                    half = pending[:len(pending) // 2]
+                    del pending[:len(pending) // 2]
+                    with counter.get_lock():
+                        counter.value += 1
+                    jobq.put(half)
+                state, fingerprints, ebits = pending.pop()
+
+                # property evaluation (dfs.rs:204-237)
+                for i, prop in enumerate(properties):
+                    if prop.name in local_disc:
+                        continue
+                    if prop.expectation == Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            local_disc.add(prop.name)
+                            resq.put(("disc", prop.name,
+                                      list(fingerprints)))
+                    elif prop.expectation == Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            local_disc.add(prop.name)
+                            resq.put(("disc", prop.name,
+                                      list(fingerprints)))
+                    else:  # EVENTUALLY
+                        if prop.condition(model, state):
+                            ebits = ebits - {i}
+
+                # expansion (dfs.rs:239-301)
+                actions: List = []
+                is_terminal = True
+                model.actions(state, actions)
+                for action in actions:
+                    next_state = model.next_state(state, action)
+                    if next_state is None:
+                        continue
+                    if not model.within_boundary(next_state):
+                        continue
+                    gen += 1
+                    is_terminal = False
+                    if symmetry is not None:
+                        rep_fp = model.fingerprint(symmetry(next_state))
+                        next_fp = None
+                    else:
+                        rep_fp = next_fp = model.fingerprint(next_state)
+                    if not _shared_insert(table, mask, rep_fp):
+                        continue
+                    if next_fp is None:
+                        # enqueue-original rule (dfs.rs:266-269)
+                        next_fp = model.fingerprint(next_state)
+                    pending.append(
+                        (next_state, fingerprints + [next_fp], ebits))
+                if is_terminal:
+                    for i, prop in enumerate(properties):
+                        if i in ebits and prop.name not in local_disc:
+                            local_disc.add(prop.name)
+                            resq.put(("disc", prop.name,
+                                      list(fingerprints)))
+            return gen
+
+        while not stop.is_set():
+            try:
+                job = jobq.get(timeout=0.05)
+            except queue_mod.Empty:
+                continue
+            try:
+                gen = run_job(job)
+                resq.put(("done", gen))
+            finally:
+                with counter.get_lock():
+                    counter.value -= 1
+    except Exception as exc:  # surface worker crashes to the master
+        resq.put(("error", f"{type(exc).__name__}: {exc}"))
+    finally:
+        shm.close()
+
+
+class ParallelDfsChecker(HostChecker):
+    """Job-market multi-process DFS (``threads(n)``, n > 1)."""
+
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        if builder.visitor_ is not None:
+            raise ValueError(
+                "per-state visitors require the sequential engine; drop "
+                "threads(...) or the visitor")
+        if builder.sound_eventually_ and any(
+                p.expectation == Expectation.EVENTUALLY
+                for p in self._properties):
+            raise NotImplementedError(
+                "sound_eventually() is not supported by the multi-process "
+                "DFS; use threads(1) spawn_dfs")
+        self._workers = max(2, builder.thread_count_)
+        self._capacity = int(builder.tpu_options_.get(
+            "host_table_capacity", 1 << 22))
+        assert self._capacity & (self._capacity - 1) == 0, \
+            "host_table_capacity must be a power of two"
+        self._discovery_fps: Dict[str, List[int]] = {}
+        self._generated: set = set()
+
+    def _run(self) -> None:
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+
+        import cloudpickle
+
+        model = self._model
+        properties = self._properties
+        symmetry = self._symmetry
+        discoveries = self._discovery_fps
+        target = self._target_state_count
+        ctx = mp.get_context("forkserver")
+
+        shm = shared_memory.SharedMemory(
+            create=True, size=8 * self._capacity)
+        procs: List = []
+        try:
+            table = np.ndarray((self._capacity,), dtype=np.uint64,
+                               buffer=shm.buf)
+            table[:] = 0
+            mask = self._capacity - 1
+
+            init_states = [s for s in model.init_states()
+                           if model.within_boundary(s)]
+            self._state_count = len(init_states)
+            ebits = self._init_ebits()
+            entries = []
+            for s in init_states:
+                fp = model.fingerprint(s)
+                rep_fp = (model.fingerprint(symmetry(s))
+                          if symmetry is not None else fp)
+                if _shared_insert(table, mask, rep_fp):
+                    entries.append((s, [fp], ebits))
+            self._unique_state_count = len(entries)
+            if not properties or not entries:
+                return
+
+            payload = cloudpickle.dumps((model, properties, symmetry))
+            jobq = ctx.Queue()
+            resq = ctx.Queue()
+            stop = ctx.Event()
+            counter = ctx.Value("i", 0)
+            # round-robin the init entries so several workers start busy
+            n_jobs = min(len(entries), self._workers)
+            jobs: List[List] = [entries[i::n_jobs] for i in range(n_jobs)]
+            with counter.get_lock():
+                counter.value = len(jobs)
+            for job in jobs:
+                jobq.put(job)
+            for wid in range(self._workers):
+                p = ctx.Process(
+                    target=_dfs_worker,
+                    args=(payload, shm.name, self._capacity, jobq, resq,
+                          stop, counter, self._workers),
+                    daemon=True)
+                p.start()
+                procs.append(p)
+
+            while True:
+                try:
+                    msg = resq.get(timeout=0.05)
+                except queue_mod.Empty:
+                    msg = None
+                if msg is not None:
+                    kind = msg[0]
+                    if kind == "disc":
+                        discoveries.setdefault(msg[1], msg[2])
+                        if len(discoveries) == len(properties):
+                            break
+                    elif kind == "done":
+                        self._state_count += msg[1]
+                        self._unique_state_count = int(
+                            np.count_nonzero(table))
+                    else:  # error
+                        raise RuntimeError(
+                            f"DFS worker failed: {msg[1]}")
+                if target is not None and self._state_count >= target:
+                    break
+                with counter.get_lock():
+                    done = counter.value == 0
+                if done and msg is None:
+                    break
+            stop.set()
+            # drain any last messages (discoveries already in flight)
+            while True:
+                try:
+                    msg = resq.get(timeout=0.05)
+                except queue_mod.Empty:
+                    break
+                if msg[0] == "disc":
+                    discoveries.setdefault(msg[1], msg[2])
+                elif msg[0] == "done":
+                    self._state_count += msg[1]
+            # exact unique count: racing claims can store a fingerprint
+            # in two slots, so the count dedups the table contents. The
+            # deduplicated set also backs generated_fingerprints().
+            vals = np.unique(table[table != np.uint64(0)])
+            self._unique_state_count = int(vals.size)
+            self._generated = set(int(v) for v in vals)
+        finally:
+            try:
+                stop.set()
+            except Exception:
+                pass
+            for p in procs:
+                p.join(timeout=2.0)
+                if p.is_alive():
+                    p.terminate()
+            shm.close()
+            shm.unlink()
+
+    def discoveries(self) -> Dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self._model, fps)
+            for name, fps in list(self._discovery_fps.items())
+        }

@@ -949,7 +949,15 @@ class TpuChecker(HostChecker):
         rmax = min(_bucket(end - start), qcap)
         s0 = min(start, qcap - rmax)
 
-        def fn(q_rows, log_chi, log_clo, khi, klo, s0_, q_off, q_len):
+        # recovered representatives are logged into hidx too, so later
+        # table growths (which re-seed from hidx) keep their keys; when
+        # the log lacks room for the write window, skip logging — later
+        # duplicates just re-log and re-evaluate (memoized), benign
+        log_reps = (int(jax.device_get(carry.h_n)) + rmax
+                    <= carry.hidx.shape[0])
+
+        def fn(q_rows, log_chi, log_clo, khi, klo, hidx, h_n, s0_,
+               q_off, q_len):
             region = jax.lax.dynamic_slice(q_rows, (s0_, 0),
                                            (rmax, width))
             hhi, hlo = fp64_device(region[:, off:off + hw])
@@ -958,18 +966,27 @@ class TpuChecker(HostChecker):
             ins, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
             src = shrink_indices(ins, rmax)
             rows = region[src]
+            hcnt = ins.sum(dtype=jnp.int32)
+            if log_reps:
+                hidx = jax.lax.dynamic_update_slice(
+                    hidx, (src + s0_).astype(jnp.int32), (h_n,))
+                h_n = h_n + hcnt
             li = jnp.clip(src + s0_ - n_init, 0, log_chi.shape[0] - 1)
-            return (rows, log_chi[li], log_clo[li],
-                    ins.sum(dtype=jnp.int32), ovf, khi, klo)
+            return (rows, log_chi[li], log_clo[li], hcnt, ovf, khi, klo,
+                    hidx, h_n)
 
-        (rows_d, whi_d, wlo_d, hcnt_d, ovf_d, khi, klo) = jax.jit(fn)(
+        (rows_d, whi_d, wlo_d, hcnt_d, ovf_d, khi, klo, hidx_d,
+         h_n_d) = jax.jit(fn)(
             carry.q_rows, carry.log_chi, carry.log_clo,
-            carry.hkey_hi, carry.hkey_lo, jnp.int32(s0),
-            jnp.int32(start - s0), jnp.int32(end - start))
+            carry.hkey_hi, carry.hkey_lo, carry.hidx, carry.h_n,
+            jnp.int32(s0), jnp.int32(start - s0), jnp.int32(end - start))
         hcnt, ovf = jax.device_get((hcnt_d, ovf_d))
         if bool(ovf):
             return carry, True
         hcnt = int(hcnt)
+        if log_reps:
+            carry = carry._replace(hidx=hidx_d, h_n=h_n_d)
+            self._h_pulled += hcnt  # evaluated below, stay in lockstep
         if hcnt:
             n = min(_bucket(hcnt), rmax)
             rows_h, whi_h, wlo_h = jax.device_get(
@@ -1191,34 +1208,6 @@ class TpuChecker(HostChecker):
                 discoveries[prop.name] = fp
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
-
-    _SCATTER_JIT = None
-
-    def _seed_table_scatter(self, key_hi, key_lo, fps: List[int]):
-        """Insert seed fingerprints into the (empty) table via a
-        host-computed placement plan and one device scatter."""
-        import jax
-        import jax.numpy as jnp
-
-        from ..ops.hashtable import plan_insert_host
-
-        if not fps:
-            return key_hi, key_lo
-        if TpuChecker._SCATTER_JIT is None:
-            def scatter(khi, klo, idx, hi, lo):
-                return (khi.at[idx].set(hi, mode="drop"),
-                        klo.at[idx].set(lo, mode="drop"))
-            TpuChecker._SCATTER_JIT = jax.jit(scatter)
-        plan = plan_insert_host(fps, self._capacity)
-        n = _bucket(len(fps))
-        arr = np.zeros((n,), np.uint64)
-        arr[:len(fps)] = np.asarray(fps, np.uint64)
-        idx = np.full((n,), self._capacity, np.int64)  # oob rows dropped
-        idx[:len(fps)] = np.where(plan >= 0, plan, self._capacity)
-        return TpuChecker._SCATTER_JIT(
-            key_hi, key_lo, jnp.asarray(idx.astype(np.int32)),
-            jnp.asarray((arr >> np.uint64(32)).astype(np.uint32)),
-            jnp.asarray(arr.astype(np.uint32)))
 
     def _bulk_insert_async(self, insert_fn, key_hi, key_lo,
                            fps: List[int]):

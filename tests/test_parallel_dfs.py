@@ -1,0 +1,98 @@
+"""Multi-process host DFS tests (`threads(n).spawn_dfs()`): set-equality
+and verdict parity with the sequential DFS across model families —
+mirroring the reference's multithreaded DFS promises (`dfs.rs:76-159`,
+sharing `dfs.rs:145-157`). Parallel runs cannot pin visitation order, so
+assertions use unique counts + fingerprint-set equality, as the
+reference's own multithreaded runs require."""
+
+import pytest
+
+from stateright_tpu.actor.test_util import PingPongCfg
+from stateright_tpu.models.fixtures import LinearEquation
+from stateright_tpu.models.twopc import TwoPhaseSys
+
+
+def par(model, n=4, **kw):
+    ck = model.checker().threads(n)
+    for k, v in kw.items():
+        getattr(ck, k)(v)
+    return ck.spawn_dfs().join()
+
+
+class TestParallelDfs:
+    def test_full_enumeration_matches_sequential(self):
+        p = par(TwoPhaseSys(5))  # 8,832 (2pc.rs:133)
+        s = TwoPhaseSys(5).checker().spawn_dfs().join()
+        assert p.unique_state_count() == 8832
+        assert p.generated_fingerprints() == s.generated_fingerprints()
+
+    def test_discovery_replays(self):
+        # discoveries carry whole fingerprint paths (dfs.rs:26); an
+        # invalid path would fail Path.from_fingerprints replay
+        p = par(LinearEquation(2, 10, 14))
+        found = p.assert_any_discovery("solvable")
+        x, y = found.last_state()
+        assert (2 * x + 10 * y) & 0xFF == 14
+
+    def test_actor_model_counts(self):
+        model = PingPongCfg(maintains_history=False,
+                            max_nat=5).into_model()
+        p = par(model)
+        s = (PingPongCfg(maintains_history=False, max_nat=5).into_model()
+             .checker().spawn_dfs().join())
+        assert p.unique_state_count() == 11
+        assert set(p.discoveries()) == set(s.discoveries())
+
+    def test_symmetry_reduction(self):
+        # the parallel DFS preserves the canonicalize-then-hash-but-
+        # enqueue-original rule; 2pc 5 RMs reduces 8,832 -> 665
+        # (2pc.rs:138)
+        p = par(TwoPhaseSys(5), symmetry_fn=lambda s:
+                TwoPhaseSys(5).representative(s))
+        assert p.unique_state_count() == 665
+        p.assert_properties()
+
+    def test_target_state_count(self):
+        p = par(LinearEquation(2, 4, 7), target_state_count=500)
+        assert p.state_count() >= 500
+
+    def test_visitor_falls_back_to_sequential(self):
+        from stateright_tpu.checker.dfs import DfsChecker
+        from stateright_tpu.checker.visitor import StateRecorder
+        ck = (LinearEquation(2, 10, 14).checker().threads(4)
+              .visitor(StateRecorder()).spawn_dfs())
+        assert isinstance(ck, DfsChecker)
+
+    def test_full_linear_equation(self):
+        # 65,536-state full enumeration across 4 workers
+        p = par(LinearEquation(2, 4, 251))
+        s = LinearEquation(2, 4, 251).checker().spawn_dfs().join()
+        assert (p.unique_state_count() == s.unique_state_count()
+                == 65536)
+
+
+def test_threads_after_xla_initialized():
+    # the forkserver never inherits this process's native threads, so a
+    # multi-process checker constructed AFTER XLA spun up its threadpool
+    # in-process must work (the old fork()-based pool was fork-unsafe
+    # here per POSIX)
+    import jax.numpy as jnp
+
+    (jnp.zeros((8,)) + 1).sum().item()  # force backend + threadpool init
+    p = par(TwoPhaseSys(3))
+    assert p.unique_state_count() == 288
+    b = TwoPhaseSys(3).checker().threads(2).spawn_bfs().join()
+    assert b.unique_state_count() == 288
+
+
+def test_no_fork_deprecation_warning(recwarn):
+    # the multi-process engines use forkserver + cloudpickle: no
+    # fork()-with-threads DeprecationWarning may escape
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        p = par(TwoPhaseSys(3))
+        assert p.unique_state_count() == 288
+        b = TwoPhaseSys(3).checker().threads(2).spawn_bfs().join()
+        assert b.unique_state_count() == 288
