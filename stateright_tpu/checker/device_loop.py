@@ -290,6 +290,9 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             k_plo = p_wlo[row]
             k_ceb = exp.ebits[row]
             if sound:
+                # keep the canonical state fps for the queue fp cache;
+                # the dedup keys become node keys
+                s_chi, s_clo = k_chi, k_clo
                 k_chi, k_clo = fp64_node_device(k_chi, k_clo, k_ceb)
 
             inserted, key_hi, key_lo, t_ovf = table_insert(
@@ -342,10 +345,10 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             q_eb = jax.lax.dynamic_update_slice(
                 c.q_eb, n_eb, (c.q_tail,))
             if sound:
-                # the cache holds STATE fps (node keys are re-derived
-                # from them plus the row's ebits)
-                cf_hi = exp.ohi[src][src2]
-                cf_lo = exp.olo[src][src2]
+                # the cache holds CANONICAL state fps (node keys are
+                # re-derived from them plus the row's ebits)
+                cf_hi = s_chi[src2]
+                cf_lo = s_clo[src2]
             else:
                 cf_hi, cf_lo = n_chi, n_clo
             q_fph = jax.lax.dynamic_update_slice(

@@ -299,10 +299,6 @@ class TpuChecker(HostChecker):
                 raise NotImplementedError(
                     "checkpoint resume under sound_eventually() is not "
                     "supported")
-            if builder.symmetry_fn_ is not None:
-                raise NotImplementedError(
-                    "sound_eventually() with symmetry reduction is not "
-                    "supported on the TPU engine; use spawn_dfs")
         # host-property history dedup (device engine): the history-key
         # table rides IN the chunk carry (device_loop.ChunkCarry.hkey_*);
         # hcap is its capacity, grown on occupancy pressure or hovf.
@@ -422,6 +418,7 @@ class TpuChecker(HostChecker):
             from ..ops.expand import eventually_indices
             full_mask = sum(1 << i
                             for i in eventually_indices(self._properties))
+        self._seed_cache_fps: List[int] = []
         for s in init_states:
             if validate is not None:
                 validate(s)
@@ -429,11 +426,14 @@ class TpuChecker(HostChecker):
             key = fp64_node(fp, full_mask) if self._sound else fp
             if key not in self._generated:
                 self._generated[key] = None
-                if self._symmetry:
+                if self._symmetry or self._sound:
+                    # replay translation: node/canonical key -> the
+                    # ORIGINAL explored state's fingerprint
                     self._orig_of[key] = model.fingerprint(s)
-                elif self._sound:
-                    self._orig_of[key] = fp
                 init_rows.append(model.encode(s))
+                # the queue fingerprint cache wants the CANONICAL state
+                # fp (node keys are re-derived from it + the row ebits)
+                self._seed_cache_fps.append(fp)
         self._unique_state_count = len(self._generated)
         return init_rows
 
@@ -526,10 +526,11 @@ class TpuChecker(HostChecker):
             # launching the chunk (which donates the carry) while the
             # seed/insert programs are still in flight was measured to
             # slow the whole chunk loop ~2.5x on the tunneled device
-            # the queue's cached fingerprints are STATE fps (sound mode
-            # deduped on node keys but re-derives them from state fps)
-            cache_fps = ([self._orig_of[k] for k in seed_fps]
-                         if self._sound else seed_fps)
+            # the queue's cached fingerprints are canonical STATE fps
+            # (sound mode dedups on node keys but re-derives them from
+            # these); on resume the rows' own fps were recomputed
+            cache_fps = (self._seed_cache_fps
+                         if self._resume_path is None else seed_fps)
             # the table is empty, so small seeds (the fresh-run case) are
             # placed by a host plan scattered INSIDE the seed program —
             # zero extra dispatches (a standalone table_insert dispatch,

@@ -55,10 +55,10 @@ class ShardedTpuChecker(TpuChecker):
             raise NotImplementedError(
                 "checkpoint resume is not supported on the sharded "
                 "engine; use single-chip spawn_tpu")
-        if getattr(self, "_sound", False):
+        if getattr(self, "_sound", False) and self._host_props:
             raise NotImplementedError(
-                "sound_eventually() is not supported on the sharded "
-                "engine; use single-chip spawn_tpu or the host engines")
+                "sound_eventually() with host-evaluated properties is "
+                "not supported on the sharded engine")
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
@@ -100,15 +100,18 @@ class ShardedTpuChecker(TpuChecker):
         n_init_arr = np.asarray([len(b) for b in init_by_shard], np.int32)
 
         insert_fn = build_sharded_insert(mesh, axis)
+        seed_ebits = full_ebits
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
-                                   init_rows, init_fps, full_ebits,
-                                   prop_count, symmetry=self._symmetry)
+                                   init_rows, init_fps, seed_ebits,
+                                   prop_count, symmetry=self._symmetry,
+                                   sound=self._sound)
         key_hi, key_lo = self._sharded_bulk_insert(
             insert_fn, carry.key_hi, carry.key_lo, init_fps, D)
         carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
         chunk_fn = build_sharded_chunk_fn(model, mesh, axis, qcap,
                                           self._capacity, fmax,
-                                          symmetry=self._symmetry)
+                                          symmetry=self._symmetry,
+                                          sound=self._sound)
 
         import jax.numpy as jnp
 
@@ -163,7 +166,7 @@ class ShardedTpuChecker(TpuChecker):
                     carry, qcap, n_init, headroom, init_fps, insert_fn)
                 chunk_fn = build_sharded_chunk_fn(
                     model, mesh, axis, qcap, self._capacity, fmax,
-                    symmetry=self._symmetry)
+                    symmetry=self._symmetry, sound=self._sound)
 
         self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
@@ -238,7 +241,7 @@ class ShardedTpuChecker(TpuChecker):
         log_clo = np.zeros((self._capacity,), dtype=np.uint32)
         log_phi = np.zeros((self._capacity,), dtype=np.uint32)
         log_plo = np.zeros((self._capacity,), dtype=np.uint32)
-        oshape = self._capacity if self._symmetry else D
+        oshape = self._capacity if self._symmetry or self._sound else D
         log_ohi = np.zeros((oshape,), dtype=np.uint32)
         log_olo = np.zeros((oshape,), dtype=np.uint32)
         for s in range(D):
@@ -254,7 +257,7 @@ class ShardedTpuChecker(TpuChecker):
             log_clo[dst] = h.log_clo[src]
             log_phi[dst] = h.log_phi[src]
             log_plo[dst] = h.log_plo[src]
-            if self._symmetry:
+            if self._symmetry or self._sound:
                 log_ohi[dst] = h.log_ohi[src]
                 log_olo[dst] = h.log_olo[src]
 
@@ -359,7 +362,7 @@ class ShardedTpuChecker(TpuChecker):
             (carry.log_n, carry.log_chi, carry.log_clo, carry.log_phi,
              carry.log_plo))
         log_ohi = log_olo = None
-        if self._symmetry:
+        if self._symmetry or self._sound:
             log_ohi, log_olo = jax.device_get(
                 (carry.log_ohi, carry.log_olo))
         for s in range(D):
@@ -370,7 +373,7 @@ class ShardedTpuChecker(TpuChecker):
             child = _combine64(log_chi[src], log_clo[src])
             parent = _combine64(log_phi[src], log_plo[src])
             self._generated.update(zip(child.tolist(), parent.tolist()))
-            if self._symmetry:
+            if self._symmetry or self._sound:
                 orig = _combine64(log_ohi[src], log_olo[src])
                 self._orig_of.update(zip(child.tolist(), orig.tolist()))
         self._unique_state_count = len(self._generated)

@@ -52,6 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.expand import (discovery_candidates, eventually_indices,
                           expand_frontier)
+from ..ops.hash_kernel import fp64_node_device
 from ..ops.hashtable import table_insert
 
 
@@ -111,7 +112,7 @@ _SHARDED_CACHE: dict = {}
 
 def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                            capacity: int, fmax: int,
-                           symmetry: bool = False):
+                           symmetry: bool = False, sound: bool = False):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
@@ -119,6 +120,12 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     ``chunk(carry, target_remaining, grow_limit) -> carry`` where
     ``grow_limit`` bounds any single shard's log length (the host grows all
     buffers when a shard approaches its slice capacity).
+
+    With ``sound`` (``CheckerBuilder.sound_eventually()``), dedup,
+    ownership routing, and the log work on (state, pending-ebits) NODE
+    keys (``fp64_node_device``), while the log's original-fp columns
+    record plain state fingerprints for replay — the SPMD analog of the
+    single-chip sound mode (`checker/device_loop.py`).
 
     Memoized like the single-chip chunk (`checker/device_loop.py`).
     """
@@ -128,12 +135,12 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     key = None
     if mkey is not None:
         key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax,
-               symmetry)
+               symmetry, sound)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
-                                 fmax, symmetry)
+                                 fmax, symmetry, sound)
     if key is not None:
         if len(_SHARDED_CACHE) >= 64:
             _SHARDED_CACHE.clear()
@@ -143,7 +150,8 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
 def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                             capacity: int, fmax: int,
-                            symmetry: bool = False):
+                            symmetry: bool = False,
+                            sound: bool = False):
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
     qloc = qcap // D
@@ -187,13 +195,22 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # shared check_block analog (ops/expand.py) on local rows
         exp = expand_frontier(model, frontier, fvalid, ebits,
                               eventually_idx, symmetry=symmetry)
-        par_hi = jnp.repeat(exp.phi, n_actions)
-        par_lo = jnp.repeat(exp.plo, n_actions)
-        ceb = jnp.repeat(exp.ebits, n_actions)
-        if kbits:
-            owner = exp.chi >> jnp.uint32(32 - kbits)
+        if sound:
+            # node keys: dedup/routing identity = (state fp, pending
+            # ebits); the parent's node used its at-enqueue bits
+            p_whi, p_wlo = fp64_node_device(exp.phi, exp.plo, ebits)
+            ceb = jnp.repeat(exp.ebits, n_actions)
+            k_chi, k_clo = fp64_node_device(exp.chi, exp.clo, ceb)
         else:
-            owner = jnp.zeros_like(exp.chi)
+            p_whi, p_wlo = exp.phi, exp.plo
+            ceb = jnp.repeat(exp.ebits, n_actions)
+            k_chi, k_clo = exp.chi, exp.clo
+        par_hi = jnp.repeat(p_whi, n_actions)
+        par_lo = jnp.repeat(p_wlo, n_actions)
+        if kbits:
+            owner = k_chi >> jnp.uint32(32 - kbits)
+        else:
+            owner = jnp.zeros_like(k_chi)
 
         q_head = q_head + take
         key_hi, key_lo = c.key_hi, c.key_lo
@@ -205,8 +222,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
         # ownership routing: D hops around the ring; each shard claims and
         # dedups the in-flight children it owns, then forwards the rest
-        rc = (exp.flat, exp.chi, exp.clo, par_hi, par_lo, ceb, exp.cvalid,
-              owner) + ((exp.ohi, exp.olo) if symmetry else ())
+        rc = (exp.flat, k_chi, k_clo, par_hi, par_lo, ceb, exp.cvalid,
+              owner) + ((exp.ohi, exp.olo) if symmetry or sound else ())
         for hop in range(D):
             (flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c, val_c,
              own_c) = rc[:8]
@@ -224,7 +241,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             log_clo = log_clo.at[lidx].set(clo_c, mode="drop")
             log_phi = log_phi.at[lidx].set(phi_c, mode="drop")
             log_plo = log_plo.at[lidx].set(plo_c, mode="drop")
-            if symmetry:
+            if symmetry or sound:
                 log_ohi = log_ohi.at[lidx].set(rc[8], mode="drop")
                 log_olo = log_olo.at[lidx].set(rc[9], mode="drop")
             q_tail = q_tail + cnt
@@ -237,7 +254,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
         if prop_count:
             hit_l, cand_hi, cand_lo = discovery_candidates(
-                properties, exp, fvalid)
+                properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
             sel = jnp.where(hit_l, me, jnp.uint32(D))
             min_shard = lax.pmin(sel, axis)
             pick = hit_l & (me == min_shard)
@@ -392,8 +409,8 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
 
 def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                        capacity: int, init_rows, init_fps, full_ebits,
-                       prop_count: int,
-                       symmetry: bool = False) -> ShardedCarry:
+                       prop_count: int, symmetry: bool = False,
+                       sound: bool = False) -> ShardedCarry:
     """Host-side construction of the initial sharded carry: init states
     routed to their owner shards' queues. The caller inserts the init
     fingerprints into the table via :func:`build_sharded_insert`."""
@@ -426,10 +443,10 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
         log_clo=put(np.zeros((capacity,), np.uint32), sh),
         log_phi=put(np.zeros((capacity,), np.uint32), sh),
         log_plo=put(np.zeros((capacity,), np.uint32), sh),
-        log_ohi=put(np.zeros((capacity if symmetry else D,), np.uint32),
-                    sh),
-        log_olo=put(np.zeros((capacity if symmetry else D,), np.uint32),
-                    sh),
+        log_ohi=put(np.zeros((capacity if symmetry or sound else D,),
+                             np.uint32), sh),
+        log_olo=put(np.zeros((capacity if symmetry or sound else D,),
+                             np.uint32), sh),
         log_n=put(np.zeros((D,), np.int32), sh),
         disc_hit=put(np.zeros((prop_count,), bool), rep),
         disc_hi=put(np.zeros((prop_count,), np.uint32), rep),
