@@ -105,6 +105,16 @@ class PackedRegisterModel(PackedActorModel):
         self.history_width = 1 + 3 * client_count
         self.max_sends = max_sends
         self.host_property_indices = (0,)  # linearizable
+        if ordered:
+            # declare the flows the register protocol actually uses —
+            # client<->server and server<->server; client<->client FIFOs
+            # would waste ~30% row width (and expansion lanes)
+            servers = range(server_count)
+            clients = range(server_count, server_count + client_count)
+            self.ordered_channels = (
+                [(c, s) for c in clients for s in servers]
+                + [(s, c) for s in servers for c in clients]
+                + [(s, t) for s in servers for t in servers if s != t])
         self.finalize_layout()
 
     # --- subclass interface ----------------------------------------------
