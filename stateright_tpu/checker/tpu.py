@@ -272,12 +272,14 @@ class TpuChecker(HostChecker):
         self._host_props = [
             (i, self._properties[i])
             for i in getattr(model, "host_property_indices", ())]
-        for _i, prop in self._host_props:
-            if prop.expectation == Expectation.EVENTUALLY:
-                raise NotImplementedError(
-                    "host-evaluated eventually properties are not "
-                    "supported on the TPU engine; evaluate them with the "
-                    "host engines")
+        # host-evaluated EVENTUALLY properties run on the per-level
+        # engine: the device never clears their ebits (the packed
+        # placeholder bit must be False); the host evaluates each new
+        # state's condition (memoized by host_property_key) and corrects
+        # its ebits before it is enqueued, so terminal flushes report
+        # faithful counterexamples
+        self._host_ev = [(i, p) for i, p in self._host_props
+                         if p.expectation == Expectation.EVENTUALLY]
         self._host_prop_cache: Dict[bytes, List[bool]] = {}
         # sound-eventually mode: dedup on (state, pending-ebits) NODE keys
         # (`fingerprint.fp64_node`), fixing the reference's documented
@@ -371,9 +373,16 @@ class TpuChecker(HostChecker):
             mode = "level"
         # host-evaluated properties run on either engine: the per-level
         # engine evaluates them on each level's new states; the device
-        # engine evaluates them post-hoc over the distinct host-property
-        # keys of the entire reached set (the append-only queue retains
-        # every unique state's packed row)
+        # engine evaluates them via the in-carry history dedup. Host
+        # EVENTUALLY properties need their per-row ebits corrected before
+        # each state is enqueued, which only the per-level orchestration
+        # provides.
+        if self._host_ev:
+            if mode == "device":
+                raise NotImplementedError(
+                    "host-evaluated eventually properties need the "
+                    "per-level engine; drop tpu_options(mode='device')")
+            mode = "level"
         if self._resume_path is not None and mode == "level":
             raise NotImplementedError(
                 "resume_from() requires the device engine; drop the "
@@ -1047,7 +1056,12 @@ class TpuChecker(HostChecker):
                                    for i in eventually_indices(properties)))
         generated = self._generated
         discoveries = self._discovery_fps
-        host_prop_idx = {i for i, _p in self._host_props}
+        # host ALWAYS/SOMETIMES bits are placeholders on device; host
+        # EVENTUALLY discoveries come from the device's terminal flush
+        # over host-corrected ebits, so their device bits are authoritative
+        host_prop_idx = {i for i, p in self._host_props
+                         if p.expectation != Expectation.EVENTUALLY}
+        host_ev = self._host_ev
         target = self._target_state_count
         visitor = self._visitor
 
@@ -1079,6 +1093,10 @@ class TpuChecker(HostChecker):
             rows = np.zeros((bucket, width), dtype=np.uint32)
             rows[:fcount] = np.stack(chunk)
             ebs = np.full((bucket,), full_ebits, dtype=np.uint32)
+            if host_ev:
+                for j in range(fcount):
+                    ebs[j] &= ~np.uint32(
+                        self._host_ev_clear_bits(chunk[j]))
             segments.append((jnp.asarray(rows), jnp.asarray(ebs), 0, fcount))
 
         # --- search loop ------------------------------------------------
@@ -1153,11 +1171,23 @@ class TpuChecker(HostChecker):
                         for _i, p in self._host_props):
                     # skip the row pull + decode once every host property
                     # already has its discovery
+                    nb = _bucket(count)
                     rows_h = np.asarray(jax.device_get(take_rows_fn(
-                        comp_rows, _bucket(count))))
+                        comp_rows, nb)))
+                    ev_clear = (np.zeros((nb,), np.uint32)
+                                if host_ev else None)
                     for k in range(count):
                         self._eval_host_props_row(
                             rows_h[k], int(fp_c[k]), discoveries)
+                        if host_ev:
+                            ev_clear[k] = self._host_ev_clear_bits(
+                                rows_h[k])
+                    if host_ev and ev_clear.any():
+                        # correct the new states' ebits BEFORE they are
+                        # enqueued: the device cannot evaluate these
+                        # conditions, so their bits only clear here
+                        comp_eb = self._clear_ebits_jit(nb)(
+                            comp_eb, jnp.asarray(ev_clear))
             self._unique_state_count = len(generated)
 
             if len(discoveries) == prop_count:
@@ -1189,11 +1219,34 @@ class TpuChecker(HostChecker):
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
 
-    def _eval_host_props_row(self, row, fp: int,
-                             discoveries: Dict[str, int]) -> None:
-        """Evaluate host properties on one newly inserted packed state,
-        memoized by ``model.host_property_key`` (e.g. distinct histories
-        recur across thousands of states)."""
+    _CLEAR_JITS: dict = {}
+
+    @classmethod
+    def _clear_ebits_jit(cls, n: int):
+        """Jitted per-bucket helper: clear host-corrected eventually bits
+        on the first ``n`` rows of a compacted child-ebits buffer."""
+        fn = cls._CLEAR_JITS.get(n)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            def clear(eb, mask):
+                return eb.at[:n].set(eb[:n] & ~mask)
+
+            fn = cls._CLEAR_JITS[n] = jax.jit(clear)
+        return fn
+
+    def _host_ev_clear_bits(self, row) -> int:
+        """Bitmask of host-evaluated EVENTUALLY properties whose condition
+        holds on this packed state (memoized with the other host props)."""
+        results = self._host_props_results(row)
+        bits = 0
+        for (i, prop), res in zip(self._host_props, results):
+            if prop.expectation == Expectation.EVENTUALLY and res:
+                bits |= 1 << i
+        return bits
+
+    def _host_props_results(self, row) -> List[bool]:
         model = self._model
         key = model.host_property_key(row)
         results = self._host_prop_cache.get(key)
@@ -1202,6 +1255,14 @@ class TpuChecker(HostChecker):
             results = [bool(prop.condition(model, state))
                        for _i, prop in self._host_props]
             self._host_prop_cache[key] = results
+        return results
+
+    def _eval_host_props_row(self, row, fp: int,
+                             discoveries: Dict[str, int]) -> None:
+        """Evaluate host properties on one newly inserted packed state,
+        memoized by ``model.host_property_key`` (e.g. distinct histories
+        recur across thousands of states)."""
+        results = self._host_props_results(row)
         for (i, prop), res in zip(self._host_props, results):
             if prop.name in discoveries:
                 continue

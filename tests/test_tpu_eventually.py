@@ -72,3 +72,75 @@ class TestTpuEventually:
         dev = check_tpu(g, mode)
         assert (dev.generated_fingerprints()
                 == host.generated_fingerprints())
+
+
+class _HostEvDGraph(PackedDGraph):
+    """PackedDGraph whose eventually-property is HOST-evaluated: the
+    packed placeholder bit is always False, so the device cannot clear
+    it — only the engine's per-level host correction can."""
+
+    host_property_indices = (0,)
+
+    @staticmethod
+    def from_graph(g: PackedDGraph) -> "_HostEvDGraph":
+        h = _HostEvDGraph(g.prop)
+        h.inits = set(g.inits)
+        h.edges = {k: set(v) for k, v in g.edges.items()}
+        return h
+
+    def packed_properties(self, words):
+        import jax.numpy as jnp
+        return jnp.zeros((1,), bool)
+
+    def host_property_key(self, row) -> bytes:
+        import numpy as np
+        return np.asarray(row, np.uint32).tobytes()
+
+    def cache_key(self):
+        return ("hostev",) + super().cache_key()
+
+
+class TestHostEventuallyOnDevice:
+    """Host-evaluated EVENTUALLY properties on the device engine: the
+    host corrects each new state's ebits before enqueue, so terminal
+    flushes match the host engines' verdicts exactly."""
+
+    def _make(self, paths):
+        g = PackedDGraph.with_property(eventually_odd())
+        for p in paths:
+            g = g.with_path(p)
+        return _HostEvDGraph.from_graph(g)
+
+    def _check(self, g):
+        return (g.checker().tpu_options(capacity=1 << 10, fmax=16)
+                .spawn_tpu().join())
+
+    def test_counterexample_found(self):
+        # 0 -> 2 -> 4, all even: the terminal flush must fire from the
+        # host-corrected (never-cleared) bit
+        c = self._check(self._make([[0, 2, 4]]))
+        states = c.assert_any_discovery("odd").into_states()
+        assert states == [0, 2, 4]
+
+    def test_satisfied_path_clears(self):
+        # 0 -> 1(odd) -> 2: the host clears the bit at 1, so the
+        # terminal 2 must NOT flush a counterexample
+        self._check(self._make([[0, 1, 2]])).assert_properties()
+
+    def test_matches_host_bfs(self):
+        for paths in ([[1], [2, 3], [2, 6, 7], [4, 9, 10]],
+                      [[0, 2, 4], [1, 4, 6]],   # DAG-rejoin caveat
+                      [[0, 2, 4, 2]]):          # cycle caveat
+            g = self._make(paths)
+            dev = self._check(g)
+            host = g.checker().spawn_bfs().join()
+            assert (dev.discovery("odd") is None) \
+                == (host.discovery("odd") is None), paths
+            assert (dev.generated_fingerprints()
+                    == host.generated_fingerprints())
+
+    def test_device_mode_rejected(self):
+        g = self._make([[0, 2]])
+        with pytest.raises(NotImplementedError):
+            (g.checker().tpu_options(capacity=1 << 10, mode="device")
+             .spawn_tpu().join())
