@@ -51,10 +51,11 @@ class ShardedTpuChecker(TpuChecker):
             raise ValueError(
                 "visitors are a host feature; use single-chip spawn_tpu "
                 "(per-level mode) or the host engines")
-        if builder.resume_path_ is not None:
+        if builder.resume_path_ is not None and (
+                self._symmetry or getattr(self, "_sound", False)):
             raise NotImplementedError(
-                "checkpoint resume is not supported on the sharded "
-                "engine; use single-chip spawn_tpu")
+                "checkpoint resume under symmetry/sound_eventually is "
+                "not supported")
         if getattr(self, "_sound", False) and self._host_props:
             raise NotImplementedError(
                 "sound_eventually() with host-evaluated properties is "
@@ -79,9 +80,19 @@ class ShardedTpuChecker(TpuChecker):
         opts = self._tpu_options
         k_steps = int(opts.get("chunk_steps", 64))
 
-        init_rows = self._seed_inits()
-        init_fps = list(generated.keys())
-        n_init = len(init_fps)
+        if self._resume_path is not None:
+            # checkpoints are shard-agnostic (the single-chip format):
+            # the frontier re-routes by owner on THIS mesh, which may
+            # differ from the mesh (or single chip) that wrote it
+            init_rows, seed_ebits, frontier_fps = self._load_checkpoint(
+                discoveries)
+        else:
+            init_rows = self._seed_inits()
+            seed_ebits = full_ebits
+            frontier_fps = list(generated.keys())
+        table_fps = list(generated.keys())
+        base_unique = len(generated)
+        n_init = len(init_rows)
         if prop_count == 0:
             return  # vacuously done (bfs.rs:121-128)
 
@@ -89,24 +100,26 @@ class ShardedTpuChecker(TpuChecker):
         headroom = D * fmax * n_actions
         # per-shard slice must keep one worst-case iteration of headroom
         # below the growth limit (same invariant as the single-chip loop)
-        while self._grow_at * (self._capacity // D) <= headroom + n_init:
+        while self._grow_at * (self._capacity // D) \
+                <= headroom + len(table_fps):
             self._capacity *= 4
         qcap = self._sharded_qcap(n_init, headroom, D)
         # per-shard init fps in queue order (post-hoc witness mapping)
         init_by_shard: List[List[int]] = [[] for _ in range(D)]
-        for fp in init_fps:
+        for fp in frontier_fps:
             init_by_shard[owner_of(fp, D)].append(fp)
         self._init_by_shard = init_by_shard
         n_init_arr = np.asarray([len(b) for b in init_by_shard], np.int32)
 
         insert_fn = build_sharded_insert(mesh, axis)
-        seed_ebits = full_ebits
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
-                                   init_rows, init_fps, seed_ebits,
+                                   init_rows, frontier_fps, seed_ebits,
                                    prop_count, symmetry=self._symmetry,
                                    sound=self._sound)
+        # the table seeds with EVERYTHING known (on resume: the whole
+        # mirrored reached set, not just the pending frontier)
         key_hi, key_lo = self._sharded_bulk_insert(
-            insert_fn, carry.key_hi, carry.key_lo, init_fps, D)
+            insert_fn, carry.key_hi, carry.key_lo, table_fps, D)
         carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
         chunk_fn = build_sharded_chunk_fn(model, mesh, axis, qcap,
                                           self._capacity, fmax,
@@ -132,7 +145,7 @@ class ShardedTpuChecker(TpuChecker):
                  carry.disc_hi, carry.disc_lo, carry.gen, carry.ovf,
                  carry.xovf))
             self._state_count += int(gen)
-            self._unique_state_count = n_init + int(log_n.sum())
+            self._unique_state_count = base_unique + int(log_n.sum())
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:
@@ -163,11 +176,24 @@ class ShardedTpuChecker(TpuChecker):
                          or int(q_tail.max()) > qcap // D - headroom)
             if need_grow:
                 carry, qcap = self._grow_sharded(
-                    carry, qcap, n_init, headroom, init_fps, insert_fn)
+                    carry, qcap, n_init, headroom, table_fps, insert_fn)
                 chunk_fn = build_sharded_chunk_fn(
                     model, mesh, axis, qcap, self._capacity, fmax,
                     symmetry=self._symmetry, sound=self._sound)
 
+        if self._tpu_options.get("resumable"):
+            # pull the pending per-shard frontiers eagerly so save()
+            # needs no pinned device buffers; the checkpoint format is
+            # the single-chip one (shard-agnostic)
+            qloc = qcap // D
+            q_rows_h, q_eb_h, qh, qt = jax.device_get(
+                (carry.q_rows, carry.q_eb, carry.q_head, carry.q_tail))
+            rows_l = [q_rows_h[s * qloc + int(qh[s]):
+                               s * qloc + int(qt[s])] for s in range(D)]
+            ebs_l = [q_eb_h[s * qloc + int(qh[s]):
+                            s * qloc + int(qt[s])] for s in range(D)]
+            self._resume_frontier = (np.concatenate(rows_l),
+                                     np.concatenate(ebs_l))
         self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
 

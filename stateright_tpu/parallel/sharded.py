@@ -420,11 +420,15 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
     q_rows = np.zeros((qcap, width), dtype=np.uint32)
     q_eb = np.zeros((qcap,), dtype=np.uint32)
     q_tail = np.zeros((D,), dtype=np.int32)
-    for row, fp in zip(init_rows, init_fps):
+    # scalar ebits for fresh runs, per-row when resuming a checkpointed
+    # frontier
+    ebs = np.broadcast_to(np.asarray(full_ebits, np.uint32),
+                          (len(init_rows),))
+    for i, (row, fp) in enumerate(zip(init_rows, init_fps)):
         s = owner_of(fp, D)
         assert q_tail[s] < qloc, "init states overflow a shard queue"
         q_rows[s * qloc + q_tail[s]] = row
-        q_eb[s * qloc + q_tail[s]] = full_ebits
+        q_eb[s * qloc + q_tail[s]] = ebs[i]
         q_tail[s] += 1
 
     sh = NamedSharding(mesh, P(axis))

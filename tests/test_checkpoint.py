@@ -87,3 +87,77 @@ class TestCheckpointResume:
         with pytest.raises(RuntimeError, match="different model"):
             (TwoPhaseSys(4).checker().tpu_options(capacity=1 << 12)
              .resume_from(path).spawn_tpu().join())
+
+
+class TestShardedCheckpointResume:
+    """Checkpoint/resume on the SPMD sharded engine: the format is
+    shard-agnostic, so a checkpoint written on one mesh resumes on a
+    different shard count (or single-chip) — the frontier re-routes by
+    fingerprint ownership at seed time."""
+
+    def _mesh(self, n):
+        from jax.sharding import Mesh
+        return Mesh(jax.devices("cpu")[:n], ("shards",))
+
+    def _partial(self, path, n_shards):
+        model = TwoPhaseSys(5)  # 8,832 states (2pc.rs:133)
+        partial = (model.checker()
+                   .tpu_options(capacity=1 << 14, resumable=True,
+                                fmax=32, chunk_steps=4,
+                                mesh=self._mesh(n_shards))
+                   .target_state_count(2000)
+                   .spawn_tpu().join())
+        assert partial.unique_state_count() < 8832
+        partial.save(path)
+        return partial
+
+    @pytest.mark.parametrize("n_shards", [2, 4])
+    def test_sharded_partial_resumes_sharded(self, tmp_path, n_shards):
+        path = tmp_path / "ckpt.npz"
+        partial = self._partial(path, n_shards)
+        resumed = (TwoPhaseSys(5).checker()
+                   .tpu_options(capacity=1 << 14,
+                                mesh=self._mesh(n_shards))
+                   .resume_from(path)
+                   .spawn_tpu().join())
+        assert resumed.unique_state_count() == 8832
+        full = TwoPhaseSys(5).checker().spawn_bfs().join()
+        assert (resumed.generated_fingerprints()
+                == full.generated_fingerprints())
+        assert resumed.state_count() >= partial.state_count()
+
+    def test_two_shard_checkpoint_resumes_on_four(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        self._partial(path, 2)
+        resumed = (TwoPhaseSys(5).checker()
+                   .tpu_options(capacity=1 << 14, mesh=self._mesh(4))
+                   .resume_from(path)
+                   .spawn_tpu().join())
+        assert resumed.unique_state_count() == 8832
+        full = TwoPhaseSys(5).checker().spawn_bfs().join()
+        assert (resumed.generated_fingerprints()
+                == full.generated_fingerprints())
+
+    def test_sharded_checkpoint_resumes_single_chip(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        self._partial(path, 2)
+        resumed = (TwoPhaseSys(5).checker()
+                   .tpu_options(capacity=1 << 14)
+                   .resume_from(path)
+                   .spawn_tpu().join())
+        assert resumed.unique_state_count() == 8832
+
+    def test_single_chip_checkpoint_resumes_sharded(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        partial = (TwoPhaseSys(5).checker()
+                   .tpu_options(capacity=1 << 14, resumable=True,
+                                fmax=64, chunk_steps=4)
+                   .target_state_count(2000)
+                   .spawn_tpu().join())
+        assert partial.unique_state_count() < 8832
+        partial.save(path)
+        resumed = (TwoPhaseSys(5).checker()
+                   .tpu_options(capacity=1 << 14, mesh=self._mesh(2))
+                   .resume_from(path)
+                   .spawn_tpu().join())
+        assert resumed.unique_state_count() == 8832
