@@ -4,9 +4,22 @@ ordering, resends-until-ack, and redelivery suppression.
 Port of `/root/reference/src/actor/ordered_reliable_link.rs:29-148` — the
 reference's "reliable transport" layered over the fire-and-forget UDP
 runtime. Wraps any :class:`~stateright_tpu.actor.core.Actor`; assumes no
-actor restarts. The wrapped actor's ``SetTimer``/``CancelTimer`` are
-unsupported (the wrapper owns the timer), mirroring the reference's
-``todo!()`` (`ordered_reliable_link.rs:130-148`).
+actor restarts.
+
+Wrapped-actor timers — the part the reference left as ``todo!()``
+(`ordered_reliable_link.rs:130-148`) — are supported by multiplexing the
+single per-actor timer onto the wrapper's resend cadence: the physical
+timer stays armed at the resend interval (never reset by message
+traffic, so steady traffic cannot starve resends), and a wrapped
+``SetTimer`` is tracked as a countdown of physical firings sized to
+approximate the requested interval (``ceil(wanted / resend)`` firings).
+Each firing resends everything unacked; when the countdown reaches
+zero, the wrapped ``on_timeout`` runs too. At runtime, wrapped timers
+therefore fire with resend-interval granularity; under the model
+checker (where timers are zero-duration abstractions,
+``model_timeout``) the countdown is one firing, and the two logical
+timers fire as one combined action — a sound coarsening, since both
+handlers are individually enabled whenever the combined action is.
 """
 
 from __future__ import annotations
@@ -41,6 +54,11 @@ class StateWrapper:
     # receive (ack'ing) side
     last_delivered_seqs: frozenset  # {(src, seq)}
     wrapped_state: Any
+    # the wrapped actor's logical timer: its requested interval when
+    # set, None otherwise (multiplexed onto the one physical timer)
+    wrapped_timer: Optional[Tuple[float, float]] = None
+    # physical firings left before the wrapped timer is due
+    wrapped_fires_left: int = 0
 
 
 def _last_delivered(state: StateWrapper, src: Id) -> int:
@@ -64,17 +82,37 @@ class ActorWrapper(Actor):
         return ActorWrapper(wrapped_actor)
 
     # ------------------------------------------------------------------
+    def _countdown(self, interval: Tuple[float, float]) -> int:
+        """Physical firings approximating the wrapped interval (>= 1;
+        under the model checker timers are zero-duration, so this is 1
+        and the wrapped timer fires at the next combined firing)."""
+        r = self.resend_interval[0]
+        if r <= 0 or interval[0] <= 0:
+            return 1
+        return max(1, -(-int(interval[0] * 1000) // int(r * 1000)))
+
     def _process_output(self, state: StateWrapper, wrapped_out: Out,
                         o: Out) -> StateWrapper:
-        """Wrap inner Sends as sequenced Delivers
-        (`ordered_reliable_link.rs:122-148`)."""
+        """Wrap inner Sends as sequenced Delivers; fold inner timer
+        commands into the multiplexed physical timer
+        (`ordered_reliable_link.rs:122-148` — the SetTimer/CancelTimer
+        arms the reference stubbed with ``todo!()``). The physical
+        timer is never re-armed here: resetting the resend deadline on
+        every wrapped SetTimer would let steady traffic starve resends."""
         next_seq = state.next_send_seq
         pending = set(state.msgs_pending_ack)
+        wrapped_timer = state.wrapped_timer
+        fires_left = state.wrapped_fires_left
         for command in wrapped_out:
-            if isinstance(command, (SetTimer, CancelTimer)):
-                raise NotImplementedError(
-                    "timers of ORL-wrapped actors are not supported at "
-                    "this time")
+            if isinstance(command, SetTimer):
+                wrapped_timer = (command.min_seconds,
+                                 command.max_seconds)
+                fires_left = self._countdown(wrapped_timer)
+                continue
+            if isinstance(command, CancelTimer):
+                wrapped_timer = None
+                fires_left = 0
+                continue
             assert isinstance(command, Send)
             o.send(command.dst, Deliver(next_seq, command.msg))
             pending.add((next_seq, (command.dst, command.msg)))
@@ -83,7 +121,9 @@ class ActorWrapper(Actor):
             next_send_seq=next_seq,
             msgs_pending_ack=frozenset(pending),
             last_delivered_seqs=state.last_delivered_seqs,
-            wrapped_state=state.wrapped_state)
+            wrapped_state=state.wrapped_state,
+            wrapped_timer=wrapped_timer,
+            wrapped_fires_left=fires_left)
 
     def on_start(self, id: Id, o: Out) -> StateWrapper:
         o.set_timer(self.resend_interval)
@@ -116,7 +156,9 @@ class ActorWrapper(Actor):
                 msgs_pending_ack=state.msgs_pending_ack,
                 last_delivered_seqs=delivered,
                 wrapped_state=state.wrapped_state if next_wrapped is None
-                else next_wrapped)
+                else next_wrapped,
+                wrapped_timer=state.wrapped_timer,
+                wrapped_fires_left=state.wrapped_fires_left)
             return self._process_output(new_state, wrapped_out, o)
 
         if isinstance(msg, Ack):
@@ -129,15 +171,41 @@ class ActorWrapper(Actor):
                 next_send_seq=state.next_send_seq,
                 msgs_pending_ack=remaining,
                 last_delivered_seqs=state.last_delivered_seqs,
-                wrapped_state=state.wrapped_state)
+                wrapped_state=state.wrapped_state,
+                wrapped_timer=state.wrapped_timer,
+                wrapped_fires_left=state.wrapped_fires_left)
         return None
 
     def on_timeout(self, id: Id, state: StateWrapper,
                    o: Out) -> Optional[StateWrapper]:
-        """Re-arm and resend everything unacked
-        (`ordered_reliable_link.rs:117-127`)."""
+        """Re-arm, resend everything unacked
+        (`ordered_reliable_link.rs:117-127`), and fire the wrapped
+        actor's logical timer when its countdown is due (the
+        multiplexed firing — see the module docstring)."""
         o.set_timer(self.resend_interval)
         for seq, (dst, msg) in sorted(state.msgs_pending_ack,
                                       key=lambda e: e[0]):
             o.send(dst, Deliver(seq, msg))
-        return None
+        if state.wrapped_timer is None:
+            return None
+        if state.wrapped_fires_left > 1:
+            return StateWrapper(
+                next_send_seq=state.next_send_seq,
+                msgs_pending_ack=state.msgs_pending_ack,
+                last_delivered_seqs=state.last_delivered_seqs,
+                wrapped_state=state.wrapped_state,
+                wrapped_timer=state.wrapped_timer,
+                wrapped_fires_left=state.wrapped_fires_left - 1)
+        # due: the firing consumes the wrapped logical timer; the
+        # wrapped handler may re-set it via its output commands
+        wrapped_out = Out()
+        next_wrapped = self.wrapped_actor.on_timeout(
+            id, state.wrapped_state, wrapped_out)
+        new_state = StateWrapper(
+            next_send_seq=state.next_send_seq,
+            msgs_pending_ack=state.msgs_pending_ack,
+            last_delivered_seqs=state.last_delivered_seqs,
+            wrapped_state=state.wrapped_state if next_wrapped is None
+            else next_wrapped,
+            wrapped_timer=None, wrapped_fires_left=0)
+        return self._process_output(new_state, wrapped_out, o)

@@ -124,10 +124,12 @@ class CheckerBuilder:
         from .tpu import TpuChecker
         return TpuChecker(self)
 
-    def serve(self, address) -> "Checker":
-        """Start the Explorer web service (`src/checker.rs:99-114`)."""
+    def serve(self, address, engine: str = "bfs") -> "Checker":
+        """Start the Explorer web service (`src/checker.rs:99-114`).
+        ``engine="tpu"`` runs the device engine behind the browser (the
+        reference always spawns BFS, `explorer.rs:85-88`)."""
         from .explorer import serve as explorer_serve
-        return explorer_serve(self, address)
+        return explorer_serve(self, address, engine=engine)
 
 
 class Checker:

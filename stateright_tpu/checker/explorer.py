@@ -195,26 +195,46 @@ def _make_handler(checker, snapshot: Optional[Snapshot]):
 
 
 def serve(checker_builder, address: Tuple[str, int] | str,
-          block: bool = True):
+          block: bool = True, engine: str = "bfs"):
     """Start checking in the background and serve the Explorer
     (`explorer.rs:71-89`). ``address`` is ``(host, port)`` or
     ``"host:port"``. With ``block=False`` returns ``(checker, server)``
     and serves on a daemon thread (used by tests and ``explore``
-    subcommands that poll)."""
+    subcommands that poll).
+
+    ``engine`` selects the background checker: ``"bfs"`` (the
+    reference's fixed choice, `explorer.rs:85-88`), ``"dfs"``, or
+    ``"tpu"`` — the browser then watches a device-engine run live via
+    ``/.status`` (per-chunk counts; the recent-path sample needs the
+    per-state visitor, a host feature, so it stays empty). State
+    browsing via ``/.states`` replays through the host model either
+    way."""
     if isinstance(address, str):
         host, _, port = address.rpartition(":")
         address = (host or "localhost", int(port))
 
-    snapshot = Snapshot()
-    checker = checker_builder.visitor(snapshot).spawn_bfs()
+    if engine == "tpu":
+        snapshot = None
+        checker = checker_builder.spawn_tpu()
+    elif engine == "dfs":
+        snapshot = Snapshot()
+        checker = checker_builder.visitor(snapshot).spawn_dfs()
+    elif engine == "bfs":
+        snapshot = Snapshot()
+        checker = checker_builder.visitor(snapshot).spawn_bfs()
+    else:
+        raise ValueError(
+            f"unknown explorer engine {engine!r}; expected 'bfs', "
+            "'dfs', or 'tpu'")
     checker._start_background()
 
-    def rearm_loop():
-        while True:
-            time.sleep(4)
-            snapshot.rearm()
+    if snapshot is not None:
+        def rearm_loop():
+            while True:
+                time.sleep(4)
+                snapshot.rearm()
 
-    threading.Thread(target=rearm_loop, daemon=True).start()
+        threading.Thread(target=rearm_loop, daemon=True).start()
 
     server = ThreadingHTTPServer(address, _make_handler(checker, snapshot))
     if block:

@@ -103,13 +103,16 @@ class ShardedTpuChecker(TpuChecker):
         while self._grow_at * (self._capacity // D) \
                 <= headroom + len(table_fps):
             self._capacity *= 4
-        qcap = self._sharded_qcap(n_init, headroom, D)
-        # per-shard init fps in queue order (post-hoc witness mapping)
+        # per-shard init fps in queue order (post-hoc witness mapping);
+        # the queue slices are sized from the per-shard split, not the
+        # total frontier (a resumed frontier routes ~1/D to each shard)
         init_by_shard: List[List[int]] = [[] for _ in range(D)]
         for fp in frontier_fps:
             init_by_shard[owner_of(fp, D)].append(fp)
         self._init_by_shard = init_by_shard
         n_init_arr = np.asarray([len(b) for b in init_by_shard], np.int32)
+        qcap = self._sharded_qcap(
+            max((len(b) for b in init_by_shard), default=0), headroom, D)
 
         insert_fn = build_sharded_insert(mesh, axis)
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,

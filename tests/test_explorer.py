@@ -153,6 +153,49 @@ class TestHttpSmoke:
             server.server_close()
 
 
+class TestTpuEngineExplorer:
+    def test_device_run_behind_browser(self):
+        # serve(engine="tpu"): /.status counts come live from the device
+        # chunk loop; /.states replays through the host model
+        import pytest
+
+        pytest.importorskip("jax")
+        builder = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12))
+        checker, server = serve(builder, ("127.0.0.1", 0), block=False,
+                                engine="tpu")
+        host, port = server.server_address
+        base = f"http://{host}:{port}"
+        try:
+            # /.status responds mid-run too (counts may be partial)
+            with urllib.request.urlopen(f"{base}/.status") as r:
+                json.loads(r.read())
+            checker.join()
+            with urllib.request.urlopen(f"{base}/.status") as r:
+                status = json.loads(r.read())
+            assert status["done"] is True
+            assert status["unique_state_count"] == 288
+            # the sometimes-properties carry encoded discovery paths
+            discs = [p for p in status["properties"] if p[2]]
+            assert discs
+            with urllib.request.urlopen(f"{base}/.states/") as r:
+                inits = json.loads(r.read())
+            fp = inits[0]["fingerprint"]
+            with urllib.request.urlopen(f"{base}/.states/{fp}") as r:
+                steps = json.loads(r.read())
+            assert steps and "action" in steps[0]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_unknown_engine_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="unknown explorer engine"):
+            serve(TwoPhaseSys(2).checker(), ("127.0.0.1", 0),
+                  block=False, engine="warp")
+
+
 class TestActorSvg:
     def test_sequence_diagram(self):
         # ping_pong: Deliver arrows + lifelines render; the svg reaches the
