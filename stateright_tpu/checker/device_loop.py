@@ -182,6 +182,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                     symmetry: bool, sound: bool = False, hcap: int = 0,
                     n_init: int = 0):
     n_actions = model.max_actions
+    width = model.packed_width
     properties = model.properties()
     prop_count = len(properties)
     eventually_idx = eventually_indices(properties)
@@ -282,32 +283,49 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             src = shrink_indices(exp.cvalid, kmax_b)
             kvalid = (jnp.arange(kmax_b, dtype=jnp.int32) < vcount) \
                 & ~kovf
-            k_flat = exp.flat[src]
-            k_chi = exp.chi[src]
-            k_clo = exp.clo[src]
-            row = src // n_actions  # parent frontier row per child
-            k_phi = p_whi[row]
-            k_plo = p_wlo[row]
-            k_ceb = exp.ebits[row]
+            # ONE candidate matrix gathered ONCE: per-column gathers were
+            # ~1 ms kernels each at kmax lanes (profiler); the parent
+            # columns are pre-broadcast to the child axis so everything
+            # shares the same source domain
+            cand_cols = [exp.flat,
+                         exp.chi[:, None], exp.clo[:, None],
+                         jnp.repeat(p_whi, n_actions)[:, None],
+                         jnp.repeat(p_wlo, n_actions)[:, None],
+                         jnp.repeat(exp.ebits, n_actions)[:, None]]
+            if symmetry or sound:
+                cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
+            cand = jnp.concatenate(cand_cols, axis=1)
+            k_all = cand[src]
+            k_flat = k_all[:, :width]
+            k_chi = k_all[:, width]
+            k_clo = k_all[:, width + 1]
+            k_phi = k_all[:, width + 2]
+            k_plo = k_all[:, width + 3]
+            k_ceb = k_all[:, width + 4]
             if sound:
                 # keep the canonical state fps for the queue fp cache;
                 # the dedup keys become node keys
                 s_chi, s_clo = k_chi, k_clo
                 k_chi, k_clo = fp64_node_device(k_chi, k_clo, k_ceb)
+                k_all = jnp.concatenate(
+                    [k_all[:, :width],
+                     k_chi[:, None], k_clo[:, None],
+                     k_all[:, width + 2:]], axis=1)
 
             inserted, key_hi, key_lo, t_ovf = table_insert(
                 c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
             t_ovf = t_ovf & ~kovf
             cnt = inserted.sum(dtype=jnp.int32)
 
-            # compact the fresh rows for the block appends
+            # compact the fresh rows for the block appends (one gather)
             src2 = shrink_indices(inserted, kmax_b)
-            n_flat = k_flat[src2]
-            n_eb = k_ceb[src2]
-            n_chi = k_chi[src2]
-            n_clo = k_clo[src2]
-            n_phi = k_phi[src2]
-            n_plo = k_plo[src2]
+            n_all = k_all[src2]
+            n_flat = n_all[:, :width]
+            n_chi = n_all[:, width]
+            n_clo = n_all[:, width + 1]
+            n_phi = n_all[:, width + 2]
+            n_plo = n_all[:, width + 3]
+            n_eb = n_all[:, width + 4]
 
             if hist_on:
                 # dedup the fresh rows by host-property key against the
@@ -367,12 +385,10 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             if symmetry or sound:
                 # the replayable STATE fingerprint per logged node
                 # (exp.ohi aliases the state fp without symmetry)
-                k_ohi = exp.ohi[src]
-                k_olo = exp.olo[src]
                 log_ohi = jax.lax.dynamic_update_slice(
-                    log_ohi, k_ohi[src2], (c.log_n,))
+                    log_ohi, n_all[:, width + 5], (c.log_n,))
                 log_olo = jax.lax.dynamic_update_slice(
-                    log_olo, k_olo[src2], (c.log_n,))
+                    log_olo, n_all[:, width + 6], (c.log_n,))
 
             return c._replace(
                 q_rows=q_rows, q_eb=q_eb, q_fph=q_fph, q_fpl=q_fpl,
