@@ -37,7 +37,7 @@ def tpu_paxos_rate() -> float:
 
     run(50_000)  # warm the jit caches (shapes recur)
     best = None
-    for _ in range(2):
+    for _ in range(3):  # best-of-3: process-level timing is bimodal
         dt, ck = run(500_000)
         rate = ck.unique_state_count() / dt
         best = max(best or rate, rate)

@@ -58,6 +58,7 @@ def make_table(capacity: int):
 
 _BUCKET = 4  # slots probed per round (one contiguous row gather)
 _MIN_NARROW = 256  # floor for the narrow-tail probe width
+_NARROW_THRESHOLD = 4096  # below this, a single probe loop wins
 _CLAIM_CELLS = 1 << 16  # claim-arena floor: full capacity would memset
 #                         MBs per probe round; hashed cells only cost a
 #                         false claim-loss (the loser retries next round)
@@ -141,6 +142,26 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
     klo2 = key_lo.reshape(n_buckets, _BUCKET)
     claim_full = min(capacity, max(_CLAIM_CELLS, _next_pow2(4 * n)))
     token = jnp.arange(1, n + 1, dtype=jnp.uint32)
+
+    if n <= _NARROW_THRESHOLD:
+        # small batches: one plain probe loop — the three-phase narrow-
+        # tail structure below saves lane-width on big batches but its
+        # extra while_loops dominate tiny inserts
+        def cond0(c):
+            unres, _ins, _g, _khi2, _klo2, rounds = c
+            return unres.any() & (rounds < max_rounds)
+
+        def body0(c):
+            unres, ins, g, khi2, klo2, rounds = c
+            unres, ins, g, khi2, klo2 = round_(
+                unres, ins, g, khi2, klo2, fhi, flo, token, claim_full)
+            return unres, ins, g, khi2, klo2, rounds + 1
+
+        unres, inserted, _g, khi2, klo2, _r = lax.while_loop(
+            cond0, body0, (valid, jnp.zeros((n,), dtype=bool), group0,
+                           khi2, klo2, jnp.int32(0)))
+        return (inserted, khi2.reshape(capacity), klo2.reshape(capacity),
+                unres.any())
 
     # --- round 1 at full width -----------------------------------------
     inserted = jnp.zeros((n,), dtype=bool)
