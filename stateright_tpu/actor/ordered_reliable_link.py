@@ -12,18 +12,32 @@ single per-actor timer onto the wrapper's resend cadence: the physical
 timer stays armed at the resend interval (never reset by message
 traffic, so steady traffic cannot starve resends), and a wrapped
 ``SetTimer`` is tracked as a countdown of physical firings sized to
-approximate the requested interval (``ceil(wanted / resend)`` firings).
-Each firing resends everything unacked; when the countdown reaches
-zero, the wrapped ``on_timeout`` runs too. At runtime, wrapped timers
-therefore fire with resend-interval granularity; under the model
-checker (where timers are zero-duration abstractions,
-``model_timeout``) the countdown is one firing, and the two logical
-timers fire as one combined action — a sound coarsening, since both
-handlers are individually enabled whenever the combined action is.
+approximate the requested interval. Each firing resends everything
+unacked and decrements the countdown; the firing *after* the countdown
+reaches zero also runs the wrapped ``on_timeout``.
+
+The countdown is always >= 1, so the resend and the wrapped timeout
+never merge into one atomic action: under the model checker (where
+timers are zero-duration abstractions, ``model_timeout``) the resend
+fires as one ``Timeout`` action and the wrapped handler as a later,
+separate ``Timeout`` action, with every network delivery of the resent
+``Deliver``s explorable in between. (An earlier design fired both in
+one combined action — a reduction that hid interleavings where a
+resent message is consumed before the wrapped timeout runs.) The
+physical firings are themselves coupled in the runtime — every firing
+that runs the wrapped handler has also just resent — so no reachable
+runtime behavior is lost by never exploring "wrapped timeout with no
+prior resend".
+
+At runtime, wrapped timers fire with resend-interval granularity, one
+resend period later than a dedicated timer would (the separation
+above); model checking is unaffected since modeled timers have no
+duration.
 """
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
@@ -83,13 +97,15 @@ class ActorWrapper(Actor):
 
     # ------------------------------------------------------------------
     def _countdown(self, interval: Tuple[float, float]) -> int:
-        """Physical firings approximating the wrapped interval (>= 1;
-        under the model checker timers are zero-duration, so this is 1
-        and the wrapped timer fires at the next combined firing)."""
+        """Physical firings to count down before the wrapped timer is
+        due (>= 1 always, so the resend firing and the wrapped firing
+        stay separate ``Timeout`` actions — see the module docstring).
+        The wrapped handler runs on the firing *after* the countdown
+        hits zero, i.e. ``countdown + 1`` firings after ``SetTimer``."""
         r = self.resend_interval[0]
         if r <= 0 or interval[0] <= 0:
             return 1
-        return max(1, -(-int(interval[0] * 1000) // int(r * 1000)))
+        return max(1, math.ceil(interval[0] / r) - 1)
 
     def _process_output(self, state: StateWrapper, wrapped_out: Out,
                         o: Out) -> StateWrapper:
@@ -180,15 +196,17 @@ class ActorWrapper(Actor):
                    o: Out) -> Optional[StateWrapper]:
         """Re-arm, resend everything unacked
         (`ordered_reliable_link.rs:117-127`), and fire the wrapped
-        actor's logical timer when its countdown is due (the
-        multiplexed firing — see the module docstring)."""
+        actor's logical timer on the firing after its countdown has
+        run out — a separate ``Timeout`` action from the firing(s)
+        that decrement it, so the model checker explores deliveries of
+        resent messages in between (see the module docstring)."""
         o.set_timer(self.resend_interval)
         for seq, (dst, msg) in sorted(state.msgs_pending_ack,
                                       key=lambda e: e[0]):
             o.send(dst, Deliver(seq, msg))
         if state.wrapped_timer is None:
             return None
-        if state.wrapped_fires_left > 1:
+        if state.wrapped_fires_left > 0:
             return StateWrapper(
                 next_send_seq=state.next_send_seq,
                 msgs_pending_ack=state.msgs_pending_ack,

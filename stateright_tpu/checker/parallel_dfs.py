@@ -8,11 +8,16 @@ threads serialize on the GIL, so the host-parallel analog here is
 **process workers over stack jobs**:
 
   * the visited set is a shared-memory open-addressed table of uint64
-    fingerprints (linear probing). Inserts are plain aligned stores —
-    racing workers can each claim the same state and both explore it,
-    the process analog of the reference's benign DashSet races
-    ("Races other threads, but that's fine", `dfs.rs:210,218,297`);
-    the final unique count deduplicates the table exactly.
+    fingerprints (linear probing). Probes are lock-free reads; the
+    store into an empty slot takes a striped lock and re-checks, so no
+    claimed fingerprint is ever lost to a concurrent overwrite. Racing
+    workers can still each claim the same state in *different* slots
+    and both explore it — the process analog of the reference's benign
+    DashSet races ("Races other threads, but that's fine",
+    `dfs.rs:210,218,297`); the final unique count deduplicates the
+    table contents exactly (``np.unique``). Fingerprint 0 collides
+    with the empty-slot sentinel and is remapped to 1 on insert (a
+    benign 1-in-2^64 merge, noted at ``_shared_insert``).
   * jobs are lists of DFS stack entries ``(state, fingerprint-path,
     ebits)``; a worker whose local stack grows splits its bottom half
     back to the job queue whenever the queue runs dry — the reference's
@@ -46,21 +51,43 @@ from .path import Path
 _MAX_PROBE = 1 << 14
 #: expansions between share-step checks
 _SHARE_PERIOD = 256
+#: striped insert locks (contended only when two workers store into the
+#: same stripe at the same instant — inserts happen once per unique state)
+_N_STRIPES = 64
 
 
-def _shared_insert(table, mask: int, fp: int) -> bool:
-    """Insert ``fp``; True iff this worker claimed it first (racy but
-    aligned-atomic per slot; a lost race is benign duplicate work)."""
+def _shared_insert(table, mask: int, fp: int, locks) -> bool:
+    """Insert ``fp``; True iff this worker claimed it first.
+
+    Probing is lock-free; the store into an empty slot takes the slot's
+    striped lock and re-reads, so a claimed fingerprint can never be
+    lost to a concurrent overwrite (two workers that both read a slot
+    as empty would otherwise leave only the second store). Two workers
+    inserting the SAME fingerprint can still both win — in different
+    slots — which is benign duplicate exploration; the master dedups
+    the table contents (``np.unique``) for the exact final count.
+
+    Fingerprint 0 is indistinguishable from the empty-slot sentinel and
+    is remapped to 1 (hash-table sentinel convention); a real fp-1
+    state would merge with it, which is no worse than any other fp64
+    collision.
+    """
+    if fp == 0:
+        fp = 1
     i = fp & mask
     for _ in range(_MAX_PROBE):
         v = int(table[i])
         if v == fp:
             return False
         if v == 0:
-            table[i] = fp
-            if int(table[i]) == fp:
-                return True
-            continue  # slot stolen mid-write: re-read, keep probing
+            with locks[i % _N_STRIPES]:
+                v = int(table[i])
+                if v == 0:
+                    table[i] = fp
+                    return True
+                if v == fp:
+                    return False
+            # slot claimed by a different fp while waiting: keep probing
         i = (i + 1) & mask
     raise RuntimeError(
         "shared DFS visited table is full; raise threads-DFS capacity "
@@ -69,7 +96,7 @@ def _shared_insert(table, mask: int, fp: int) -> bool:
 
 
 def _dfs_worker(payload: bytes, shm_name: str, capacity: int, jobq,
-                resq, stop, counter, nworkers: int) -> None:
+                resq, stop, counter, nworkers: int, locks) -> None:
     """Worker loop: pop a stack job, run DFS on it, share spare work."""
     import cloudpickle
     from multiprocessing import shared_memory
@@ -134,7 +161,7 @@ def _dfs_worker(payload: bytes, shm_name: str, capacity: int, jobq,
                         next_fp = None
                     else:
                         rep_fp = next_fp = model.fingerprint(next_state)
-                    if not _shared_insert(table, mask, rep_fp):
+                    if not _shared_insert(table, mask, rep_fp, locks):
                         continue
                     if next_fp is None:
                         # enqueue-original rule (dfs.rs:266-269)
@@ -211,6 +238,7 @@ class ParallelDfsChecker(HostChecker):
             table[:] = 0
             mask = self._capacity - 1
 
+            locks = [ctx.Lock() for _ in range(_N_STRIPES)]
             init_states = [s for s in model.init_states()
                            if model.within_boundary(s)]
             self._state_count = len(init_states)
@@ -220,7 +248,7 @@ class ParallelDfsChecker(HostChecker):
                 fp = model.fingerprint(s)
                 rep_fp = (model.fingerprint(symmetry(s))
                           if symmetry is not None else fp)
-                if _shared_insert(table, mask, rep_fp):
+                if _shared_insert(table, mask, rep_fp, locks):
                     entries.append((s, [fp], ebits))
             self._unique_state_count = len(entries)
             if not properties or not entries:
@@ -242,7 +270,7 @@ class ParallelDfsChecker(HostChecker):
                 p = ctx.Process(
                     target=_dfs_worker,
                     args=(payload, shm.name, self._capacity, jobq, resq,
-                          stop, counter, self._workers),
+                          stop, counter, self._workers, locks),
                     daemon=True)
                 p.start()
                 procs.append(p)

@@ -60,6 +60,15 @@ class ShardedTpuChecker(TpuChecker):
             raise NotImplementedError(
                 "sound_eventually() with host-evaluated properties is "
                 "not supported on the sharded engine")
+        if self._host_ev:
+            # mirrors the single-chip mode='device' check (tpu.py): the
+            # sharded loop has no per-level orchestration point to
+            # correct ebits before enqueue, so a violated host-evaluated
+            # EVENTUALLY property would silently report as passing
+            raise NotImplementedError(
+                "host-evaluated eventually properties need the per-level "
+                "engine; drop tpu_options(mesh=...) or use single-chip "
+                "spawn_tpu")
 
     # ------------------------------------------------------------------
     def _run(self) -> None:

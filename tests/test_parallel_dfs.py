@@ -96,3 +96,35 @@ def test_no_fork_deprecation_warning(recwarn):
         assert p.unique_state_count() == 288
         b = TwoPhaseSys(3).checker().threads(2).spawn_bfs().join()
         assert b.unique_state_count() == 288
+
+
+def test_shared_insert_zero_fingerprint_and_no_lost_updates():
+    """fp=0 collides with the empty-slot sentinel and is remapped to 1
+    (advisor r3, low); the striped-lock store means a claimed fp is
+    never lost to a concurrent overwrite (advisor r3, medium)."""
+    import threading
+
+    import numpy as np
+
+    from stateright_tpu.checker.parallel_dfs import (_N_STRIPES,
+                                                     _shared_insert)
+
+    table = np.zeros((64,), dtype=np.uint64)
+    locks = [threading.Lock() for _ in range(_N_STRIPES)]
+    # fp 0 claims once (as the reserved value 1), then dedups
+    assert _shared_insert(table, 63, 0, locks)
+    assert not _shared_insert(table, 63, 0, locks)
+    assert not _shared_insert(table, 63, 1, locks)  # documented merge
+    # hammer the table from threads with overlapping fp sets: every fp
+    # must still be present at the end (no lost updates)
+    fps = list(range(2, 40))
+    def worker():
+        for fp in fps:
+            _shared_insert(table, 63, fp, locks)
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    present = set(int(v) for v in np.unique(table[table != 0]))
+    assert set(fps) <= present

@@ -162,6 +162,37 @@ class TestOrlWrappedTimers:
         assert set(b.discoveries()) == set(d.discoveries())
         d.assert_properties()
 
+    def test_resend_and_wrapped_fire_are_separate_actions(self):
+        """The firing that resends unacked messages and the firing that
+        runs the wrapped on_timeout are distinct Timeout actions, so
+        the checker can interleave deliveries of a resent message
+        between them (a combined atomic firing would hide those
+        interleavings — advisor r3, medium)."""
+        w = ActorWrapper.with_default_timeout(TickProducer(Id(1), 1))
+        out = Out()
+        s0 = w.on_start(Id(0), out)
+        assert s0.wrapped_fires_left == 1
+        # firing 1: resend-only; the wrapped timer stays pending
+        out = Out()
+        s1 = w.on_timeout(Id(0), s0, out)
+        assert s1.wrapped_timer is not None
+        assert s1.wrapped_fires_left == 0
+        assert not any(hasattr(c, "msg") for c in out)  # no tick yet
+        # firing 2: the wrapped handler runs; its tick rides the link
+        out = Out()
+        s2 = w.on_timeout(Id(0), s1, out)
+        sent = [c.msg for c in out if hasattr(c, "msg")]
+        assert Deliver(1, 100) in sent
+        assert s2.wrapped_timer is None
+
+    def test_sub_millisecond_resend_interval(self):
+        # countdown must not ZeroDivisionError on 0 < resend < 1 ms
+        # (advisor r3, low); it stays a plain float ceiling
+        w = ActorWrapper(TickProducer(Id(1), 1),
+                         resend_interval=(0.0005, 0.001))
+        assert w._countdown((0.02, 0.04)) == 39
+        assert w._countdown((0.0001, 0.0002)) == 1
+
     def test_wrapped_cancel_timer(self):
         class OneShot(Actor):
             def on_start(self, id, o):

@@ -153,6 +153,28 @@ class TestShardedHostProps:
         assert ck.unique_state_count() < 20000  # early exit
 
 
+class TestShardedHostEventuallyRejected:
+    def test_host_eventually_raises(self):
+        # the sharded loop has no per-level point to correct host
+        # EVENTUALLY ebits before enqueue; running anyway would silently
+        # report a violated property as passing (advisor r3, high)
+        from test_tpu_engine import _HostPropEquation
+
+        class _HostEvEquation(_HostPropEquation):
+            def properties(self):
+                from stateright_tpu.core import Property
+
+                def x_big(_model, state):
+                    return state[0] > 3
+                return [Property.eventually("x big", x_big)]
+
+        model = _HostEvEquation(2, 0, 10**9)
+        with pytest.raises(NotImplementedError, match="eventually"):
+            (model.checker()
+             .tpu_options(mesh=_mesh(2), capacity=1 << 12, fmax=16)
+             .spawn_tpu())
+
+
 class TestShardedEventually:
     def test_eventually_pins_on_mesh(self):
         from stateright_tpu.core import Property
