@@ -57,7 +57,10 @@ class BfsChecker(HostChecker):
         visitor = self._visitor
         target = self._target_state_count
 
+        cancelled = self._cancel_event.is_set
         while pending:
+            if cancelled():
+                return
             state, state_fp, ebits = pending.popleft()
             # this node's dedup key uses the AT-ENQUEUE bits (dedup
             # happened at enqueue time, before this pop's clearing)

@@ -121,6 +121,13 @@ class CheckerBuilder:
         if "mesh" in self.tpu_options_:
             from ..parallel.engine import ShardedTpuChecker
             return ShardedTpuChecker(self)
+        from .race import RacingChecker, race_eligible
+        if race_eligible(self):
+            # small-model latency: the device engine's fixed dispatch +
+            # tunnel-sync costs dwarf tiny models, so a budgeted host BFS
+            # races the device run and the first finisher wins (see
+            # checker/race.py); tpu_options(race=False) opts out
+            return RacingChecker(self)
         from .tpu import TpuChecker
         return TpuChecker(self)
 

@@ -61,26 +61,33 @@ import jax.numpy as jnp
 from ..ops.expand import (discovery_candidates, eventually_indices,
                           expand_frontier)
 from ..ops.hash_kernel import fp64_device, fp64_node_device
-from ..ops.hashtable import table_insert
+from ..ops.hashtable import _BUCKET, table_insert
 
 
 class ChunkCarry(NamedTuple):
-    q_rows: jax.Array   # uint32[qcap, W] append-only queue of pending states
-    q_eb: jax.Array     # uint32[qcap]    their eventually-bits
-    q_fph: jax.Array    # uint32[qcap]    their STATE fingerprints, cached
-    q_fpl: jax.Array    #                 at insert time (canonical under
-    #                                     symmetry) so expansion never
-    #                                     re-hashes the frontier
+    # ONE queue matrix and ONE log matrix: every iteration appends each
+    # with a single contiguous block write (and reads the frontier with a
+    # single block read). The previous four queue columns + four-to-six
+    # log columns cost ~8-10 dynamic_update_slice/dynamic_slice kernels
+    # per iteration; sequential op COUNT is the per-iteration cost lever
+    # on this platform (NOTES.md).
+    q: jax.Array        # uint32[qcap, W+3] append-only queue of pending
+    #                     states: packed row (cols 0..W-1), eventually-
+    #                     bits (col W), cached STATE fingerprint hi/lo
+    #                     (cols W+1, W+2 — canonical under symmetry,
+    #                     stored at insert time so expansion never
+    #                     re-hashes the frontier)
     q_head: jax.Array   # int32[]         next row to expand
     q_tail: jax.Array   # int32[]         next free row (q_size = tail-head)
-    key_hi: jax.Array   # uint32[cap]     visited table
-    key_lo: jax.Array   # uint32[cap]
-    log_chi: jax.Array  # uint32[logcap]  child fp, insertion order
-    log_clo: jax.Array  #                 (canonical under symmetry)
-    log_phi: jax.Array  # uint32[logcap]  parent fp
-    log_plo: jax.Array  # uint32[logcap]
-    log_ohi: jax.Array  # uint32[logcap | 1]  child ORIGINAL fp (symmetry
-    log_olo: jax.Array  #                     only; 1-element dummy else)
+    key_hi: jax.Array   # uint32[cap/4, 4] visited table, bucket-major —
+    key_lo: jax.Array   #                  kept 2-D across iterations so
+    #                                      the probe pays no per-iteration
+    #                                      tile-layout conversion
+    log: jax.Array      # uint32[logcap, 4|6] insertion-order log: child
+    #                     fp hi/lo (cols 0,1 — canonical under symmetry,
+    #                     node keys under sound), parent fp hi/lo (2,3),
+    #                     child ORIGINAL state fp hi/lo (4,5 — present
+    #                     under symmetry/sound only)
     log_n: jax.Array    # int32[]
     disc_hit: jax.Array  # bool[P]   property discovered?
     disc_hi: jax.Array   # uint32[P] witnessing state fp (sticky first)
@@ -230,11 +237,11 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     def make_step(fmax_b: int, kmax_b: int):
         def step(state):
             c, target_remaining, grow_limit = state
-            frontier = jax.lax.dynamic_slice(
-                c.q_rows, (c.q_head, 0), (fmax_b, c.q_rows.shape[1]))
-            ebits = jax.lax.dynamic_slice(c.q_eb, (c.q_head,), (fmax_b,))
-            pfp = (jax.lax.dynamic_slice(c.q_fph, (c.q_head,), (fmax_b,)),
-                   jax.lax.dynamic_slice(c.q_fpl, (c.q_head,), (fmax_b,)))
+            sl = jax.lax.dynamic_slice(
+                c.q, (c.q_head, 0), (fmax_b, width + 3))
+            frontier = sl[:, :width]
+            ebits = sl[:, width]
+            pfp = (sl[:, width + 1], sl[:, width + 2])
             take = jnp.minimum(c.q_tail - c.q_head, fmax_b)
             fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
@@ -243,7 +250,40 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             exp = expand_frontier(model, frontier, fvalid, ebits,
                                   eventually_idx, symmetry=symmetry,
                                   pfp=pfp)
-            vcount = exp.cvalid.sum(dtype=jnp.int32)
+            cvalid = exp.cvalid
+            gen_count = cvalid.sum(dtype=jnp.int32)
+            if not sound:
+                # in-batch pre-dedup: drop candidate lanes whose
+                # fingerprint already appears at an earlier lane of THIS
+                # batch (scatter-min claim arena — the winner is the
+                # lowest lane, every loser is an exact-duplicate lane the
+                # table probe would reject anyway). High-merge models
+                # (2pc: >80% of valid lanes are in-batch duplicates)
+                # then fit a far narrower kmax, which every downstream
+                # gather and probe round scales with. Distinct keys
+                # colliding on an arena cell are NOT dropped (the fp
+                # equality check keeps them), so this is exact. Sound
+                # mode skips it: dedup identity there is (state, ebits)
+                # node keys, computed only post-compaction.
+                fa_b = fmax_b * n_actions
+                acells = 1 << max((2 * fa_b - 1).bit_length(), 0)
+                lane = jnp.arange(fa_b, dtype=jnp.int32)
+                slot = ((exp.clo ^ (exp.chi * jnp.uint32(0x9E3779B9)))
+                        & jnp.uint32(acells - 1)).astype(jnp.int32)
+                slot = jnp.where(cvalid, slot, acells)
+                arena = jnp.full((acells,), fa_b, jnp.int32) \
+                    .at[slot].min(lane, mode="drop")
+                win = jnp.minimum(arena[jnp.minimum(slot, acells - 1)],
+                                  fa_b - 1)
+                # verify the winner really carries the same fingerprint
+                # (two distinct keys can share an arena cell) with ONE
+                # two-column row gather, not two full-lane 1-D gathers
+                fp2 = jnp.stack([exp.chi, exp.clo], axis=1)
+                wfp = fp2[win]
+                dup = cvalid & (win != lane) \
+                    & (wfp[:, 0] == exp.chi) & (wfp[:, 1] == exp.clo)
+                cvalid = cvalid & ~dup
+            vcount = cvalid.sum(dtype=jnp.int32)
             kovf = vcount > kmax_b
 
             if sound:
@@ -280,52 +320,57 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             # rows block-written past an un-advanced tail are never
             # observed: the tail only moves on commit and the next
             # commit overwrites them.
-            src = shrink_indices(exp.cvalid, kmax_b)
+            src = shrink_indices(cvalid, kmax_b)
             kvalid = (jnp.arange(kmax_b, dtype=jnp.int32) < vcount) \
                 & ~kovf
-            # ONE candidate matrix gathered ONCE: per-column gathers were
-            # ~1 ms kernels each at kmax lanes (profiler); the parent
-            # columns are pre-broadcast to the child axis so everything
-            # shares the same source domain
-            cand_cols = [exp.flat,
-                         exp.chi[:, None], exp.clo[:, None],
-                         jnp.repeat(p_whi, n_actions)[:, None],
-                         jnp.repeat(p_wlo, n_actions)[:, None],
-                         jnp.repeat(exp.ebits, n_actions)[:, None]]
-            if symmetry or sound:
-                cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
-            cand = jnp.concatenate(cand_cols, axis=1)
-            k_all = cand[src]
-            k_flat = k_all[:, :width]
-            k_chi = k_all[:, width]
-            k_clo = k_all[:, width + 1]
-            k_phi = k_all[:, width + 2]
-            k_plo = k_all[:, width + 3]
-            k_ceb = k_all[:, width + 4]
+            # the probe only needs the dedup KEYS, so only those two
+            # columns compact to kmax lanes before it; the full candidate
+            # matrix is gathered ONCE, after the insert, for just the
+            # INSERTED lanes (via the composed plan src[src2]) — the wide
+            # every-valid-lane gather this replaces was ~1 ms at paxos
+            # shapes
+            k_chi = exp.chi[src]
+            k_clo = exp.clo[src]
             if sound:
-                # keep the canonical state fps for the queue fp cache;
-                # the dedup keys become node keys
+                # dedup identity under sound = (state fp, pending ebits)
+                # node keys; the state fps stay in the candidate matrix
+                # for the queue's fingerprint cache
                 s_chi, s_clo = k_chi, k_clo
-                k_chi, k_clo = fp64_node_device(k_chi, k_clo, k_ceb)
-                k_all = jnp.concatenate(
-                    [k_all[:, :width],
-                     k_chi[:, None], k_clo[:, None],
-                     k_all[:, width + 2:]], axis=1)
+                k_ceb = jnp.repeat(exp.ebits, n_actions)[src]
+                k_chi, k_clo = fp64_node_device(s_chi, s_clo, k_ceb)
 
             inserted, key_hi, key_lo, t_ovf = table_insert(
                 c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
             t_ovf = t_ovf & ~kovf
             cnt = inserted.sum(dtype=jnp.int32)
 
-            # compact the fresh rows for the block appends (one gather)
+            # ONE candidate matrix, gathered ONCE for the inserted lanes.
+            # Column layout is chosen so the queue block (row | ebits |
+            # state fp) and the log block (dedup key | parent fp |
+            # original fp) are each ONE contiguous column slice; the
+            # parent columns are pre-broadcast to the child axis so
+            # everything shares the same source domain.
+            cand_cols = [exp.flat,
+                         jnp.repeat(exp.ebits, n_actions)[:, None],
+                         exp.chi[:, None], exp.clo[:, None],
+                         jnp.repeat(p_whi, n_actions)[:, None],
+                         jnp.repeat(p_wlo, n_actions)[:, None]]
+            if symmetry or sound:
+                cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
+            cand = jnp.concatenate(cand_cols, axis=1)
             src2 = shrink_indices(inserted, kmax_b)
-            n_all = k_all[src2]
+            n_all = cand[src[src2]]
+            if sound:
+                # splice the node keys (already computed at kmax lanes)
+                # in ahead of the parent columns for the log block
+                n_all = jnp.concatenate(
+                    [n_all[:, :width + 3],
+                     k_chi[src2][:, None], k_clo[src2][:, None],
+                     n_all[:, width + 3:]], axis=1)
+            # log block columns inside n_all: key hi/lo, parent hi/lo,
+            # (original hi/lo under symmetry/sound)
+            log_off = width + 3 if sound else width + 1
             n_flat = n_all[:, :width]
-            n_chi = n_all[:, width]
-            n_clo = n_all[:, width + 1]
-            n_phi = n_all[:, width + 2]
-            n_plo = n_all[:, width + 3]
-            n_eb = n_all[:, width + 4]
 
             if hist_on:
                 # dedup the fresh rows by host-property key against the
@@ -356,48 +401,25 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 hidx, h_n = c.hidx, c.h_n
 
             take = jnp.where(kovf, 0, take)
-            vgen = jnp.where(kovf, 0, vcount)
+            # generated counts every valid transition (host-engine
+            # semantics), not the post-dedup lane count
+            vgen = jnp.where(kovf, 0, gen_count)
 
-            q_rows = jax.lax.dynamic_update_slice(
-                c.q_rows, n_flat, (c.q_tail, 0))
-            q_eb = jax.lax.dynamic_update_slice(
-                c.q_eb, n_eb, (c.q_tail,))
-            if sound:
-                # the cache holds CANONICAL state fps (node keys are
-                # re-derived from them plus the row's ebits)
-                cf_hi = s_chi[src2]
-                cf_lo = s_clo[src2]
-            else:
-                cf_hi, cf_lo = n_chi, n_clo
-            q_fph = jax.lax.dynamic_update_slice(
-                c.q_fph, cf_hi, (c.q_tail,))
-            q_fpl = jax.lax.dynamic_update_slice(
-                c.q_fpl, cf_lo, (c.q_tail,))
-            log_chi = jax.lax.dynamic_update_slice(
-                c.log_chi, n_chi, (c.log_n,))
-            log_clo = jax.lax.dynamic_update_slice(
-                c.log_clo, n_clo, (c.log_n,))
-            log_phi = jax.lax.dynamic_update_slice(
-                c.log_phi, n_phi, (c.log_n,))
-            log_plo = jax.lax.dynamic_update_slice(
-                c.log_plo, n_plo, (c.log_n,))
-            log_ohi, log_olo = c.log_ohi, c.log_olo
-            if symmetry or sound:
-                # the replayable STATE fingerprint per logged node
-                # (exp.ohi aliases the state fp without symmetry)
-                log_ohi = jax.lax.dynamic_update_slice(
-                    log_ohi, n_all[:, width + 5], (c.log_n,))
-                log_olo = jax.lax.dynamic_update_slice(
-                    log_olo, n_all[:, width + 6], (c.log_n,))
+            # the TWO block appends: queue block = (row | ebits | state
+            # fp cache), log block = (dedup key | parent | original) —
+            # each one contiguous column slice of the compacted matrix
+            q = jax.lax.dynamic_update_slice(
+                c.q, n_all[:, :width + 3], (c.q_tail, 0))
+            log = jax.lax.dynamic_update_slice(
+                c.log, n_all[:, log_off:log_off + c.log.shape[1]],
+                (c.log_n, 0))
 
             return c._replace(
-                q_rows=q_rows, q_eb=q_eb, q_fph=q_fph, q_fpl=q_fpl,
+                q=q,
                 q_head=c.q_head + take,
                 q_tail=c.q_tail + cnt,
                 key_hi=key_hi, key_lo=key_lo,
-                log_chi=log_chi, log_clo=log_clo,
-                log_phi=log_phi, log_plo=log_plo,
-                log_ohi=log_ohi, log_olo=log_olo,
+                log=log,
                 log_n=c.log_n + cnt,
                 hkey_hi=hkey_hi, hkey_lo=hkey_lo, hidx=hidx, h_n=h_n,
                 gen=c.gen + vgen,
@@ -448,20 +470,21 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo])
         if not hist_on:
-            return out, stats, jnp.zeros((1, 1), jnp.uint32)
+            return out, stats
         # window over the representatives logged this chunk: rides the
         # host's per-chunk sync, so the common case (few fresh distinct
         # histories) needs NO standalone pull dispatch. Overflow beyond
-        # HIST_WINDOW falls back to TpuChecker._pull_host_reps. The rows
-        # and witness fps ride ONE matrix (one transfer).
+        # HIST_WINDOW falls back to TpuChecker._pull_host_reps. The rows,
+        # witness fps AND the scalar stats ride ONE flat vector: every
+        # device->host transfer on the tunneled chip costs ~100 ms of
+        # latency regardless of size, so a separate window transfer
+        # doubled the per-chunk sync cost.
         sel = out.hidx[jnp.minimum(h0 + jnp.arange(HIST_WINDOW),
                                    out.hidx.shape[0] - 1)]
-        rows = out.q_rows[jnp.minimum(sel, out.q_rows.shape[0] - 1)]
-        li = jnp.clip(sel - n_init, 0, out.log_chi.shape[0] - 1)
-        win = jnp.concatenate(
-            [rows, out.log_chi[li][:, None], out.log_clo[li][:, None]],
-            axis=1)
-        return out, stats, win
+        rows = out.q[jnp.minimum(sel, out.q.shape[0] - 1)][:, :width]
+        li = jnp.clip(sel - n_init, 0, out.log.shape[0] - 1)
+        win = jnp.concatenate([rows, out.log[li][:, 0:2]], axis=1)
+        return out, jnp.concatenate([stats, win.reshape(-1)])
 
     return jax.jit(chunk, donate_argnums=(0,))
 
@@ -484,13 +507,12 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     resuming from a checkpointed frontier.
 
     The whole construction is ONE jitted dispatch (a dozen separate
-    zeros/update dispatches each paid a tunneled-host round trip).
-    NOTE: the engine deliberately ``block_until_ready``s the seeded carry
-    before the first chunk launch — launching the chunk (which donates
-    the carry) with the seed still in flight was measured ~2.5x slower
-    for the whole chunk loop. Folding the fingerprint table seeding INTO
-    this program was also tried and regressed the same way, so it stays
-    a separate ``table_insert`` dispatch."""
+    zeros/update dispatches each paid a tunneled-host round trip). The
+    engine launches the first chunk with the seed still in flight: the
+    round-2/3 measurement that this slowed the loop ~2.5x no longer
+    reproduces with the consolidated carry (NOTES.md round 4), and the
+    old pre-launch ``block_until_ready`` cost a ~100 ms tunnel round
+    trip per run."""
     import numpy as np
 
     width = model.packed_width
@@ -503,38 +525,26 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     if fn is None:
         logcap = capacity
 
-        def build(init_arr, eb_arr, fp_hi, fp_lo, t_idx, t_hi, t_lo,
-                  steps_s):
-            q_rows = jnp.zeros((qcap, width), jnp.uint32)
-            q_eb = jnp.zeros((qcap,), jnp.uint32)
-            q_fph = jnp.zeros((qcap,), jnp.uint32)
-            q_fpl = jnp.zeros((qcap,), jnp.uint32)
+        def build(seed_block, t_idx, t_hi, t_lo, steps_s):
+            q = jnp.zeros((qcap, width + 3), jnp.uint32)
             if k:
-                q_rows = jax.lax.dynamic_update_slice(q_rows, init_arr,
-                                                      (0, 0))
-                q_eb = jax.lax.dynamic_update_slice(q_eb, eb_arr, (0,))
-                q_fph = jax.lax.dynamic_update_slice(q_fph, fp_hi, (0,))
-                q_fpl = jax.lax.dynamic_update_slice(q_fpl, fp_lo, (0,))
-            key_hi = jnp.zeros((capacity,), jnp.uint32)
-            key_lo = jnp.zeros((capacity,), jnp.uint32)
+                q = jax.lax.dynamic_update_slice(q, seed_block, (0, 0))
+            key_hi = jnp.zeros((capacity // _BUCKET, _BUCKET), jnp.uint32)
+            key_lo = jnp.zeros((capacity // _BUCKET, _BUCKET), jnp.uint32)
             if kt:
                 # seed the visited table from the host placement plan —
                 # part of this single program, no separate dispatch
-                key_hi = key_hi.at[t_idx].set(t_hi, mode="drop")
-                key_lo = key_lo.at[t_idx].set(t_lo, mode="drop")
+                key_hi = key_hi.at[t_idx // _BUCKET, t_idx % _BUCKET].set(
+                    t_hi, mode="drop")
+                key_lo = key_lo.at[t_idx // _BUCKET, t_idx % _BUCKET].set(
+                    t_lo, mode="drop")
             return ChunkCarry(
-                q_rows=q_rows, q_eb=q_eb, q_fph=q_fph, q_fpl=q_fpl,
+                q=q,
                 q_head=jnp.int32(0), q_tail=jnp.int32(k),
                 key_hi=key_hi,
                 key_lo=key_lo,
-                log_chi=jnp.zeros((logcap,), jnp.uint32),
-                log_clo=jnp.zeros((logcap,), jnp.uint32),
-                log_phi=jnp.zeros((logcap,), jnp.uint32),
-                log_plo=jnp.zeros((logcap,), jnp.uint32),
-                log_ohi=jnp.zeros((logcap if symmetry else 1,),
-                                  jnp.uint32),
-                log_olo=jnp.zeros((logcap if symmetry else 1,),
-                                  jnp.uint32),
+                log=jnp.zeros((logcap, 6 if symmetry else 4),
+                              jnp.uint32),
                 log_n=jnp.int32(0),
                 disc_hit=jnp.zeros((prop_count,), bool),
                 disc_hi=jnp.zeros((prop_count,), jnp.uint32),
@@ -558,12 +568,12 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                                  (k,)).copy()
         fps = np.asarray(init_fps if init_fps is not None
                          else [0] * k, np.uint64)
-        fp_hi = (fps >> np.uint64(32)).astype(np.uint32)
-        fp_lo = fps.astype(np.uint32)
+        seed_block = np.concatenate(
+            [init_arr, eb_arr[:, None],
+             (fps >> np.uint64(32)).astype(np.uint32)[:, None],
+             fps.astype(np.uint32)[:, None]], axis=1)
     else:
-        init_arr = np.zeros((0, width), np.uint32)
-        eb_arr = np.zeros((0,), np.uint32)
-        fp_hi = fp_lo = np.zeros((0,), np.uint32)
+        seed_block = np.zeros((0, width + 3), np.uint32)
     if kt:
         plan, seed_keys = table_plan
         arr = np.zeros((kt,), np.uint64)
@@ -576,5 +586,4 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     else:
         t_idx = np.zeros((0,), np.int32)
         t_hi = t_lo = np.zeros((0,), np.uint32)
-    return fn(init_arr, eb_arr, fp_hi, fp_lo, t_idx, t_hi, t_lo,
-              jnp.int32(steps))
+    return fn(seed_block, t_idx, t_hi, t_lo, jnp.int32(steps))

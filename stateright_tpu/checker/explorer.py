@@ -215,7 +215,8 @@ def serve(checker_builder, address: Tuple[str, int] | str,
 
     if engine == "tpu":
         snapshot = None
-        checker = checker_builder.spawn_tpu()
+        # the Explorer introspects the device checker; no host race
+        checker = checker_builder.tpu_options(race=False).spawn_tpu()
     elif engine == "dfs":
         snapshot = Snapshot()
         checker = checker_builder.visitor(snapshot).spawn_dfs()

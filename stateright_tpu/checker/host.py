@@ -35,6 +35,15 @@ class HostChecker(Checker):
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
+        self._cancel_event = threading.Event()
+
+    def cancel(self) -> None:
+        """Cooperatively stop the run (checked at engine loop points);
+        used by the spawn_tpu host-vs-device race to stop the loser."""
+        self._cancel_event.set()
+
+    def cancelled(self) -> bool:
+        return self._cancel_event.is_set()
 
     def generated_fingerprints(self):
         """All visited STATE fingerprints (the dedup record, translated

@@ -484,7 +484,14 @@ class TpuChecker(HostChecker):
             k_default = min(fa, max(
                 1 << 12, -(-(fmax * hint * 5 // 4) // 256) * 256))
         else:
-            k_default = max(1 << 12, fa // 2)
+            # the in-batch pre-dedup (device_loop) drops duplicate lanes
+            # before compaction, so high-merge models need far fewer
+            # candidate lanes than fa/2; start narrow and let the kovf
+            # abort-and-rebuild protocol grow it when a batch overflows
+            # (one lost iteration, compile-cached rebuild). Sound mode
+            # skips the pre-dedup (node-key identity), so it keeps the
+            # un-deduped fa/2 sizing.
+            k_default = max(1 << 12, fa // 2 if self._sound else fa // 8)
         kmax = min(int(opts.get("kmax", k_default)), fa)
         k_steps = int(opts.get("chunk_steps", 64))
         insert_fn = _insert_jit()
@@ -564,10 +571,12 @@ class TpuChecker(HostChecker):
                 carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
             else:
                 seed_ovf = None  # plan_insert_host raises on overflow
-            # one readiness wait per program (not per leaf — each wait
-            # can round-trip on a tunneled device): the seed build and
-            # the table scatter are the two programs in flight
-            jax.block_until_ready((carry.q_head, carry.key_hi))
+            # No readiness wait: a block_until_ready here costs one
+            # tunnel round trip (~100 ms, re-measured round 4). The
+            # round-2/3 finding that launching the chunk over an
+            # in-flight seed slowed the loop ~2.5x no longer reproduces
+            # with the consolidated carry (q/log matrices, 2-D table);
+            # PJRT orders the dependent programs itself.
         chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
                                   kmax, symmetry=self._symmetry,
                                   sound=self._sound, hcap=hcap,
@@ -597,9 +606,10 @@ class TpuChecker(HostChecker):
                                           sound=self._sound, hcap=0,
                                           n_init=n_init)
             with self._timed("chunk"):
-                carry, stats_d, win_d = chunk_fn(carry, remaining,
-                                                 grow_limit)
-                # ONE transfer for all scalars (packed vector)
+                carry, stats_d = chunk_fn(carry, remaining, grow_limit)
+                # ONE transfer for everything the host reads per chunk
+                # (scalars + the representative window when host props
+                # are on): each transfer costs ~100 ms of tunnel latency
                 stats = np.asarray(stats_d)
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
              vmax) = (int(stats[0]), int(stats[1]), int(stats[2]),
@@ -610,10 +620,9 @@ class TpuChecker(HostChecker):
             disc_hi = stats[10 + prop_count:10 + 2 * prop_count]
             disc_lo = stats[10 + 2 * prop_count:10 + 3 * prop_count]
             if want_reps and h_n > self._h_pulled:
-                # the representative window transfers only when this
-                # chunk actually logged fresh keys (the link is slow)
-                with self._timed("chunk"):
-                    win = np.asarray(win_d)
+                from .device_loop import HIST_WINDOW
+                win = stats[10 + 3 * prop_count:].reshape(
+                    (HIST_WINDOW, -1))
                 hrows = win[:, :-2]
                 hwhi, hwlo = win[:, -2], win[:, -1]
             q_size = int(q_tail) - int(q_head)
@@ -714,7 +723,8 @@ class TpuChecker(HostChecker):
             done = (q_size == 0
                     or len(discoveries) == prop_count
                     or (target is not None
-                        and self._state_count >= target))
+                        and self._state_count >= target)
+                    or self._cancel_event.is_set())
             if done:
                 break
             need_grow = (int(log_n) >= int(grow_limit)
@@ -734,16 +744,15 @@ class TpuChecker(HostChecker):
             # device buffers
             head = int(jax.device_get(carry.q_head))
             tail = int(jax.device_get(carry.q_tail))
-            self._resume_frontier = (
-                np.asarray(jax.device_get(carry.q_rows[head:tail])),
-                np.asarray(jax.device_get(carry.q_eb[head:tail])))
+            width = model.packed_width
+            pend = np.asarray(jax.device_get(carry.q[head:tail]))
+            self._resume_frontier = (pend[:, :width].copy(),
+                                     pend[:, width].copy())
         # the mirror (fp -> parent fp) stays device-resident until someone
         # needs it (path reconstruction, checkpointing): the log pull is
         # pure host-link cost, pointless for count-only runs. Keep only
         # the log fields so the table/queue HBM is freed promptly.
-        self._mirror_carry = (carry.log_chi, carry.log_clo, carry.log_phi,
-                              carry.log_plo, carry.log_ohi, carry.log_olo,
-                              carry.log_n)
+        self._mirror_carry = (carry.log, carry.log_n)
         self._discovery_fps.update(discoveries)
 
     def _device_qcap(self, n_init: int, headroom: int) -> int:
@@ -776,64 +785,38 @@ class TpuChecker(HostChecker):
         self._capacity = old_capacity * 4
         new_qcap = self._device_qcap(n_init, headroom)
 
-        symmetry = self._symmetry or self._sound
         hist_on = carry.hidx.shape[0] > 1
 
-        def rebuild(q_rows, q_eb, q_fph, q_fpl, q_head, q_tail,
-                    log_chi, log_clo, log_phi, log_plo,
-                    log_ohi, log_olo, log_n, hidx):
+        def rebuild(q, q_head, q_tail, log, log_n, hidx):
             # copy the whole queue prefix into the larger buffer at the
             # same positions: the [0, tail) region doubles as the list of
             # every unique state's packed row (post-hoc property eval,
             # checkpointing), so consumed rows are retained
-            nq_rows = jnp.zeros((new_qcap, q_rows.shape[1]), jnp.uint32)
-            nq_rows = jax.lax.dynamic_update_slice(nq_rows, q_rows, (0, 0))
-            nq_eb = jnp.zeros((new_qcap,), jnp.uint32)
-            nq_eb = jax.lax.dynamic_update_slice(nq_eb, q_eb, (0,))
-            nq_fph = jnp.zeros((new_qcap,), jnp.uint32)
-            nq_fph = jax.lax.dynamic_update_slice(nq_fph, q_fph, (0,))
-            nq_fpl = jnp.zeros((new_qcap,), jnp.uint32)
-            nq_fpl = jax.lax.dynamic_update_slice(nq_fpl, q_fpl, (0,))
-            # bigger log
-            nl_chi = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_chi = jax.lax.dynamic_update_slice(nl_chi, log_chi, (0,))
-            nl_clo = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_clo = jax.lax.dynamic_update_slice(nl_clo, log_clo, (0,))
-            nl_phi = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_phi = jax.lax.dynamic_update_slice(nl_phi, log_phi, (0,))
-            nl_plo = jnp.zeros((self._capacity,), jnp.uint32)
-            nl_plo = jax.lax.dynamic_update_slice(nl_plo, log_plo, (0,))
-            if symmetry:
-                nl_ohi = jnp.zeros((self._capacity,), jnp.uint32)
-                nl_ohi = jax.lax.dynamic_update_slice(nl_ohi, log_ohi,
-                                                      (0,))
-                nl_olo = jnp.zeros((self._capacity,), jnp.uint32)
-                nl_olo = jax.lax.dynamic_update_slice(nl_olo, log_olo,
-                                                      (0,))
-            else:
-                nl_ohi, nl_olo = log_ohi, log_olo
+            nq = jnp.zeros((new_qcap, q.shape[1]), jnp.uint32)
+            nq = jax.lax.dynamic_update_slice(nq, q, (0, 0))
+            nlog = jnp.zeros((self._capacity, log.shape[1]), jnp.uint32)
+            nlog = jax.lax.dynamic_update_slice(nlog, log, (0, 0))
             if hist_on:
                 nh_idx = jnp.zeros((self._capacity,), jnp.int32)
                 nh_idx = jax.lax.dynamic_update_slice(nh_idx, hidx, (0,))
             else:
                 nh_idx = hidx
-            # fresh table; re-insert every logged fingerprint
-            key_hi = jnp.zeros((self._capacity,), jnp.uint32)
-            key_lo = jnp.zeros((self._capacity,), jnp.uint32)
+            # fresh table (2-D bucket-major, like the chunk carry);
+            # re-insert every logged fingerprint
+            from ..ops.hashtable import _BUCKET
+            key_hi = jnp.zeros(
+                (self._capacity // _BUCKET, _BUCKET), jnp.uint32)
+            key_lo = jnp.zeros(
+                (self._capacity // _BUCKET, _BUCKET), jnp.uint32)
             valid = jnp.arange(old_capacity, dtype=jnp.int32) < log_n
             _, key_hi, key_lo, ovf = table_insert_local(
-                key_hi, key_lo, log_chi, log_clo, valid)
-            return (nq_rows, nq_eb, nq_fph, nq_fpl, key_hi, key_lo,
-                    nl_chi, nl_clo, nl_phi, nl_plo, nl_ohi, nl_olo,
-                    nh_idx, ovf)
+                key_hi, key_lo, log[:, 0], log[:, 1], valid)
+            return (nq, key_hi, key_lo, nlog, nh_idx, ovf)
 
         rebuild = jax.jit(rebuild)
-        (nq_rows, nq_eb, nq_fph, nq_fpl, key_hi, key_lo, nl_chi, nl_clo,
-         nl_phi, nl_plo, nl_ohi, nl_olo, nh_idx, ovf) = rebuild(
-            carry.q_rows, carry.q_eb, carry.q_fph, carry.q_fpl,
-            carry.q_head, carry.q_tail, carry.log_chi, carry.log_clo,
-            carry.log_phi, carry.log_plo, carry.log_ohi, carry.log_olo,
-            carry.log_n, carry.hidx)
+        nq, key_hi, key_lo, nlog, nh_idx, ovf = rebuild(
+            carry.q, carry.q_head, carry.q_tail, carry.log, carry.log_n,
+            carry.hidx)
         if bool(jax.device_get(ovf)):
             raise RuntimeError("overflow while re-inserting during growth")
         # fingerprints known at seed time (inits, or a resumed snapshot)
@@ -841,11 +824,7 @@ class TpuChecker(HostChecker):
         key_hi, key_lo = self._bulk_insert(insert_fn, key_hi, key_lo,
                                            self._base_fps)
         carry = carry._replace(
-            q_rows=nq_rows, q_eb=nq_eb, q_fph=nq_fph, q_fpl=nq_fpl,
-            key_hi=key_hi, key_lo=key_lo,
-            log_chi=nl_chi, log_clo=nl_clo, log_phi=nl_phi,
-            log_plo=nl_plo, log_ohi=nl_ohi, log_olo=nl_olo,
-            hidx=nh_idx)
+            q=nq, key_hi=key_hi, key_lo=key_lo, log=nlog, hidx=nh_idx)
         return carry, new_qcap
 
     # ------------------------------------------------------------------
@@ -861,18 +840,20 @@ class TpuChecker(HostChecker):
             import jax
             import jax.numpy as jnp
 
-            def fn(q_rows, hidx, log_chi, log_clo, start, n_init,
-                   bucket):
+            def fn(q, hidx, log, start, n_init, bucket):
                 sel = hidx[jnp.minimum(start + jnp.arange(bucket),
                                        hidx.shape[0] - 1)]
-                rows = q_rows[jnp.minimum(sel, q_rows.shape[0] - 1)]
+                # the queue matrix carries 3 bookkeeping columns past the
+                # packed row (ebits + cached fp)
+                rows = q[jnp.minimum(sel, q.shape[0] - 1)][:,
+                                                           :q.shape[1] - 3]
                 # queue row i >= n_init is log entry i - n_init (queue
                 # and log append in lockstep); seed rows never appear in
                 # hidx (they are evaluated host-side at seed time)
-                li = jnp.clip(sel - n_init, 0, log_chi.shape[0] - 1)
-                return rows, log_chi[li], log_clo[li]
+                li = jnp.clip(sel - n_init, 0, log.shape[0] - 1)
+                return rows, log[li, 0], log[li, 1]
 
-            cls._HPULL_JIT = jax.jit(fn, static_argnums=(6,))
+            cls._HPULL_JIT = jax.jit(fn, static_argnums=(5,))
         return cls._HPULL_JIT
 
     def _pull_host_reps(self, carry, h_n: int, n_init: int,
@@ -890,7 +871,7 @@ class TpuChecker(HostChecker):
         count = h_n - start
         bucket = _bucket(count)
         rows_d, whi_d, wlo_d = self._hpull_jit()(
-            carry.q_rows, carry.hidx, carry.log_chi, carry.log_clo,
+            carry.q, carry.hidx, carry.log,
             jnp.int32(start), jnp.int32(n_init), bucket)
         rows_h, whi_h, wlo_h = jax.device_get((rows_d, whi_d, wlo_d))
         wfp = _combine64(whi_h, wlo_h)
@@ -913,17 +894,17 @@ class TpuChecker(HostChecker):
         cols = getattr(model, "host_property_cols", None)
         off, hw = cols if cols is not None else (0, model.packed_width)
 
-        def reseed(q_rows, hidx, n):
+        def reseed(q, hidx, n):
             khi = jnp.zeros((hcap,), jnp.uint32)
             klo = jnp.zeros((hcap,), jnp.uint32)
-            sel = jnp.minimum(hidx, q_rows.shape[0] - 1)
-            hhi, hlo = fp64_device(q_rows[sel][:, off:off + hw])
+            sel = jnp.minimum(hidx, q.shape[0] - 1)
+            hhi, hlo = fp64_device(q[sel][:, off:off + hw])
             valid = jnp.arange(hidx.shape[0], dtype=jnp.int32) < n
             _, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
             return khi, klo, ovf
 
         bucket = min(_bucket(max(h_n, 1)), carry.hidx.shape[0])
-        khi, klo, ovf = jax.jit(reseed)(carry.q_rows,
+        khi, klo, ovf = jax.jit(reseed)(carry.q,
                                         carry.hidx[:bucket],
                                         jnp.int32(h_n))
         if bool(jax.device_get(ovf)):
@@ -966,28 +947,27 @@ class TpuChecker(HostChecker):
         log_reps = (int(jax.device_get(carry.h_n)) + rmax
                     <= carry.hidx.shape[0])
 
-        def fn(q_rows, log_chi, log_clo, khi, klo, hidx, h_n, s0_,
-               q_off, q_len):
-            region = jax.lax.dynamic_slice(q_rows, (s0_, 0),
-                                           (rmax, width))
+        def fn(q, log, khi, klo, hidx, h_n, s0_, q_off, q_len):
+            region = jax.lax.dynamic_slice(q, (s0_, 0),
+                                           (rmax, width + 3))
             hhi, hlo = fp64_device(region[:, off:off + hw])
             idx = jnp.arange(rmax, dtype=jnp.int32)
             valid = (idx >= q_off) & (idx < q_off + q_len)
             ins, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
             src = shrink_indices(ins, rmax)
-            rows = region[src]
+            rows = region[src][:, :width]
             hcnt = ins.sum(dtype=jnp.int32)
             if log_reps:
                 hidx = jax.lax.dynamic_update_slice(
                     hidx, (src + s0_).astype(jnp.int32), (h_n,))
                 h_n = h_n + hcnt
-            li = jnp.clip(src + s0_ - n_init, 0, log_chi.shape[0] - 1)
-            return (rows, log_chi[li], log_clo[li], hcnt, ovf, khi, klo,
+            li = jnp.clip(src + s0_ - n_init, 0, log.shape[0] - 1)
+            return (rows, log[li, 0], log[li, 1], hcnt, ovf, khi, klo,
                     hidx, h_n)
 
         (rows_d, whi_d, wlo_d, hcnt_d, ovf_d, khi, klo, hidx_d,
          h_n_d) = jax.jit(fn)(
-            carry.q_rows, carry.log_chi, carry.log_clo,
+            carry.q, carry.log,
             carry.hkey_hi, carry.hkey_lo, carry.hidx, carry.h_n,
             jnp.int32(s0), jnp.int32(start - s0), jnp.int32(end - start))
         hcnt, ovf = jax.device_get((hcnt_d, ovf_d))
@@ -1018,25 +998,23 @@ class TpuChecker(HostChecker):
         if mirror is None:
             return
         self._mirror_carry = None
-        log_chi, log_clo, log_phi, log_plo, log_ohi, log_olo, log_n_d = \
-            mirror
+        log_d, log_n_d = mirror
         import jax
 
         with self._timed("mirror_pull"):
             log_n = int(jax.device_get(log_n_d))
             if not log_n:
                 return
-            # pull only the live prefix (pow2-padded slice jitted on device)
-            n = min(_bucket(log_n), log_chi.shape[0])
-            _slice, take_fn, _rows, take2_fn = _level_helpers()
-            chi, clo, phi, plo = jax.device_get(take_fn(
-                log_chi, log_clo, log_phi, log_plo, n))
-            child = _combine64(chi[:log_n], clo[:log_n])
-            parent = _combine64(phi[:log_n], plo[:log_n])
+            # pull only the live prefix (pow2-padded slice jitted on
+            # device); the log matrix rides ONE transfer
+            n = min(_bucket(log_n), log_d.shape[0])
+            _slice, _take, take_rows_fn, _take2 = _level_helpers()
+            log = np.asarray(jax.device_get(take_rows_fn(log_d, n)))
+            child = _combine64(log[:log_n, 0], log[:log_n, 1])
+            parent = _combine64(log[:log_n, 2], log[:log_n, 3])
             self._generated.update(zip(child.tolist(), parent.tolist()))
             if self._symmetry or self._sound:
-                ohi, olo = jax.device_get(take2_fn(log_ohi, log_olo, n))
-                orig = _combine64(ohi[:log_n], olo[:log_n])
+                orig = _combine64(log[:log_n, 4], log[:log_n, 5])
                 self._orig_of.update(zip(child.tolist(), orig.tolist()))
             self._unique_state_count = len(self._generated)
 

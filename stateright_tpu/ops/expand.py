@@ -120,20 +120,24 @@ def discovery_candidates(properties, exp: Expansion, fvalid,
     """
     if whi is None:
         whi, wlo = exp.phi, exp.plo
-    hit_l, hi_l, lo_l = [], [], []
-    term_flush = exp.terminal & (exp.ebits != 0)
-    for i, prop in enumerate(properties):
-        if prop.expectation == Expectation.ALWAYS:
-            mask = fvalid & ~exp.pbits[:, i]
-        elif prop.expectation == Expectation.SOMETIMES:
-            mask = fvalid & exp.pbits[:, i]
-        else:
-            mask = term_flush & ((exp.ebits >> i) & 1).astype(bool)
-        k = jnp.argmax(mask)
-        hit_l.append(mask.any())
-        hi_l.append(whi[k])
-        lo_l.append(wlo[k])
-    if not hit_l:
+    n_props = len(properties)
+    if not n_props:
         z32 = jnp.zeros((0,), jnp.uint32)
         return jnp.zeros((0,), bool), z32, z32
-    return jnp.stack(hit_l), jnp.stack(hi_l), jnp.stack(lo_l)
+    # one (F, P) mask matrix + one any/argmax pair, instead of a Python
+    # loop of ~5 dependent ops per property (sequential op COUNT is the
+    # per-iteration cost lever on this platform — NOTES.md)
+    kind = jnp.asarray([0 if p.expectation == Expectation.ALWAYS
+                        else 1 if p.expectation == Expectation.SOMETIMES
+                        else 2 for p in properties], jnp.int32)
+    term_flush = exp.terminal & (exp.ebits != 0)
+    ebit = ((exp.ebits[:, None] >> jnp.arange(n_props, dtype=jnp.uint32))
+            & 1).astype(bool)
+    masks = jnp.where(
+        kind[None, :] == 0, fvalid[:, None] & ~exp.pbits[:, :n_props],
+        jnp.where(kind[None, :] == 1,
+                  fvalid[:, None] & exp.pbits[:, :n_props],
+                  term_flush[:, None] & ebit))
+    hit = masks.any(axis=0)
+    k = jnp.argmax(masks, axis=0)
+    return hit, whi[k], wlo[k]

@@ -1,0 +1,75 @@
+"""spawn_tpu host-vs-device race (checker/race.py): tiny models must
+answer at host speed; device-only features must bypass the race; a
+device failure must not beat a correct host result."""
+
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.examples.increment_lock import IncrementLock  # noqa: E402
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+
+
+class TestRace:
+    def test_small_model_fast_and_exact(self):
+        jax.devices()  # engine warm-up out of the timed region
+        t0 = time.perf_counter()
+        ck = (IncrementLock(3).checker().tpu_options(capacity=1 << 14)
+              .spawn_tpu().join())
+        dt = time.perf_counter() - t0
+        assert ck.unique_state_count() == 61
+        assert dt < 0.3, dt  # BASELINE.json time-to-counterexample bar
+        ck.assert_properties()
+
+    def test_full_enumeration_agnostic_to_winner(self):
+        # either engine winning must produce the exact enumeration
+        ck = (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 288  # 2pc.rs:128
+        host = TwoPhaseSys(3).checker().spawn_bfs().join()
+        assert ck.generated_fingerprints() == host.generated_fingerprints()
+
+    def test_device_failure_defers_to_host(self):
+        # the device run hits a packed-encoding overflow (fatal on the
+        # device path) while the host model is fine; the budgeted host
+        # racer completes, so the raced run returns the correct result
+        # instead of raising (race=False pins the raise — see
+        # test_tpu_engine.TestModelOverflowFatal)
+        from test_tpu_engine import _OverflowingEquation
+
+        class _TinyOverflow(_OverflowingEquation):
+            # bound the host search so it finishes well inside the race
+            # budget; the device still overflows at x > 5 first
+            def within_boundary(self, state):
+                return state[0] <= 20 and state[1] <= 20
+
+        model = _TinyOverflow(2, 0, 10**9)  # unsatisfiable: full walk
+        ck = (model.checker().tpu_options(capacity=1 << 14)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() > 0
+        host = _TinyOverflow(2, 0, 10**9).checker().spawn_bfs().join()
+        assert ck.unique_state_count() == host.unique_state_count()
+
+    def test_race_ineligible_paths(self):
+        from stateright_tpu.checker.race import race_eligible
+        b = TwoPhaseSys(3).checker()
+        assert race_eligible(b)
+        assert not race_eligible(TwoPhaseSys(3).checker()
+                                 .tpu_options(race=False))
+        assert not race_eligible(TwoPhaseSys(3).checker()
+                                 .tpu_options(mode="device"))
+        assert not race_eligible(TwoPhaseSys(3).checker()
+                                 .tpu_options(resumable=True))
+        m = TwoPhaseSys(3)
+        assert not race_eligible(m.checker().symmetry_fn(m.representative))
+
+    def test_report_streams_progress(self):
+        import io
+        out = io.StringIO()
+        ck = (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+              .spawn_tpu().report(out))
+        text = out.getvalue()
+        assert "Done. states=" in text
+        assert ck.unique_state_count() == 288

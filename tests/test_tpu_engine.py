@@ -274,8 +274,10 @@ class TestModelOverflowFatal:
         # envelopes than network slots must abort, not under-explore
         from stateright_tpu.examples.paxos_packed import PackedPaxos
         model = PackedPaxos(client_count=1, net_capacity=2)
+        # race=False: this pins the DEVICE guard — a raced run may
+        # legitimately adopt the host racer's complete result instead
         with pytest.raises(RuntimeError, match="capacity overflow"):
-            (model.checker().tpu_options(capacity=1 << 14)
+            (model.checker().tpu_options(capacity=1 << 14, race=False)
              .spawn_tpu().join())
 
     def test_cache_not_shared_across_subclasses(self):

@@ -48,15 +48,51 @@ class TestDeviceSymmetry:
         # 5 RMs: 8,832 plain states (2pc.rs:133); under symmetry the DFS
         # oracle reaches 665 (2pc.rs:138). 2pc's representative breaks
         # ties by original position, so the exact reduced count is
-        # DFS-order-specific — the BFS device engine must land in the
-        # same ballpark, be deterministic, and reach the same verdicts.
+        # ORDER-specific: the sound range is [314, 1092] — 314 true
+        # RM-permutation orbits and 1092 distinct representative keys
+        # over the full reachable set (both computed by brute force over
+        # all 120 permutations; the reference's 665 is just its DFS
+        # order's value inside that range). The device engine must land
+        # in the sound range, COVER EVERY REACHABLE ORBIT (the actual
+        # soundness obligation), be deterministic, and reach the same
+        # verdicts.
+        from itertools import permutations
+
+        from stateright_tpu.checker.representative import RewritePlan
+
         model = TwoPhaseSys(5)
         ck = (model.checker().symmetry_fn(model.representative)
               .tpu_options(capacity=1 << 12, fmax=64)
               .spawn_tpu().join())
         n = ck.unique_state_count()
-        assert 665 <= n < 1000, n  # never coarser than the DFS partition
+        assert 314 <= n <= 1092, n
         ck.assert_properties()
+
+        # soundness oracle: the canonical keys the engine reached must
+        # cover all 314 reachable orbits
+        plain = TwoPhaseSys(5).checker().spawn_bfs().join()
+        states = [model.decode(model.encode(s))
+                  for s in self._all_states(model)]
+        assert len(states) == plain.unique_state_count() == 8832
+
+        def apply_plan(s, plan):
+            rm_state, tm_state, tm_prepared, msgs = s
+            return (tuple(plan.reindex(rm_state)), tm_state,
+                    tuple(plan.reindex(tm_prepared)),
+                    frozenset(plan.rewrite(m) if m < 16 else m
+                              for m in msgs))
+
+        perms = [RewritePlan(list(p)) for p in permutations(range(5))]
+        orbit_of_key = {}
+        all_orbits = set()
+        for s in states:
+            okey = min(model.fingerprint(apply_plan(s, p)) for p in perms)
+            all_orbits.add(okey)
+            orbit_of_key[model.fingerprint(model.representative(s))] = okey
+        assert len(all_orbits) == 314
+        reached = {orbit_of_key[fp]
+                   for fp in ck.generated_fingerprints()}
+        assert reached == all_orbits
         # deterministic across runs
         ck2 = (TwoPhaseSys(5).checker()
                .symmetry_fn(TwoPhaseSys(5).representative)
@@ -68,6 +104,27 @@ class TestDeviceSymmetry:
             path = ck.discovery(name)
             prop = model.property(name)
             assert prop.condition(model, path.last_state())
+
+    @staticmethod
+    def _all_states(model):
+        seen, out = set(), []
+        frontier = list(model.init_states())
+        while frontier:
+            nxt = []
+            for s in frontier:
+                fp = model.fingerprint(s)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                out.append(s)
+                acts = []
+                model.actions(s, acts)
+                for a in acts:
+                    t = model.next_state(s, a)
+                    if t is not None and model.within_boundary(t):
+                        nxt.append(t)
+            frontier = nxt
+        return out
 
     def test_increment_sym_8(self):
         # 13 plain states vs 8 canonical (increment.rs:36-105)

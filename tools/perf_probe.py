@@ -1,0 +1,78 @@
+"""Quick A/B probe for engine perf work: paxos-capped + 2pc-full rates,
+best-of-N. Not part of the driver contract (bench.py is)."""
+import sys
+import time
+
+
+def paxos(n_runs=3, cap=500_000):
+    from stateright_tpu.examples.paxos_packed import PackedPaxos
+
+    def run(c):
+        t0 = time.perf_counter()
+        ck = (PackedPaxos(3).checker()
+              .tpu_options(capacity=1 << 21)
+              .target_state_count(c)
+              .spawn_tpu().join())
+        return time.perf_counter() - t0, ck
+
+    run(50_000)  # warm
+    rates = []
+    for _ in range(n_runs):
+        dt, ck = run(cap)
+        rates.append(ck.unique_state_count() / dt)
+    print(f"paxos3 capped: uniq={ck.unique_state_count()} "
+          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
+    return max(rates)
+
+
+def twopc(n_runs=3):
+    from stateright_tpu.models.twopc import TwoPhaseSys
+
+    def run():
+        t0 = time.perf_counter()
+        ck = (TwoPhaseSys(7).checker()
+              .tpu_options(capacity=1 << 22)
+              .spawn_tpu().join())
+        return time.perf_counter() - t0, ck.unique_state_count()
+
+    run()
+    rates = []
+    for _ in range(n_runs):
+        dt, uq = run()
+        assert uq == 296448, uq
+        rates.append(uq / dt)
+    print(f"2pc n=7 full: uniq={uq} "
+          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
+    return max(rates)
+
+
+def abd(n_runs=3, cap=100_000):
+    from stateright_tpu.examples.abd_packed import PackedAbd
+
+    def run(c):
+        t0 = time.perf_counter()
+        ck = (PackedAbd(2, server_count=3, ordered=True, channel_depth=8)
+              .checker()
+              .tpu_options(capacity=1 << 20)
+              .target_state_count(c)
+              .spawn_tpu().join())
+        return time.perf_counter() - t0, ck
+
+    run(5_000)
+    rates = []
+    for _ in range(n_runs):
+        dt, ck = run(cap)
+        rates.append(ck.unique_state_count() / dt)
+    print(f"abd2 ordered capped: uniq={ck.unique_state_count()} "
+          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
+    return max(rates)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "paxos"):
+        paxos()
+    if which in ("all", "2pc"):
+        twopc()
+    if which in ("all", "abd"):
+        abd()

@@ -1,0 +1,62 @@
+"""Profile the device chunk loop on paxos: trace one warm capped run and
+summarize op time by kernel name from the trace proto."""
+import glob
+import gzip
+import json
+import os
+import shutil
+import sys
+import time
+
+import jax
+
+
+def run(cap=300_000):
+    import os
+    if os.environ.get("PROF_MODEL") == "2pc":
+        from stateright_tpu.models.twopc import TwoPhaseSys
+        t0 = time.perf_counter()
+        ck = (TwoPhaseSys(7).checker()
+              .tpu_options(capacity=1 << 22)
+              .spawn_tpu().join())
+        dt = time.perf_counter() - t0
+        print(f"run: {ck.unique_state_count()} uniq in {dt:.2f}s "
+              f"({ck.unique_state_count()/dt:,.0f}/s)", file=sys.stderr)
+        return
+    from stateright_tpu.examples.paxos_packed import PackedPaxos
+    t0 = time.perf_counter()
+    ck = (PackedPaxos(3).checker()
+          .tpu_options(capacity=1 << 21)
+          .target_state_count(cap)
+          .spawn_tpu().join())
+    dt = time.perf_counter() - t0
+    print(f"run: {ck.unique_state_count()} uniq in {dt:.2f}s "
+          f"({ck.unique_state_count()/dt:,.0f}/s) "
+          f"profile={ {k: round(v, 3) for k, v in ck.profile().items()} }",
+          file=sys.stderr)
+
+
+outdir = "/tmp/jaxprof"
+shutil.rmtree(outdir, ignore_errors=True)
+run()  # warm
+with jax.profiler.trace(outdir):
+    run()
+
+traces = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
+                   recursive=True)
+print("traces:", traces, file=sys.stderr)
+ev_by_name = {}
+for t in traces:
+    with gzip.open(t, "rt") as f:
+        data = json.load(f)
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        dur = ev.get("dur", 0)  # us
+        ev_by_name.setdefault(name, [0, 0])
+        ev_by_name[name][0] += dur
+        ev_by_name[name][1] += 1
+top = sorted(ev_by_name.items(), key=lambda kv: -kv[1][0])[:45]
+for name, (dur, cnt) in top:
+    print(f"{dur/1e3:10.1f} ms  x{cnt:<6} {name[:110]}")

@@ -1,0 +1,42 @@
+import sys, time
+
+def paxos(fmax=None, kmax=None, cap=500_000, runs=2):
+    from stateright_tpu.examples.paxos_packed import PackedPaxos
+    opts = {"capacity": 1 << 21}
+    if fmax: opts["fmax"] = fmax
+    if kmax: opts["kmax"] = kmax
+    def run(c):
+        t0 = time.perf_counter()
+        ck = (PackedPaxos(3).checker().tpu_options(**opts)
+              .target_state_count(c).spawn_tpu().join())
+        return time.perf_counter() - t0, ck
+    run(50_000)
+    rates = []
+    for _ in range(runs):
+        dt, ck = run(cap)
+        rates.append(ck.unique_state_count() / dt)
+    print(f"paxos fmax={fmax} kmax={kmax}: best={max(rates):,.0f} "
+          f"rates={[f'{r:,.0f}' for r in rates]} vmax={ck.profile().get('vmax')}")
+
+def twopc(fmax=None, kmax=None, runs=2):
+    from stateright_tpu.models.twopc import TwoPhaseSys
+    opts = {"capacity": 1 << 22}
+    if fmax: opts["fmax"] = fmax
+    if kmax: opts["kmax"] = kmax
+    def run():
+        t0 = time.perf_counter()
+        ck = (TwoPhaseSys(7).checker().tpu_options(**opts)
+              .spawn_tpu().join())
+        return time.perf_counter() - t0, ck
+    run()
+    rates = []
+    for _ in range(runs):
+        dt, ck = run()
+        assert ck.unique_state_count() == 296448
+        rates.append(296448 / dt)
+    print(f"2pc fmax={fmax} kmax={kmax}: best={max(rates):,.0f} "
+          f"rates={[f'{r:,.0f}' for r in rates]} vmax={ck.profile().get('vmax')}")
+
+if __name__ == "__main__":
+    for arg in sys.argv[1:]:
+        eval(arg)
