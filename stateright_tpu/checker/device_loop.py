@@ -58,8 +58,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.expand import (discovery_candidates, eventually_indices,
-                          expand_frontier)
+from ..ops.expand import (candidate_matrix, discovery_candidates,
+                          eventually_indices, expand_frontier, pre_dedup,
+                          splice_node_keys)
 from ..ops.hash_kernel import fp64_device, fp64_node_device
 from ..ops.hashtable import _BUCKET, table_insert
 
@@ -220,13 +221,19 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     kmax_small = min(fmax_small * n_actions, kmax)
     two_size = fmax_small < fmax
 
+    # the queue slice must cover BOTH the widest append (kmax rows) and
+    # the frontier dequeue (fmax rows — dynamic_slice would silently
+    # CLAMP its start near the end of the queue, re-expanding consumed
+    # rows and skipping pending ones)
+    qmargin = max(kmax, fmax)
+
     def cond(state):
         c, target_remaining, grow_limit = state
         go = (c.q_tail > c.q_head) & (c.steps > 0) \
             & ~c.ovf & ~c.xovf & ~c.kovf & ~c.hovf \
             & (c.gen < target_remaining) \
             & (c.log_n < grow_limit) \
-            & (c.q_tail <= qcap - kmax)
+            & (c.q_tail <= qcap - qmargin)
         if device_prop_idx and not host_idx:
             # stop once every device-evaluated property has a discovery —
             # but only when no host properties remain: those need the
@@ -253,36 +260,8 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             cvalid = exp.cvalid
             gen_count = cvalid.sum(dtype=jnp.int32)
             if not sound:
-                # in-batch pre-dedup: drop candidate lanes whose
-                # fingerprint already appears at an earlier lane of THIS
-                # batch (scatter-min claim arena — the winner is the
-                # lowest lane, every loser is an exact-duplicate lane the
-                # table probe would reject anyway). High-merge models
-                # (2pc: >80% of valid lanes are in-batch duplicates)
-                # then fit a far narrower kmax, which every downstream
-                # gather and probe round scales with. Distinct keys
-                # colliding on an arena cell are NOT dropped (the fp
-                # equality check keeps them), so this is exact. Sound
-                # mode skips it: dedup identity there is (state, ebits)
-                # node keys, computed only post-compaction.
-                fa_b = fmax_b * n_actions
-                acells = 1 << max((2 * fa_b - 1).bit_length(), 0)
-                lane = jnp.arange(fa_b, dtype=jnp.int32)
-                slot = ((exp.clo ^ (exp.chi * jnp.uint32(0x9E3779B9)))
-                        & jnp.uint32(acells - 1)).astype(jnp.int32)
-                slot = jnp.where(cvalid, slot, acells)
-                arena = jnp.full((acells,), fa_b, jnp.int32) \
-                    .at[slot].min(lane, mode="drop")
-                win = jnp.minimum(arena[jnp.minimum(slot, acells - 1)],
-                                  fa_b - 1)
-                # verify the winner really carries the same fingerprint
-                # (two distinct keys can share an arena cell) with ONE
-                # two-column row gather, not two full-lane 1-D gathers
-                fp2 = jnp.stack([exp.chi, exp.clo], axis=1)
-                wfp = fp2[win]
-                dup = cvalid & (win != lane) \
-                    & (wfp[:, 0] == exp.chi) & (wfp[:, 1] == exp.clo)
-                cvalid = cvalid & ~dup
+                # EXACT in-batch duplicate-lane drop (ops/expand.py)
+                cvalid = pre_dedup(exp, cvalid, fmax_b * n_actions)
             vcount = cvalid.sum(dtype=jnp.int32)
             kovf = vcount > kmax_b
 
@@ -344,32 +323,16 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             t_ovf = t_ovf & ~kovf
             cnt = inserted.sum(dtype=jnp.int32)
 
-            # ONE candidate matrix, gathered ONCE for the inserted lanes.
-            # Column layout is chosen so the queue block (row | ebits |
-            # state fp) and the log block (dedup key | parent fp |
-            # original fp) are each ONE contiguous column slice; the
-            # parent columns are pre-broadcast to the child axis so
-            # everything shares the same source domain.
-            cand_cols = [exp.flat,
-                         jnp.repeat(exp.ebits, n_actions)[:, None],
-                         exp.chi[:, None], exp.clo[:, None],
-                         jnp.repeat(p_whi, n_actions)[:, None],
-                         jnp.repeat(p_wlo, n_actions)[:, None]]
-            if symmetry or sound:
-                cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
-            cand = jnp.concatenate(cand_cols, axis=1)
+            # ONE candidate matrix (shared layout — ops/expand.py),
+            # gathered ONCE for the inserted lanes
+            cand, _key_col, log_off = candidate_matrix(
+                exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
             src2 = shrink_indices(inserted, kmax_b)
             n_all = cand[src[src2]]
             if sound:
                 # splice the node keys (already computed at kmax lanes)
-                # in ahead of the parent columns for the log block
-                n_all = jnp.concatenate(
-                    [n_all[:, :width + 3],
-                     k_chi[src2][:, None], k_clo[src2][:, None],
-                     n_all[:, width + 3:]], axis=1)
-            # log block columns inside n_all: key hi/lo, parent hi/lo,
-            # (original hi/lo under symmetry/sound)
-            log_off = width + 3 if sound else width + 1
+                n_all = splice_node_keys(n_all, width,
+                                         k_chi[src2], k_clo[src2])
             n_flat = n_all[:, :width]
 
             if hist_on:

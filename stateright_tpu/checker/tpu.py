@@ -479,20 +479,10 @@ class TpuChecker(HostChecker):
         # children per state) can shrink it well below the fa//2 default
         # via ``branching_hint``; a frontier that spikes past it triggers
         # the cheap kovf resize
-        hint = getattr(model, "branching_hint", None)
-        if hint:
-            k_default = min(fa, max(
-                1 << 12, -(-(fmax * hint * 5 // 4) // 256) * 256))
-        else:
-            # the in-batch pre-dedup (device_loop) drops duplicate lanes
-            # before compaction, so high-merge models need far fewer
-            # candidate lanes than fa/2; start narrow and let the kovf
-            # abort-and-rebuild protocol grow it when a batch overflows
-            # (one lost iteration, compile-cached rebuild). Sound mode
-            # skips the pre-dedup (node-key identity), so it keeps the
-            # un-deduped fa/2 sizing.
-            k_default = max(1 << 12, fa // 2 if self._sound else fa // 8)
-        kmax = min(int(opts.get("kmax", k_default)), fa)
+        from ..ops.expand import kmax_default
+        kmax = min(int(opts.get("kmax",
+                                kmax_default(model, fmax, self._sound))),
+                   fa)
         k_steps = int(opts.get("chunk_steps", 64))
         insert_fn = _insert_jit()
 
@@ -1081,6 +1071,8 @@ class TpuChecker(HostChecker):
         while segments:
             if len(discoveries) == prop_count:
                 return
+            if self._cancel_event.is_set():
+                return  # raced loser (checker/race.py): stop promptly
             rows, ebs, start, length = segments.popleft()
             bucket = _bucket(length)
             if rows.shape[0] == bucket and start == 0:

@@ -107,6 +107,85 @@ def expand_frontier(model, frontier, fvalid, ebits,
                      phi=phi, plo=plo, terminal=terminal, xovf=xovf)
 
 
+def pre_dedup(exp: Expansion, cvalid, fa: int):
+    """EXACT in-batch duplicate-lane mask: drop candidate lanes whose
+    fingerprint already appears at an earlier valid lane of this batch.
+
+    One scatter-min claim arena keyed by fingerprint hash; a losing lane
+    is dropped only when the winner's fingerprint VERIFIES equal (one
+    2-column row gather), so distinct keys colliding on an arena cell
+    are kept — sound by construction. High-merge models (2pc: >80%
+    duplicate lanes) then fit a far narrower ``kmax``, which every
+    downstream gather/probe/ring-hop scales with. Callers skip this
+    under sound mode, where dedup identity is (state, ebits) node keys
+    computed only post-compaction.
+    """
+    acells = 1 << max((2 * fa - 1).bit_length(), 0)
+    lane = jnp.arange(fa, dtype=jnp.int32)
+    slot = ((exp.clo ^ (exp.chi * jnp.uint32(0x9E3779B9)))
+            & jnp.uint32(acells - 1)).astype(jnp.int32)
+    slot = jnp.where(cvalid, slot, acells)
+    arena = jnp.full((acells,), fa, jnp.int32) \
+        .at[slot].min(lane, mode="drop")
+    win = jnp.minimum(arena[jnp.minimum(slot, acells - 1)], fa - 1)
+    fp2 = jnp.stack([exp.chi, exp.clo], axis=1)
+    wfp = fp2[win]
+    dup = cvalid & (win != lane) \
+        & (wfp[:, 0] == exp.chi) & (wfp[:, 1] == exp.clo)
+    return cvalid & ~dup
+
+
+def candidate_matrix(exp: Expansion, n_actions: int, width: int,
+                     p_whi, p_wlo, symmetry: bool, sound: bool):
+    """The per-iteration candidate matrix shared by the single-chip and
+    sharded loops, ONE concatenation whose column layout makes the queue
+    block and the log block each a contiguous slice post-compaction:
+
+      [packed row (0..W-1) | child ebits (W) | state fp hi/lo (W+1,W+2)
+       | parent key hi/lo | original fp hi/lo (symmetry/sound only)]
+
+    Under ``sound`` the caller splices node-key columns in at W+3 AFTER
+    compaction (they are computed at kmax lanes); ``key_cols`` and
+    ``log_off`` already account for that splice. Returns
+    ``(cand, key_col, log_off)`` where ``key_col`` is the dedup-key hi
+    column inside the FINAL (post-splice) layout and ``log_off`` the
+    start of the contiguous log block.
+    """
+    cand_cols = [exp.flat,
+                 jnp.repeat(exp.ebits, n_actions)[:, None],
+                 exp.chi[:, None], exp.clo[:, None],
+                 jnp.repeat(p_whi, n_actions)[:, None],
+                 jnp.repeat(p_wlo, n_actions)[:, None]]
+    if symmetry or sound:
+        cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
+    cand = jnp.concatenate(cand_cols, axis=1)
+    key_col = width + 3 if sound else width + 1
+    log_off = width + 3 if sound else width + 1
+    return cand, key_col, log_off
+
+
+def splice_node_keys(k_all, width: int, nk_hi, nk_lo):
+    """Insert the node-key columns at W+3 (sound mode, post-compaction) —
+    the splice :func:`candidate_matrix`'s key_col/log_off expect."""
+    return jnp.concatenate(
+        [k_all[:, :width + 3], nk_hi[:, None], nk_lo[:, None],
+         k_all[:, width + 3:]], axis=1)
+
+
+def kmax_default(model, fmax: int, sound: bool) -> int:
+    """Candidate-buffer width policy shared by both engines: models that
+    declare ``branching_hint`` get a hint-sized buffer; hint-less models
+    start at fa/8 (the in-batch :func:`pre_dedup` shrinks real batches
+    well below raw fa) and the kovf abort-and-rebuild protocol grows on
+    demand; sound mode skips pre-dedup and keeps the fa/2 sizing."""
+    fa = fmax * model.max_actions
+    hint = getattr(model, "branching_hint", None)
+    if hint:
+        return min(fa, max(
+            1 << 12, -(-(fmax * hint * 5 // 4) // 256) * 256))
+    return min(fa, max(1 << 12, fa // 2 if sound else fa // 8))
+
+
 def discovery_candidates(properties, exp: Expansion, fvalid,
                          whi=None, wlo=None):
     """Per-property (hit, fp_hi, fp_lo) selection on the frontier batch.

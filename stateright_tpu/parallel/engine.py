@@ -105,8 +105,12 @@ class ShardedTpuChecker(TpuChecker):
         if prop_count == 0:
             return  # vacuously done (bfs.rs:121-128)
 
+        from ..ops.expand import kmax_default
         fmax = int(opts.get("fmax", auto_fmax(model, shards=D)))
-        headroom = D * fmax * n_actions
+        fa = fmax * n_actions
+        kmax = min(int(opts.get("kmax", kmax_default(
+            model, fmax, self._sound))), fa)
+        headroom = max(D * kmax, fmax)
         # per-shard slice must keep one worst-case iteration of headroom
         # below the growth limit (same invariant as the single-chip loop)
         while self._grow_at * (self._capacity // D) \
@@ -124,19 +128,27 @@ class ShardedTpuChecker(TpuChecker):
             max((len(b) for b in init_by_shard), default=0), headroom, D)
 
         insert_fn = build_sharded_insert(mesh, axis)
+        # the queue caches STATE fps; frontier_fps (the routing/dedup
+        # keys) are node keys under sound — see seed_sharded_carry
+        cache_fps = (self._seed_cache_fps
+                     if self._resume_path is None else frontier_fps)
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
                                    init_rows, frontier_fps, seed_ebits,
                                    prop_count, symmetry=self._symmetry,
-                                   sound=self._sound)
+                                   sound=self._sound,
+                                   cache_fps=cache_fps)
         # the table seeds with EVERYTHING known (on resume: the whole
         # mirrored reached set, not just the pending frontier)
         key_hi, key_lo = self._sharded_bulk_insert(
             insert_fn, carry.key_hi, carry.key_lo, table_fps, D)
         carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
-        chunk_fn = build_sharded_chunk_fn(model, mesh, axis, qcap,
-                                          self._capacity, fmax,
-                                          symmetry=self._symmetry,
-                                          sound=self._sound)
+
+        def rebuild_chunk():
+            return build_sharded_chunk_fn(
+                model, mesh, axis, qcap, self._capacity, fmax, kmax,
+                symmetry=self._symmetry, sound=self._sound)
+
+        chunk_fn = rebuild_chunk()
 
         import jax.numpy as jnp
 
@@ -149,14 +161,27 @@ class ShardedTpuChecker(TpuChecker):
                 min(max(target - self._state_count, 0), 2**31 - 1)
                 if target is not None else 2**31 - 1)
             carry = carry._replace(gen=jnp.int32(0),
-                                   steps=jnp.int32(k_steps))
-            carry = chunk_fn(carry, remaining, grow_limit)
-            (q_head, q_tail, log_n, disc_hit, disc_hi, disc_lo, gen, ovf,
-             xovf) = jax.device_get(
-                (carry.q_head, carry.q_tail, carry.log_n, carry.disc_hit,
-                 carry.disc_hi, carry.disc_lo, carry.gen, carry.ovf,
-                 carry.xovf))
-            self._state_count += int(gen)
+                                   steps=jnp.int32(k_steps),
+                                   vmax=jnp.int32(0))
+            with self._timed("chunk"):
+                carry, stats_d = chunk_fn(carry, remaining, grow_limit)
+                # ONE transfer for everything the host reads per chunk
+                stats = np.asarray(jax.device_get(stats_d))
+            q_head = stats[:D].astype(np.int64)
+            q_tail = stats[D:2 * D].astype(np.int64)
+            log_n = stats[2 * D:3 * D].astype(np.int64)
+            gen = int(stats[3 * D])
+            ovf = bool(stats[3 * D + 1])
+            xovf = bool(stats[3 * D + 2])
+            kovf = bool(stats[3 * D + 3])
+            vmax = int(stats[3 * D + 4])
+            base = 3 * D + 5
+            disc_hit = stats[base:base + prop_count].astype(bool)
+            disc_hi = stats[base + prop_count:base + 2 * prop_count]
+            disc_lo = stats[base + 2 * prop_count:base + 3 * prop_count]
+            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+            self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
+            self._state_count += gen
             self._unique_state_count = base_unique + int(log_n.sum())
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
@@ -178,6 +203,15 @@ class ShardedTpuChecker(TpuChecker):
                 with self._timed("posthoc"):
                     self._posthoc_sharded(carry, qcap, n_init_arr,
                                           discoveries)
+            if kovf:
+                # a shard's post-dedup batch outran the candidate
+                # buffer; nothing was committed — resize and resume
+                kmax = min(max(kmax * 2,
+                               -(-(vmax + vmax // 4) // 256) * 256), fa)
+                headroom = max(D * kmax, fmax)
+                chunk_fn = rebuild_chunk()
+                carry = carry._replace(kovf=jnp.bool_(False))
+                continue
             done = (int((q_tail - q_head).sum()) == 0
                     or len(discoveries) == prop_count
                     or (target is not None
@@ -189,21 +223,21 @@ class ShardedTpuChecker(TpuChecker):
             if need_grow:
                 carry, qcap = self._grow_sharded(
                     carry, qcap, n_init, headroom, table_fps, insert_fn)
-                chunk_fn = build_sharded_chunk_fn(
-                    model, mesh, axis, qcap, self._capacity, fmax,
-                    symmetry=self._symmetry, sound=self._sound)
+                chunk_fn = rebuild_chunk()
 
         if self._tpu_options.get("resumable"):
             # pull the pending per-shard frontiers eagerly so save()
             # needs no pinned device buffers; the checkpoint format is
             # the single-chip one (shard-agnostic)
             qloc = qcap // D
-            q_rows_h, q_eb_h, qh, qt = jax.device_get(
-                (carry.q_rows, carry.q_eb, carry.q_head, carry.q_tail))
-            rows_l = [q_rows_h[s * qloc + int(qh[s]):
-                               s * qloc + int(qt[s])] for s in range(D)]
-            ebs_l = [q_eb_h[s * qloc + int(qh[s]):
-                            s * qloc + int(qt[s])] for s in range(D)]
+            width = model.packed_width
+            q_h, qh, qt = jax.device_get(
+                (carry.q, carry.q_head, carry.q_tail))
+            rows_l = [q_h[s * qloc + int(qh[s]):
+                          s * qloc + int(qt[s]), :width]
+                      for s in range(D)]
+            ebs_l = [q_h[s * qloc + int(qh[s]):
+                         s * qloc + int(qt[s]), width] for s in range(D)]
             self._resume_frontier = (np.concatenate(rows_l),
                                      np.concatenate(ebs_l))
         self._finalize_sharded(carry)
@@ -252,19 +286,17 @@ class ShardedTpuChecker(TpuChecker):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..ops.hashtable import _BUCKET
+
         mesh, axis = self._mesh, self._axis
         D = mesh.shape[axis]
         # pull only what the rebuild reads — NOT the old table halves,
         # which are discarded and re-derived from the logs
-        h = carry._replace(
-            key_hi=None, key_lo=None, ovf=None, go=None)._replace(
-            **jax.device_get({
-                f: getattr(carry, f)
-                for f in ("q_rows", "q_eb", "q_head", "q_tail",
-                          "log_chi", "log_clo", "log_phi", "log_plo",
-                          "log_ohi", "log_olo",
-                          "log_n", "disc_hit", "disc_hi", "disc_lo",
-                          "gen", "xovf", "steps")}))
+        (q_h, qh, qt, log_h, ln_h, disc_hit, disc_hi, disc_lo, gen,
+         xovf, steps) = jax.device_get(
+            (carry.q, carry.q_head, carry.q_tail, carry.log,
+             carry.log_n, carry.disc_hit, carry.disc_hi, carry.disc_lo,
+             carry.gen, carry.xovf, carry.steps))
         old_qloc = qcap // D
         old_closc = self._capacity // D
         self._capacity *= 4
@@ -272,70 +304,51 @@ class ShardedTpuChecker(TpuChecker):
         qloc = new_qcap // D
         closc = self._capacity // D
         width = self._model.packed_width
+        log_w = log_h.shape[1]
 
-        q_rows = np.zeros((new_qcap, width), dtype=np.uint32)
-        q_eb = np.zeros((new_qcap,), dtype=np.uint32)
-        log_chi = np.zeros((self._capacity,), dtype=np.uint32)
-        log_clo = np.zeros((self._capacity,), dtype=np.uint32)
-        log_phi = np.zeros((self._capacity,), dtype=np.uint32)
-        log_plo = np.zeros((self._capacity,), dtype=np.uint32)
-        oshape = self._capacity if self._symmetry or self._sound else D
-        log_ohi = np.zeros((oshape,), dtype=np.uint32)
-        log_olo = np.zeros((oshape,), dtype=np.uint32)
+        q = np.zeros((new_qcap, width + 3), dtype=np.uint32)
+        log = np.zeros((self._capacity, log_w), dtype=np.uint32)
         for s in range(D):
-            tail = int(h.q_tail[s])
-            q_rows[s * qloc:s * qloc + tail] = \
-                h.q_rows[s * old_qloc:s * old_qloc + tail]
-            q_eb[s * qloc:s * qloc + tail] = \
-                h.q_eb[s * old_qloc:s * old_qloc + tail]
-            ln = int(h.log_n[s])
-            src = slice(s * old_closc, s * old_closc + ln)
-            dst = slice(s * closc, s * closc + ln)
-            log_chi[dst] = h.log_chi[src]
-            log_clo[dst] = h.log_clo[src]
-            log_phi[dst] = h.log_phi[src]
-            log_plo[dst] = h.log_plo[src]
-            if self._symmetry or self._sound:
-                log_ohi[dst] = h.log_ohi[src]
-                log_olo[dst] = h.log_olo[src]
+            tail = int(qt[s])
+            q[s * qloc:s * qloc + tail] = \
+                q_h[s * old_qloc:s * old_qloc + tail]
+            ln = int(ln_h[s])
+            log[s * closc:s * closc + ln] = \
+                log_h[s * old_closc:s * old_closc + ln]
 
         sh = NamedSharding(mesh, P(axis))
         rep = NamedSharding(mesh, P())
         key_hi = jax.device_put(
-            np.zeros((self._capacity,), np.uint32), sh)
+            np.zeros((self._capacity // _BUCKET, _BUCKET), np.uint32), sh)
         key_lo = jax.device_put(
-            np.zeros((self._capacity,), np.uint32), sh)
+            np.zeros((self._capacity // _BUCKET, _BUCKET), np.uint32), sh)
         # rebuild the table device-side: each shard's log slice holds
         # exactly the fps it owns; only the init fps need host routing
         from .sharded import build_sharded_rebuild
-        d_log_chi = jax.device_put(log_chi, sh)
-        d_log_clo = jax.device_put(log_clo, sh)
-        d_log_n = jax.device_put(h.log_n, sh)
+        d_log = jax.device_put(log, sh)
+        d_log_n = jax.device_put(ln_h, sh)
         key_hi, key_lo, r_ovf = build_sharded_rebuild(mesh, axis)(
-            key_hi, key_lo, d_log_chi, d_log_clo, d_log_n)
+            key_hi, key_lo, d_log, d_log_n)
         if bool(jax.device_get(r_ovf)):
             raise RuntimeError("overflow while re-inserting during growth")
         key_hi, key_lo = self._sharded_bulk_insert(
             insert_fn, key_hi, key_lo, init_fps, D)
         new_carry = ShardedCarry(
-            q_rows=jax.device_put(q_rows, sh),
-            q_eb=jax.device_put(q_eb, sh),
-            q_head=jax.device_put(h.q_head, sh),
-            q_tail=jax.device_put(h.q_tail, sh),
+            q=jax.device_put(q, sh),
+            q_head=jax.device_put(qh, sh),
+            q_tail=jax.device_put(qt, sh),
             key_hi=key_hi, key_lo=key_lo,
-            log_chi=d_log_chi, log_clo=d_log_clo,
-            log_phi=jax.device_put(log_phi, sh),
-            log_plo=jax.device_put(log_plo, sh),
-            log_ohi=jax.device_put(log_ohi, sh),
-            log_olo=jax.device_put(log_olo, sh),
-            log_n=jax.device_put(h.log_n, sh),
-            disc_hit=jax.device_put(h.disc_hit, rep),
-            disc_hi=jax.device_put(h.disc_hi, rep),
-            disc_lo=jax.device_put(h.disc_lo, rep),
-            gen=jax.device_put(h.gen, rep),
+            log=d_log,
+            log_n=jax.device_put(ln_h, sh),
+            disc_hit=jax.device_put(disc_hit, rep),
+            disc_hi=jax.device_put(disc_hi, rep),
+            disc_lo=jax.device_put(disc_lo, rep),
+            gen=jax.device_put(gen, rep),
             ovf=jax.device_put(np.bool_(False), rep),
-            xovf=jax.device_put(h.xovf, rep),
-            steps=jax.device_put(h.steps, rep),
+            xovf=jax.device_put(xovf, rep),
+            kovf=jax.device_put(np.bool_(False), rep),
+            vmax=jax.device_put(np.int32(0), rep),
+            steps=jax.device_put(steps, rep),
             go=jax.device_put(np.bool_(False), rep))
         return new_carry, new_qcap
 
@@ -361,8 +374,7 @@ class ShardedTpuChecker(TpuChecker):
             fn = build_sharded_posthoc(model, mesh, axis, qcap,
                                        self._capacity, hmax)
             (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf, over) = fn(
-                carry.q_rows, carry.q_tail, carry.log_chi, carry.log_clo,
-                n_init_d)
+                carry.q, carry.q_tail, carry.log, n_init_d)
             hcount, tovf, over = jax.device_get((hcount_d, tovf, over))
             if bool(tovf):
                 raise RuntimeError(
@@ -391,27 +403,35 @@ class ShardedTpuChecker(TpuChecker):
 
     # ------------------------------------------------------------------
     def _finalize_sharded(self, carry: ShardedCarry) -> None:
-        """Pull the per-shard logs and complete the host mirror."""
+        """Stash the device-resident per-shard logs; the host mirror is
+        completed lazily on first use (`_ensure_mirror`) — the log pull
+        is ~tens of MB over a ~35 MB/s link, pointless for count-only
+        runs (the unique count comes from the stats vector)."""
+        self._mirror_carry = ("sharded", carry.log, carry.log_n)
+
+    def _ensure_mirror(self) -> None:
+        mirror = getattr(self, "_mirror_carry", None)
+        if mirror is None or mirror[0] != "sharded":
+            return super()._ensure_mirror()
+        self._mirror_carry = None
+        _tag, log_d, log_n_d = mirror
         import jax
 
-        D = self._mesh.shape[self._axis]
-        closc = self._capacity // D
-        log_n, log_chi, log_clo, log_phi, log_plo = jax.device_get(
-            (carry.log_n, carry.log_chi, carry.log_clo, carry.log_phi,
-             carry.log_plo))
-        log_ohi = log_olo = None
-        if self._symmetry or self._sound:
-            log_ohi, log_olo = jax.device_get(
-                (carry.log_ohi, carry.log_olo))
-        for s in range(D):
-            ln = int(log_n[s])
-            if not ln:
-                continue
-            src = slice(s * closc, s * closc + ln)
-            child = _combine64(log_chi[src], log_clo[src])
-            parent = _combine64(log_phi[src], log_plo[src])
-            self._generated.update(zip(child.tolist(), parent.tolist()))
-            if self._symmetry or self._sound:
-                orig = _combine64(log_ohi[src], log_olo[src])
-                self._orig_of.update(zip(child.tolist(), orig.tolist()))
-        self._unique_state_count = len(self._generated)
+        with self._timed("mirror_pull"):
+            D = self._mesh.shape[self._axis]
+            closc = self._capacity // D
+            log_n, log = jax.device_get((log_n_d, log_d))
+            for s in range(D):
+                ln = int(log_n[s])
+                if not ln:
+                    continue
+                blk = log[s * closc:s * closc + ln]
+                child = _combine64(blk[:, 0], blk[:, 1])
+                parent = _combine64(blk[:, 2], blk[:, 3])
+                self._generated.update(zip(child.tolist(),
+                                           parent.tolist()))
+                if self._symmetry or self._sound:
+                    orig = _combine64(blk[:, 4], blk[:, 5])
+                    self._orig_of.update(zip(child.tolist(),
+                                             orig.tolist()))
+            self._unique_state_count = len(self._generated)

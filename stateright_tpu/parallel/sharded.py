@@ -6,7 +6,7 @@ Replaces the reference's shared-memory job market
 `bfs.rs:138-150`) with fingerprint-prefix ownership over a
 ``jax.sharding.Mesh``:
 
-  * the pending-state ring queue, the visited hash table, and the
+  * the pending-state queue, the visited hash table, and the
     (child fp, parent fp) log are all sharded over one mesh axis (default
     ``"shards"``) — every shard owns a ``1/D`` slice of each;
   * a state is *owned* by the shard selected by the top ``log2(D)`` bits of
@@ -14,11 +14,14 @@ Replaces the reference's shared-memory job market
     state is only ever deduplicated (and expanded) by one shard;
   * each iteration, every shard dequeues up to ``fmax`` local rows, expands
     them (vmapped ``packed_step`` via the shared `ops/expand.py` core),
-    fingerprints the children, and routes them to their owners with a
-    **ring exchange** (``lax.ppermute`` over ICI): D hops, and at each hop a
-    shard claims the in-flight children it owns, inserts them into its local
-    table slice, logs them, and appends the fresh ones to its local queue.
-    After D hops every child has passed its owner exactly once.
+    fingerprints the children, drops in-batch duplicate lanes (the same
+    exact scatter-min pre-dedup as the single-chip loop), **compacts the
+    survivors to a ``kmax``-lane candidate matrix**, and routes that to
+    owners with a **ring exchange** (``lax.ppermute`` over ICI): D hops,
+    and at each hop a shard claims the in-flight children it owns,
+    inserts them into its local table slice, and appends the fresh rows
+    to its local queue and log with two contiguous block writes. After D
+    hops every child has passed its owner exactly once.
 
 The whole multi-level search runs inside one ``lax.while_loop`` under
 ``shard_map`` — one launch per K-iteration chunk regardless of chip count,
@@ -26,18 +29,25 @@ exactly like the single-chip device loop (`checker/device_loop.py`).
 Termination, generation counters, and discovery registers are psum-reduced
 each iteration so the loop condition is a replicated scalar and all shards
 exit in lockstep (the distributed analog of "all threads waiting and no
-jobs", `bfs.rs:94-98`).
+jobs", `bfs.rs:94-98`). Everything the host reads per chunk rides ONE
+replicated uint32 stats vector (a device->host transfer costs ~100 ms of
+tunnel latency regardless of size — NOTES.md round 4).
 
-The ring costs D permutes of the full child buffer; a bucketed
-``all_to_all`` would move less data but needs per-destination compaction.
-The ring is chosen because every hop is a fixed-size neighbor transfer
-(pure ICI, no host), and D is small on a single slice.
+The ring costs D permutes of the kmax-lane candidate matrix. Compacting
+to ``kmax`` BEFORE the ring (round 4) cut the permuted bytes by the
+pre-dedup's duplicate factor times the invalid-lane factor (~8x on 2pc)
+— this, not a bucketed ``all_to_all``, was the data-volume fix; a
+bucketed exchange would still need the same compaction first and adds
+per-destination bookkeeping.
 
 Queue-overflow safety is static: the loop condition requires every shard's
-queue to have ``D * fmax * max_actions`` free slots — the worst case of one
-iteration routing every child in the machine to a single owner — before
-another iteration may start, so ring-buffer writes can never wrap onto live
-entries.
+queue to have ``D * kmax`` free slots — the worst case of one iteration
+routing every candidate in the machine to a single owner — before another
+iteration may start, so block appends can never overrun a slice.
+
+Like the single-chip loop, a batch whose post-dedup valid-children count
+exceeds ``kmax`` aborts the iteration BEFORE any mutation (``kovf``), and
+the host rebuilds with a doubled ``kmax`` — no work is lost.
 """
 
 from __future__ import annotations
@@ -50,10 +60,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.expand import (discovery_candidates, eventually_indices,
-                          expand_frontier)
+from ..ops.expand import (candidate_matrix, discovery_candidates,
+                          eventually_indices, expand_frontier, pre_dedup,
+                          splice_node_keys)
 from ..ops.hash_kernel import fp64_node_device
-from ..ops.hashtable import table_insert
+from ..ops.hashtable import _BUCKET, table_insert
 
 
 class ShardedCarry(NamedTuple):
@@ -68,18 +79,18 @@ class ShardedCarry(NamedTuple):
     (post-hoc host-property evaluation, checkpointing).
     """
 
-    q_rows: jax.Array   # uint32[D*qloc, W] per-shard append-only queues
-    q_eb: jax.Array     # uint32[D*qloc]    their eventually-bits
+    q: jax.Array        # uint32[D*qloc, W+3] per-shard append-only queues:
+    #                     packed row | eventually-bits | cached state fp
+    #                     hi/lo (expansion never re-hashes the frontier)
     q_head: jax.Array   # int32[D]          per-shard next row to expand
     q_tail: jax.Array   # int32[D]          per-shard next free row
-    key_hi: jax.Array   # uint32[C]         visited table (C/D per shard)
-    key_lo: jax.Array   # uint32[C]
-    log_chi: jax.Array  # uint32[C]         child fp, insertion order
-    log_clo: jax.Array  # uint32[C]
-    log_phi: jax.Array  # uint32[C]         parent fp
-    log_plo: jax.Array  # uint32[C]
-    log_ohi: jax.Array  # uint32[C | D]     child ORIGINAL fp (symmetry
-    log_olo: jax.Array  #                   only; 1-per-shard dummy else)
+    key_hi: jax.Array   # uint32[C/4, 4]    visited table, bucket-major
+    key_lo: jax.Array   #                   (C/D slots per shard), 2-D so
+    #                                       the probe pays no per-iteration
+    #                                       tile-layout conversion
+    log: jax.Array      # uint32[C, 4|6]    insertion-order log: child fp
+    #                     hi/lo (node keys under sound), parent fp hi/lo,
+    #                     original fp hi/lo (symmetry/sound only)
     log_n: jax.Array    # int32[D]          per-shard log length
     disc_hit: jax.Array  # bool[P]    replicated: property discovered?
     disc_hi: jax.Array   # uint32[P]  replicated: witness fp (sticky first)
@@ -87,6 +98,10 @@ class ShardedCarry(NamedTuple):
     gen: jax.Array      # int32[]  replicated: states generated this chunk
     ovf: jax.Array      # bool[]   replicated: table probe overflow
     xovf: jax.Array     # bool[]   replicated: model capacity overflow
+    kovf: jax.Array     # bool[]   replicated: kmax candidate overflow
+    #                              (host rebuilds with doubled kmax)
+    vmax: jax.Array     # int32[]  replicated: max post-dedup children in
+    #                              one shard-iteration this chunk
     steps: jax.Array    # int32[]  replicated: remaining step budget
     go: jax.Array       # bool[]   replicated: loop condition
 
@@ -100,26 +115,25 @@ def carry_specs(axis: str) -> ShardedCarry:
     """PartitionSpecs for each carry field."""
     s, r = P(axis), P()
     return ShardedCarry(
-        q_rows=s, q_eb=s, q_head=s, q_tail=s, key_hi=s, key_lo=s,
-        log_chi=s, log_clo=s, log_phi=s, log_plo=s,
-        log_ohi=s, log_olo=s, log_n=s,
+        q=s, q_head=s, q_tail=s, key_hi=s, key_lo=s, log=s, log_n=s,
         disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
-        steps=r, go=r)
+        kovf=r, vmax=r, steps=r, go=r)
 
 
 _SHARDED_CACHE: dict = {}
 
 
 def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
-                           capacity: int, fmax: int,
+                           capacity: int, fmax: int, kmax: int,
                            symmetry: bool = False, sound: bool = False):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
     ``qcap // D`` / ``capacity // D`` slice. Returned callable:
-    ``chunk(carry, target_remaining, grow_limit) -> carry`` where
-    ``grow_limit`` bounds any single shard's log length (the host grows all
-    buffers when a shard approaches its slice capacity).
+    ``chunk(carry, target_remaining, grow_limit) -> (carry, stats)``
+    where ``grow_limit`` bounds any single shard's log length (the host
+    grows all buffers when a shard approaches its slice capacity) and
+    ``stats`` is the replicated uint32 sync vector (see `_stats_layout`).
 
     With ``sound`` (``CheckerBuilder.sound_eventually()``), dedup,
     ownership routing, and the log work on (state, pending-ebits) NODE
@@ -134,13 +148,13 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     mkey = model_cache_key(model)
     key = None
     if mkey is not None:
-        key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax,
+        key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax, kmax,
                symmetry, sound)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
-                                 fmax, symmetry, sound)
+                                 fmax, kmax, symmetry, sound)
     if key is not None:
         if len(_SHARDED_CACHE) >= 64:
             _SHARDED_CACHE.clear()
@@ -149,31 +163,39 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
 
 def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
-                            capacity: int, fmax: int,
+                            capacity: int, fmax: int, kmax: int,
                             symmetry: bool = False,
                             sound: bool = False):
+    from ..checker.device_loop import shrink_indices
+
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
     qloc = qcap // D
     closc = capacity // D
     assert closc & (closc - 1) == 0, "per-shard table must be a power of two"
     n_actions = model.max_actions
+    width = model.packed_width
     properties = model.properties()
     prop_count = len(properties)
     eventually_idx = eventually_indices(properties)
     host_idx = frozenset(getattr(model, "host_property_indices", ()))
     device_prop_idx = [i for i in range(prop_count) if i not in host_idx]
     logcap = closc
-    # worst case: every child generated machine-wide lands on one shard
-    ring_headroom = D * fmax * n_actions
+    fa = fmax * n_actions
+    kmax = min(kmax, fa)
+    # the queue slice must cover BOTH the worst-case routed appends
+    # (every candidate machine-wide on one shard: D*kmax rows) and the
+    # frontier dequeue (fmax rows — dynamic_slice would silently CLAMP
+    # its start near the end of the queue otherwise)
+    ring_headroom = max(D * kmax, fmax)
     ring = [(i, (i + 1) % D) for i in range(D)]
 
-    def go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf, steps,
-                target_remaining, grow_limit):
+    def go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf, kovf,
+                steps, target_remaining, grow_limit):
         total_q = lax.psum(q_tail - q_head, axis)
         max_tail = lax.pmax(q_tail, axis)
         max_log = lax.pmax(log_n, axis)
-        go = ((total_q > 0) & (steps > 0) & ~ovf & ~xovf
+        go = ((total_q > 0) & (steps > 0) & ~ovf & ~xovf & ~kovf
               & (gen < target_remaining)
               & (max_log < grow_limit)
               & (max_tail <= qloc - ring_headroom))
@@ -187,70 +209,34 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         q_head, q_tail, log_n = c.q_head[0], c.q_tail[0], c.log_n[0]
 
         take = jnp.minimum(q_tail - q_head, fmax)
-        frontier = lax.dynamic_slice(c.q_rows, (q_head, 0),
-                                     (fmax, c.q_rows.shape[1]))
-        ebits = lax.dynamic_slice(c.q_eb, (q_head,), (fmax,))
+        sl = lax.dynamic_slice(c.q, (q_head, 0), (fmax, width + 3))
+        frontier = sl[:, :width]
+        ebits = sl[:, width]
+        pfp = (sl[:, width + 1], sl[:, width + 2])
         fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
 
-        # shared check_block analog (ops/expand.py) on local rows
+        # shared check_block analog (ops/expand.py) on local rows; the
+        # frontier fingerprints come from the queue cache, not a re-hash
         exp = expand_frontier(model, frontier, fvalid, ebits,
-                              eventually_idx, symmetry=symmetry)
+                              eventually_idx, symmetry=symmetry, pfp=pfp)
+        cvalid = exp.cvalid
+        gen_count = cvalid.sum(dtype=jnp.int32)
+        if not sound:
+            # EXACT in-batch duplicate-lane drop (ops/expand.py): local
+            # duplicates never enter the ring
+            cvalid = pre_dedup(exp, cvalid, fa)
+        vcount = cvalid.sum(dtype=jnp.int32)
+        kovf = c.kovf | (lax.psum((vcount > kmax).astype(jnp.int32),
+                                  axis) > 0)
+
         if sound:
-            # node keys: dedup/routing identity = (state fp, pending
-            # ebits); the parent's node used its at-enqueue bits
             p_whi, p_wlo = fp64_node_device(exp.phi, exp.plo, ebits)
-            ceb = jnp.repeat(exp.ebits, n_actions)
-            k_chi, k_clo = fp64_node_device(exp.chi, exp.clo, ceb)
         else:
             p_whi, p_wlo = exp.phi, exp.plo
-            ceb = jnp.repeat(exp.ebits, n_actions)
-            k_chi, k_clo = exp.chi, exp.clo
-        par_hi = jnp.repeat(p_whi, n_actions)
-        par_lo = jnp.repeat(p_wlo, n_actions)
-        if kbits:
-            owner = k_chi >> jnp.uint32(32 - kbits)
-        else:
-            owner = jnp.zeros_like(k_chi)
-
-        q_head = q_head + take
-        key_hi, key_lo = c.key_hi, c.key_lo
-        q_rows, q_eb = c.q_rows, c.q_eb
-        log_chi, log_clo = c.log_chi, c.log_clo
-        log_phi, log_plo = c.log_phi, c.log_plo
-        log_ohi, log_olo = c.log_ohi, c.log_olo
-        t_ovf = jnp.bool_(False)
-
-        # ownership routing: D hops around the ring; each shard claims and
-        # dedups the in-flight children it owns, then forwards the rest
-        rc = (exp.flat, k_chi, k_clo, par_hi, par_lo, ceb, exp.cvalid,
-              owner) + ((exp.ohi, exp.olo) if symmetry or sound else ())
-        for hop in range(D):
-            (flat_c, chi_c, clo_c, phi_c, plo_c, ceb_c, val_c,
-             own_c) = rc[:8]
-            mine = val_c & (own_c == me)
-            inserted, key_hi, key_lo, o = table_insert(
-                key_hi, key_lo, chi_c, clo_c, mine)
-            t_ovf = t_ovf | o
-            cnt = inserted.sum(dtype=jnp.int32)
-            pos = jnp.cumsum(inserted.astype(jnp.int32)) - 1
-            qidx = jnp.where(inserted, q_tail + pos, qloc)
-            q_rows = q_rows.at[qidx].set(flat_c, mode="drop")
-            q_eb = q_eb.at[qidx].set(ceb_c, mode="drop")
-            lidx = jnp.where(inserted, log_n + pos, logcap)
-            log_chi = log_chi.at[lidx].set(chi_c, mode="drop")
-            log_clo = log_clo.at[lidx].set(clo_c, mode="drop")
-            log_phi = log_phi.at[lidx].set(phi_c, mode="drop")
-            log_plo = log_plo.at[lidx].set(plo_c, mode="drop")
-            if symmetry or sound:
-                log_ohi = log_ohi.at[lidx].set(rc[8], mode="drop")
-                log_olo = log_olo.at[lidx].set(rc[9], mode="drop")
-            q_tail = q_tail + cnt
-            log_n = log_n + cnt
-            if D > 1 and hop < D - 1:
-                rc = tuple(lax.ppermute(x, axis, ring) for x in rc)
 
         # sticky discovery registers: pick the lowest-indexed shard with a
-        # local candidate, broadcast its fingerprint via psum
+        # local candidate, broadcast its fingerprint via psum (idempotent:
+        # safe under kovf re-expansion)
         disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
         if prop_count:
             hit_l, cand_hi, cand_lo = discovery_candidates(
@@ -266,36 +252,106 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             disc_lo = jnp.where(keep, disc_lo, g_lo)
             disc_hit = disc_hit | g_hit
 
-        gen = c.gen + lax.psum(exp.cvalid.sum(dtype=jnp.int32), axis)
-        ovf = c.ovf | (lax.psum(t_ovf.astype(jnp.int32), axis) > 0)
+        # compact the candidates to kmax lanes BEFORE the ring: the D-hop
+        # exchange and every per-hop insert/append then run at kmax, not
+        # fa. Same candidate layout as the single-chip loop
+        # (ops/expand.py): queue block = [:, :W+3], log block = one
+        # contiguous slice starting at log_off.
+        src = shrink_indices(cvalid, kmax)
+        kvalid = (jnp.arange(kmax, dtype=jnp.int32) < vcount) & ~kovf
+        cand, key_col, log_off = candidate_matrix(
+            exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
+        k_all = cand[src]
+        if sound:
+            nk_hi, nk_lo = fp64_node_device(
+                k_all[:, width + 1], k_all[:, width + 2],
+                k_all[:, width])
+            k_all = splice_node_keys(k_all, width, nk_hi, nk_lo)
+
+        if kbits:
+            owner = k_all[:, key_col] >> jnp.uint32(32 - kbits)
+        else:
+            owner = jnp.zeros((kmax,), jnp.uint32)
+
+        take = jnp.where(kovf, 0, take)
+        q_head = q_head + take
+        key_hi, key_lo = c.key_hi, c.key_lo
+        q, log = c.q, c.log
+        t_ovf = jnp.bool_(False)
+
+        # ownership routing: D hops around the ring; each shard claims and
+        # dedups the in-flight children it owns, then forwards the buffer
+        rc = (k_all, kvalid, owner)
+        for hop in range(D):
+            k_c, val_c, own_c = rc
+            mine = val_c & (own_c == me)
+            inserted, key_hi, key_lo, o = table_insert(
+                key_hi, key_lo, k_c[:, key_col], k_c[:, key_col + 1],
+                mine)
+            t_ovf = t_ovf | o
+            cnt = inserted.sum(dtype=jnp.int32)
+            src2 = shrink_indices(inserted, kmax)
+            n_all = k_c[src2]
+            q = lax.dynamic_update_slice(
+                q, n_all[:, :width + 3], (q_tail, 0))
+            log = lax.dynamic_update_slice(
+                log, n_all[:, log_off:log_off + c.log.shape[1]],
+                (log_n, 0))
+            q_tail = q_tail + cnt
+            log_n = log_n + cnt
+            if D > 1 and hop < D - 1:
+                rc = tuple(lax.ppermute(x, axis, ring) for x in rc)
+
+        gen = c.gen + jnp.where(
+            kovf, 0, lax.psum(gen_count, axis))
+        ovf = c.ovf | ((lax.psum(t_ovf.astype(jnp.int32), axis) > 0)
+                       & ~kovf)
         xovf = c.xovf | (lax.psum(exp.xovf.astype(jnp.int32), axis) > 0)
+        vmax = jnp.maximum(c.vmax, lax.pmax(vcount, axis))
         steps = c.steps - 1
         go = go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf,
-                     steps, target_remaining, grow_limit)
+                     kovf, steps, target_remaining, grow_limit)
         nc = ShardedCarry(
-            q_rows=q_rows, q_eb=q_eb,
-            q_head=q_head[None], q_tail=q_tail[None],
+            q=q, q_head=q_head[None], q_tail=q_tail[None],
             key_hi=key_hi, key_lo=key_lo,
-            log_chi=log_chi, log_clo=log_clo,
-            log_phi=log_phi, log_plo=log_plo,
-            log_ohi=log_ohi, log_olo=log_olo, log_n=log_n[None],
+            log=log, log_n=log_n[None],
             disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
-            gen=gen, ovf=ovf, xovf=xovf, steps=steps, go=go)
+            gen=gen, ovf=ovf, xovf=xovf, kovf=kovf, vmax=vmax,
+            steps=steps, go=go)
         return (nc, target_remaining, grow_limit)
 
     def local_chunk(carry, target_remaining, grow_limit):
         go = go_flag(carry.q_head[0], carry.q_tail[0], carry.log_n[0],
                      carry.disc_hit, carry.gen, carry.ovf, carry.xovf,
-                     carry.steps, target_remaining, grow_limit)
+                     carry.kovf, carry.steps, target_remaining,
+                     grow_limit)
         out, _, _ = lax.while_loop(
             lambda s: s[0].go, body,
             (carry._replace(go=go), target_remaining, grow_limit))
-        return out
+        # ONE replicated sync vector for everything the host reads per
+        # chunk (layout parsed by parallel/engine.py — keep in sync):
+        # [q_head[D], q_tail[D], log_n[D],
+        #  gen, ovf, xovf, kovf, vmax,
+        #  disc_hit[P], disc_hi[P], disc_lo[P]]
+        hs = lax.all_gather(out.q_head, axis, tiled=True)
+        ts = lax.all_gather(out.q_tail, axis, tiled=True)
+        ls = lax.all_gather(out.log_n, axis, tiled=True)
+        stats = jnp.concatenate([
+            hs.astype(jnp.uint32), ts.astype(jnp.uint32),
+            ls.astype(jnp.uint32),
+            jnp.stack([out.gen,
+                       out.ovf.astype(jnp.int32),
+                       out.xovf.astype(jnp.int32),
+                       out.kovf.astype(jnp.int32),
+                       out.vmax]).astype(jnp.uint32),
+            out.disc_hit.astype(jnp.uint32),
+            out.disc_hi, out.disc_lo])
+        return out, stats
 
     specs = carry_specs(axis)
     fn = jax.shard_map(
         local_chunk, mesh=mesh,
-        in_specs=(specs, P(), P()), out_specs=specs,
+        in_specs=(specs, P(), P()), out_specs=(specs, P()),
         # the hash kernel's scan carry starts axis-invariant and becomes
         # varying; skip the varying-manual-axes check rather than thread
         # pcasts through shared kernels
@@ -305,7 +361,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
 def build_sharded_insert(mesh: Mesh, axis: str):
     """Jitted SPMD bulk insert: each shard inserts its block of the global
-    fingerprint arrays into its local table slice."""
+    fingerprint arrays into its local (2-D bucket-major) table slice."""
     key = ("insert", mesh, axis)
     cached = _SHARDED_CACHE.get(key)
     if cached is not None:
@@ -333,15 +389,15 @@ def build_sharded_rebuild(mesh: Mesh, axis: str):
     if cached is not None:
         return cached
 
-    def local(key_hi, key_lo, log_chi, log_clo, log_n):
-        valid = jnp.arange(log_chi.shape[0], dtype=jnp.int32) < log_n[0]
-        _, khi, klo, ovf = table_insert(key_hi, key_lo, log_chi, log_clo,
-                                        valid)
+    def local(key_hi, key_lo, log, log_n):
+        valid = jnp.arange(log.shape[0], dtype=jnp.int32) < log_n[0]
+        _, khi, klo, ovf = table_insert(key_hi, key_lo, log[:, 0],
+                                        log[:, 1], valid)
         return khi, klo, lax.psum(ovf.astype(jnp.int32), axis) > 0
 
     s = P(axis)
     fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(s, s, s, s, s),
+                       in_specs=(s, s, s, s),
                        out_specs=(s, s, P()), check_vma=False)
     fn = jax.jit(fn)
     _SHARDED_CACHE[key] = fn
@@ -368,8 +424,9 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
     D = mesh.shape[axis]
     qloc = qcap // D
     closc = capacity // D
+    width = model.packed_width
     cols = getattr(model, "host_property_cols", None)
-    off, hw = cols if cols is not None else (0, model.packed_width)
+    off, hw = cols if cols is not None else (0, width)
     mkey = model_cache_key(model)
     key = None
     if mkey is not None:
@@ -378,19 +435,19 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
         if cached is not None:
             return cached
 
-    def local(q_rows, q_tail, log_chi, log_clo, n_init):
-        key_cols = q_rows[:, off:off + hw]
+    def local(q, q_tail, log, n_init):
+        key_cols = q[:, off:off + hw]
         hhi, hlo = fp64_device(key_cols)
         valid = jnp.arange(qloc, dtype=jnp.int32) < q_tail[0]
-        khi = jnp.zeros((closc,), jnp.uint32)
-        klo = jnp.zeros((closc,), jnp.uint32)
+        khi = jnp.zeros((closc // _BUCKET, _BUCKET), jnp.uint32)
+        klo = jnp.zeros((closc // _BUCKET, _BUCKET), jnp.uint32)
         inserted, khi, klo, ovf = table_insert(khi, klo, hhi, hlo, valid)
         hcount = inserted.sum(dtype=jnp.int32)
         src = shrink_indices(inserted, hmax)
-        out_rows = q_rows[src]
+        out_rows = q[src][:, :width]
         li = jnp.maximum(src - n_init[0], 0)
-        w_hi = log_chi[li]
-        w_lo = log_clo[li]
+        w_hi = log[li, 0]
+        w_lo = log[li, 1]
         tovf = lax.psum(ovf.astype(jnp.int32), axis) > 0
         over = lax.psum((hcount > hmax).astype(jnp.int32), axis) > 0
         return (out_rows, src[None, :], w_hi[None, :], w_lo[None, :],
@@ -399,7 +456,7 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
     s = P(axis)
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(s, s, s, s, s),
+        in_specs=(s, s, s, s),
         out_specs=(s, s, s, s, s, P(), P()), check_vma=False)
     fn = jax.jit(fn)
     if key is not None:
@@ -410,51 +467,79 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
 def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                        capacity: int, init_rows, init_fps, full_ebits,
                        prop_count: int, symmetry: bool = False,
-                       sound: bool = False) -> ShardedCarry:
-    """Host-side construction of the initial sharded carry: init states
-    routed to their owner shards' queues. The caller inserts the init
-    fingerprints into the table via :func:`build_sharded_insert`."""
+                       sound: bool = False,
+                       cache_fps=None) -> ShardedCarry:
+    """Construct the initial sharded carry ON DEVICE: the host routes
+    only the init rows (tiny) to their owner shards' blocks; every big
+    buffer is zeroed by a shard_map'd device program. device_put-ing
+    host zeros for the queue/table/log uploaded ~160 MB through the
+    ~35 MB/s tunnel (NOTES.md round 4) — most of a small run's wall
+    time. The caller inserts the init fingerprints into the table via
+    :func:`build_sharded_insert`.
+
+    ``init_fps`` are the DEDUP keys (node keys under sound) — they pick
+    the owner shard, matching the in-loop routing. ``cache_fps`` are the
+    STATE fingerprints cached in the queue's fp columns (the loop
+    re-derives node keys from them plus each row's ebits); they default
+    to ``init_fps``, which is only correct outside sound mode."""
     D = mesh.shape[axis]
     qloc = qcap // D
     width = model.packed_width
-    q_rows = np.zeros((qcap, width), dtype=np.uint32)
-    q_eb = np.zeros((qcap,), dtype=np.uint32)
-    q_tail = np.zeros((D,), dtype=np.int32)
-    # scalar ebits for fresh runs, per-row when resuming a checkpointed
-    # frontier
+    log_w = 6 if symmetry or sound else 4
+    if cache_fps is None:
+        cache_fps = init_fps
+
+    # host-side routing of the init rows into per-shard blocks
+    per_shard: list = [[] for _ in range(D)]
     ebs = np.broadcast_to(np.asarray(full_ebits, np.uint32),
                           (len(init_rows),))
     for i, (row, fp) in enumerate(zip(init_rows, init_fps)):
         s = owner_of(fp, D)
-        assert q_tail[s] < qloc, "init states overflow a shard queue"
-        q_rows[s * qloc + q_tail[s]] = row
-        q_eb[s * qloc + q_tail[s]] = ebs[i]
-        q_tail[s] += 1
+        r = np.zeros((width + 3,), np.uint32)
+        r[:width] = row
+        r[width] = ebs[i]
+        r[width + 1] = np.uint32(int(cache_fps[i]) >> 32)
+        r[width + 2] = np.uint32(int(cache_fps[i]) & 0xFFFFFFFF)
+        per_shard[s].append(r)
+    pad = max(1, max((len(b) for b in per_shard), default=0))
+    assert pad <= qloc, "init states overflow a shard queue"
+    init_block = np.zeros((D * pad, width + 3), np.uint32)
+    q_tail = np.zeros((D,), np.int32)
+    for s, block in enumerate(per_shard):
+        if block:
+            init_block[s * pad:s * pad + len(block)] = np.stack(block)
+        q_tail[s] = len(block)
 
+    key = ("seed", mesh, axis, qcap, capacity, width, log_w, pad,
+           prop_count)
+    fn = _SHARDED_CACHE.get(key)
+    if fn is None:
+        def local(blk, tail):
+            q = jnp.zeros((qloc, width + 3), jnp.uint32)
+            q = lax.dynamic_update_slice(q, blk, (0, 0))
+            z = jnp.int32(0)
+            f = jnp.bool_(False)
+            return ShardedCarry(
+                q=q,
+                q_head=jnp.zeros((1,), jnp.int32),
+                q_tail=tail,
+                key_hi=jnp.zeros(
+                    (capacity // D // _BUCKET, _BUCKET), jnp.uint32),
+                key_lo=jnp.zeros(
+                    (capacity // D // _BUCKET, _BUCKET), jnp.uint32),
+                log=jnp.zeros((capacity // D, log_w), jnp.uint32),
+                log_n=jnp.zeros((1,), jnp.int32),
+                disc_hit=jnp.zeros((prop_count,), bool),
+                disc_hi=jnp.zeros((prop_count,), jnp.uint32),
+                disc_lo=jnp.zeros((prop_count,), jnp.uint32),
+                gen=z, ovf=f, xovf=f, kovf=f, vmax=z, steps=z, go=f)
+
+        s = P(axis)
+        fn = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(s, s),
+            out_specs=carry_specs(axis), check_vma=False))
+        if len(_SHARDED_CACHE) >= 64:
+            _SHARDED_CACHE.clear()
+        _SHARDED_CACHE[key] = fn
     sh = NamedSharding(mesh, P(axis))
-    rep = NamedSharding(mesh, P())
-
-    def put(x, sharding):
-        return jax.device_put(x, sharding)
-
-    return ShardedCarry(
-        q_rows=put(q_rows, sh), q_eb=put(q_eb, sh),
-        q_head=put(np.zeros((D,), np.int32), sh),
-        q_tail=put(q_tail, sh),
-        key_hi=put(np.zeros((capacity,), np.uint32), sh),
-        key_lo=put(np.zeros((capacity,), np.uint32), sh),
-        log_chi=put(np.zeros((capacity,), np.uint32), sh),
-        log_clo=put(np.zeros((capacity,), np.uint32), sh),
-        log_phi=put(np.zeros((capacity,), np.uint32), sh),
-        log_plo=put(np.zeros((capacity,), np.uint32), sh),
-        log_ohi=put(np.zeros((capacity if symmetry or sound else D,),
-                             np.uint32), sh),
-        log_olo=put(np.zeros((capacity if symmetry or sound else D,),
-                             np.uint32), sh),
-        log_n=put(np.zeros((D,), np.int32), sh),
-        disc_hit=put(np.zeros((prop_count,), bool), rep),
-        disc_hi=put(np.zeros((prop_count,), np.uint32), rep),
-        disc_lo=put(np.zeros((prop_count,), np.uint32), rep),
-        gen=put(np.int32(0), rep), ovf=put(np.bool_(False), rep),
-        xovf=put(np.bool_(False), rep),
-        steps=put(np.int32(0), rep), go=put(np.bool_(False), rep))
+    return fn(jax.device_put(init_block, sh), jax.device_put(q_tail, sh))
