@@ -67,14 +67,19 @@ class CheckerBuilder:
         (state, pending-bits) NODES, so DAG rejoins can no longer mask a
         counterexample, at the cost of exploring a state once per distinct
         pending-bits value (``unique_state_count`` counts nodes). The DFS
-        engine additionally reports a lasso counterexample when expansion
-        rejoins the CURRENT path with bits still pending (a cycle on
-        which the property never holds); a cycle entered via a cross edge
-        into an already-explored sibling branch is still missed — full
-        lasso coverage needs an SCC/nested-DFS liveness pass. Supported
-        by ``spawn_bfs`` (single worker), ``spawn_dfs``, and the
-        single-chip ``spawn_tpu`` device mode. A model with no
-        ``eventually`` properties is unaffected."""
+        engine is additionally lasso-COMPLETE (without symmetry
+        reduction): expansion rejoining the CURRENT path with bits still
+        pending reports immediately, and a post-exhaustion SCC sweep
+        over the explored (state, pending-bits) node graph reports
+        cycles entered via cross edges into already-explored branches —
+        around any node-graph cycle the pending mask is invariant, so a
+        cyclic SCC with bit ``i`` still set is an infinite run on which
+        property ``i`` never holds. Under symmetry reduction only the
+        on-path check runs (a cross-branch lap cannot be replayed
+        through concrete orbit members). Supported by ``spawn_bfs``
+        (single worker), ``spawn_dfs``, and the single-chip
+        ``spawn_tpu`` device mode. A model with no ``eventually``
+        properties is unaffected."""
         self.sound_eventually_ = True
         return self
 

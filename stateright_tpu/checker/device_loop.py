@@ -135,8 +135,38 @@ def shrink_indices(mask, k: int):
     return inv[:k]
 
 
-_CHUNK_CACHE: dict = {}
-_CACHE_LIMIT = 64
+class LruCache(dict):
+    """Bounded compiled-program cache with least-recently-used eviction
+    (the previous wholesale ``.clear()`` at the limit forced a full
+    recompile cliff for long-lived processes alternating many model
+    configs). Lock-guarded: the caches are module-global and every
+    checker runs on its own background thread."""
+
+    def __init__(self, limit: int = 64):
+        super().__init__()
+        import threading
+        self._limit = limit
+        self._order: list = []
+        self._lock = threading.Lock()
+
+    def get(self, key, default=None):
+        with self._lock:
+            if key in self:
+                self._order.remove(key)
+                self._order.append(key)
+                return super().__getitem__(key)
+            return default
+
+    def __setitem__(self, key, value):
+        with self._lock:
+            if key not in self:
+                while len(self._order) >= self._limit:
+                    super().__delitem__(self._order.pop(0))
+                self._order.append(key)
+            super().__setitem__(key, value)
+
+
+_CHUNK_CACHE = LruCache()
 
 
 def model_cache_key(model):
@@ -180,8 +210,6 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
                          sound, hcap, n_init)
     if mkey is not None:
-        if len(_CHUNK_CACHE) >= _CACHE_LIMIT:
-            _CHUNK_CACHE.clear()
         _CHUNK_CACHE[key] = fn
     return fn
 
@@ -325,7 +353,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 
             # ONE candidate matrix (shared layout — ops/expand.py),
             # gathered ONCE for the inserted lanes
-            cand, _key_col, log_off = candidate_matrix(
+            cand, log_off = candidate_matrix(
                 exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
             src2 = shrink_indices(inserted, kmax_b)
             n_all = cand[src[src2]]
@@ -458,7 +486,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 HIST_WINDOW = 256
 
 
-_SEED_CACHE: dict = {}
+_SEED_CACHE = LruCache()
 
 
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
@@ -522,8 +550,6 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 vmax=jnp.int32(0))
 
         fn = jax.jit(build)
-        if len(_SEED_CACHE) >= _CACHE_LIMIT:
-            _SEED_CACHE.clear()
         _SEED_CACHE[key] = fn
     if k:
         init_arr = np.stack(init_rows).astype(np.uint32)

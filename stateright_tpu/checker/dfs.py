@@ -44,13 +44,31 @@ class DfsChecker(HostChecker):
         self._unique_state_count = len(self._generated)
         # stack entries: (state, fingerprint path, ebits, on-path
         # canonical state fingerprints for lasso detection — sound mode
-        # only, else None)
-        self._pending: List = [
-            (s, [model.fingerprint(s)], ebits,
-             frozenset([model.fingerprint(
-                 symmetry(s) if symmetry is not None else s)])
-             if self._sound else None)
-            for s in init_states]
+        # only, else None, node key)
+        self._pending: List = []
+        # full lasso coverage (sound mode, no symmetry): the explored
+        # NODE graph — every edge including dedup hits (those are the
+        # cross edges the on-path check cannot see) — plus a parent map
+        # for witness reconstruction. Within any cycle of the node graph
+        # the pending mask is invariant (bits only clear), so a cyclic
+        # SCC whose mask still holds bit i is an infinite run on which
+        # property i never holds (see _lasso_sweep).
+        self._lasso = self._sound and symmetry is None
+        if self._lasso:
+            self._node_edges: Dict[int, List[int]] = {}
+            self._node_mask: Dict[int, int] = {}
+            self._node_parent: Dict[int, tuple] = {}
+        for s in init_states:
+            fp = model.fingerprint(s)
+            rep = (model.fingerprint(symmetry(s))
+                   if symmetry is not None else fp)
+            key = self._node_key(rep, mask)
+            self._pending.append(
+                (s, [fp], ebits,
+                 frozenset([rep]) if self._sound else None, key))
+            if self._lasso:
+                self._node_mask[key] = mask
+                self._node_parent.setdefault(key, (None, fp))
         # name -> full fingerprint path (dfs.rs:26).
         self._discovery_fps: Dict[str, List[int]] = {}
 
@@ -64,8 +82,10 @@ class DfsChecker(HostChecker):
         symmetry = self._symmetry
         target = self._target_state_count
 
+        lasso = self._lasso
+
         while pending:
-            state, fingerprints, ebits, on_path = pending.pop()
+            state, fingerprints, ebits, on_path, node_key = pending.pop()
             if visitor is not None:
                 visitor.visit(model,
                               Path.from_fingerprints(model, fingerprints))
@@ -129,6 +149,12 @@ class DfsChecker(HostChecker):
                             discoveries[prop.name] = \
                                 fingerprints + [next_fp]
                 next_key = self._node_key(rep_fp, child_mask)
+                if lasso and child_mask:
+                    # record EVERY edge between still-pending nodes
+                    # (dedup hits included: those are the cross edges)
+                    self._node_edges.setdefault(node_key, []).append(
+                        next_key)
+                    self._node_mask[next_key] = child_mask
                 if next_key in generated:
                     is_terminal = False
                     continue
@@ -137,15 +163,130 @@ class DfsChecker(HostChecker):
                 is_terminal = False
                 if next_fp is None:
                     next_fp = model.fingerprint(next_state)
+                if lasso and child_mask:
+                    self._node_parent.setdefault(next_key,
+                                                 (node_key, next_fp))
                 pending.append(
                     (next_state, fingerprints + [next_fp], ebits,
-                     on_path | {rep_fp} if on_path is not None else None))
+                     on_path | {rep_fp} if on_path is not None else None,
+                     next_key))
             if is_terminal:
                 for i, prop in enumerate(properties):
                     if i in ebits:
                         discoveries[prop.name] = list(fingerprints)
             if target is not None and self._state_count >= target:
                 return
+
+        if lasso:
+            # full lasso coverage at exhaustion: cycles entered via
+            # cross edges into explored branches (invisible to the
+            # on-path check above) surface here
+            self._lasso_sweep(discoveries)
+
+    # ------------------------------------------------------------------
+    def _lasso_sweep(self, discoveries: Dict[str, List[int]]) -> None:
+        """SCC pass over the explored (state, pending-ebits) node graph.
+
+        Around any cycle of the node graph the pending mask is invariant
+        (bits only ever clear along a path and the cycle returns to the
+        same node), so a cyclic SCC whose mask still holds bit ``i`` is
+        an infinite run on which property ``i`` never holds — a liveness
+        counterexample the reference cannot see at all (`bfs.rs:239-256`)
+        and the on-path back-edge check alone reports only when the
+        cycle closes through the CURRENT path. Runs at exhaustion only
+        (an early exit leaves the graph partial); witnesses replay as
+        stem (init -> cycle entry, via the parent map) + one full lap.
+        """
+        from ..core import Expectation
+
+        properties = self._properties
+        want = [i for i, p in enumerate(properties)
+                if p.expectation == Expectation.EVENTUALLY
+                and p.name not in discoveries]
+        if not want:
+            return
+        edges = self._node_edges
+        masks = self._node_mask
+
+        # iterative Tarjan
+        index: Dict[int, int] = {}
+        low: Dict[int, int] = {}
+        on_stack: set = set()
+        stack: List[int] = []
+        counter = 0
+        for root in list(masks.keys()):
+            if root in index:
+                continue
+            work = [(root, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = low[node] = counter
+                    counter += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                nbrs = edges.get(node, ())
+                advanced = False
+                for j in range(pi, len(nbrs)):
+                    w = nbrs[j]
+                    if w not in index:
+                        work[-1] = (node, j + 1)
+                        work.append((w, 0))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    cyclic = len(comp) > 1 or node in edges.get(node, ())
+                    if cyclic:
+                        mask = masks[comp[0]]
+                        hit = [i for i in want
+                               if (mask >> i) & 1
+                               and properties[i].name not in discoveries]
+                        if hit:
+                            witness = self._lasso_witness(comp)
+                            for i in hit:
+                                discoveries[properties[i].name] = witness
+                if work:
+                    pnode = work[-1][0]
+                    low[pnode] = min(low[pnode], low[node])
+
+    def _lasso_witness(self, comp: List[int]) -> List[int]:
+        """Concrete fingerprint path: init -> SCC entry, then one lap of
+        a cycle through the entry (nodes translate to state fingerprints
+        via ``_node_fp``; every recorded edge is a real transition)."""
+        entry = comp[0]
+        chain: List[int] = []
+        k = entry
+        while k is not None:
+            pk, fp = self._node_parent[k]
+            chain.append(fp)
+            k = pk
+        chain.reverse()
+        compset = set(comp)
+        node_fp = self._node_fp
+        frontier = [(entry, [])]
+        visited = set()
+        while frontier:
+            node, path = frontier.pop()
+            for w in self._node_edges.get(node, ()):
+                if w == entry:
+                    return (chain + [node_fp[x] for x in path]
+                            + [node_fp[entry]])
+                if w in compset and w not in visited:
+                    visited.add(w)
+                    frontier.append((w, path + [w]))
+        return chain  # unreachable: a cyclic SCC always closes a lap
 
     def discoveries(self) -> Dict[str, Path]:
         return {

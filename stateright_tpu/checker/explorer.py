@@ -79,14 +79,27 @@ def status_view(checker, snapshot: Optional[Snapshot]) -> Dict[str, Any]:
         properties.append([
             p.expectation.value, p.name,
             discovery.encode(model) if discovery is not None else None])
-    return {
+    out = {
         "model": type(model).__name__,
         "done": checker.is_done(),
+        # a target_state_count-bounded run can stop short of exhaustion:
+        # "done" then doesn't establish holds-verdicts, only absence of
+        # a discovery so far (the UI softens its labels accordingly)
+        "bounded": getattr(checker, "_target_state_count", None)
+        is not None,
         "state_count": checker.state_count(),
         "unique_state_count": checker.unique_state_count(),
         "properties": properties,
         "recent_path": recent,
     }
+    profile = getattr(checker, "profile", None)
+    if profile is not None:
+        # live device-loop progress for engine='tpu': completed chunk
+        # dispatches (each chunk is up to chunk_steps frontier levels)
+        chunks = profile().get("chunks")
+        if chunks:
+            out["chunks"] = int(chunks)
+    return out
 
 
 def parse_fingerprints(fingerprints_str: str) -> List[int]:

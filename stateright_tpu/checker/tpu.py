@@ -96,7 +96,9 @@ def _compact(mask, *columns):
     return (mask.sum(dtype=jnp.int32),) + out
 
 
-_LEVEL_CACHE: dict = {}
+from .device_loop import LruCache as _LruCache
+
+_LEVEL_CACHE = _LruCache()
 _INSERT_JIT = None
 
 
@@ -132,8 +134,6 @@ def build_level_fn(model, symmetry: bool = False):
             return cached
     fn = _build_level_fn(model, symmetry)
     if mkey is not None:
-        if len(_LEVEL_CACHE) >= 64:
-            _LEVEL_CACHE.clear()
         _LEVEL_CACHE[mkey] = fn
     return fn
 
@@ -297,10 +297,6 @@ class TpuChecker(HostChecker):
                 raise NotImplementedError(
                     "sound_eventually() with host-evaluated properties "
                     "is not supported on the TPU engine")
-            if builder.resume_path_ is not None:
-                raise NotImplementedError(
-                    "checkpoint resume under sound_eventually() is not "
-                    "supported")
         # host-property history dedup (device engine): the history-key
         # table rides IN the chunk carry (device_loop.ChunkCarry.hkey_*);
         # hcap is its capacity, grown on occupancy pressure or hovf.
@@ -335,10 +331,6 @@ class TpuChecker(HostChecker):
                     "symmetry reduction on the TPU engine requires the "
                     "model to implement packed_representative (the device "
                     "canonicalization); use spawn_dfs() otherwise")
-            if builder.resume_path_ is not None:
-                raise NotImplementedError(
-                    "checkpoint resume under symmetry reduction is not "
-                    "supported")
 
     @contextmanager
     def _timed(self, name: str):
@@ -731,13 +723,15 @@ class TpuChecker(HostChecker):
 
         if self._tpu_options.get("resumable"):
             # pull the pending frontier eagerly so save() needs no pinned
-            # device buffers
+            # device buffers; the queue's cached fps (canonical under
+            # symmetry) ride along so resume never recomputes them
             head = int(jax.device_get(carry.q_head))
             tail = int(jax.device_get(carry.q_tail))
             width = model.packed_width
             pend = np.asarray(jax.device_get(carry.q[head:tail]))
-            self._resume_frontier = (pend[:, :width].copy(),
-                                     pend[:, width].copy())
+            self._resume_frontier = (
+                pend[:, :width].copy(), pend[:, width].copy(),
+                _combine64(pend[:, width + 1], pend[:, width + 2]))
         # the mirror (fp -> parent fp) stays device-resident until someone
         # needs it (path reconstruction, checkpointing): the log pull is
         # pure host-link cost, pointless for count-only runs. Keep only
@@ -1304,28 +1298,33 @@ class TpuChecker(HostChecker):
             raise RuntimeError(
                 "save() needs the pending frontier: run with "
                 "tpu_options(resumable=True) on the device engine")
-        if self._symmetry:
-            raise NotImplementedError(
-                "checkpointing under symmetry reduction is not supported")
-        if self._sound:
-            raise NotImplementedError(
-                "checkpointing under sound_eventually() is not supported")
         self._ensure_mirror()
-        rows, ebits = self._resume_frontier
+        rows, ebits, ffps = self._resume_frontier
         child = np.fromiter(self._generated.keys(), np.uint64,
                             len(self._generated))
         parent = np.fromiter(
             (p if p is not None else 0 for p in self._generated.values()),
             np.uint64, len(self._generated))
+        # under symmetry/sound the mirror keys are canonical/node keys;
+        # _orig_of translates each back to a concrete replayable state fp
+        okeys = np.fromiter(self._orig_of.keys(), np.uint64,
+                            len(self._orig_of))
+        ovals = np.fromiter(self._orig_of.values(), np.uint64,
+                            len(self._orig_of))
         import json
 
         meta = json.dumps({
             "model": self._model_tag(),
             "discoveries": {n: int(fp)
                             for n, fp in self._discovery_fps.items()},
+            # dedup-key semantics must match at resume: node keys under
+            # sound, canonical-orbit keys under symmetry
+            "symmetry": bool(self._symmetry),
+            "sound": bool(self._sound),
         })
         np.savez_compressed(
             path, child=child, parent=parent, rows=rows, ebits=ebits,
+            ffps=ffps, okeys=okeys, ovals=ovals,
             state_count=np.int64(self._state_count),
             meta=np.asarray(meta))
 
@@ -1342,9 +1341,12 @@ class TpuChecker(HostChecker):
                 f"|fpv={FP_VERSION}")
 
     def _load_checkpoint(self, discoveries: Dict[str, int]):
-        """Seed state from a ``save()`` file: the mirror, the saved
+        """Seed state from a ``save()`` file: the mirror (and its
+        canonical/node-key -> original-fp translation), the saved
         discoveries, and the pending frontier (whose rows become the seed
-        'inits' — their parents are already in the mirror)."""
+        'inits' — their parents are already in the mirror). Returns
+        ``(rows, ebits, cache_fps)`` with ``cache_fps`` the frontier's
+        queue-cached state fingerprints (canonical under symmetry)."""
         import json
 
         data = np.load(self._resume_path)
@@ -1353,17 +1355,30 @@ class TpuChecker(HostChecker):
             raise RuntimeError(
                 "checkpoint was written by a different model config: "
                 f"saved {meta['model']!r}, resuming {self._model_tag()!r}")
+        if (bool(meta.get("symmetry")) != self._symmetry
+                or bool(meta.get("sound")) != self._sound):
+            raise RuntimeError(
+                "checkpoint dedup-key semantics do not match this run: "
+                f"saved symmetry={meta.get('symmetry')} "
+                f"sound={meta.get('sound')}, resuming "
+                f"symmetry={self._symmetry} sound={self._sound}")
         child = data["child"].tolist()
         parent = [None if p == 0 else int(p)
                   for p in data["parent"].tolist()]
         self._generated.update(zip(child, parent))
+        if "okeys" in data:
+            self._orig_of.update(zip(data["okeys"].tolist(),
+                                     data["ovals"].tolist()))
         self._state_count = int(data["state_count"])
         self._unique_state_count = len(self._generated)
         for name, fp in meta["discoveries"].items():
             discoveries[name] = int(fp)
-        from ..fingerprint import fp64_words
         rows = [np.asarray(r, np.uint32) for r in data["rows"]]
-        fps = [fp64_words(r.tolist()) for r in rows]
+        if "ffps" in data:
+            fps = [int(f) for f in data["ffps"]]
+        else:  # pre-round-4 checkpoint: plain mode only, recompute
+            from ..fingerprint import fp64_words
+            fps = [fp64_words(r.tolist()) for r in rows]
         return rows, np.asarray(data["ebits"], np.uint32), fps
 
     def _reconstruct_path(self, fp: int) -> Path:

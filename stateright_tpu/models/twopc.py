@@ -39,16 +39,25 @@ State = Tuple[Tuple[int, ...], int, Tuple[int, ...], frozenset]
 class TwoPhaseSys(PackedModel):
     packed_width = 4
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, complete_symmetry: bool = False):
+        """``complete_symmetry=True`` swaps the reference's sort-by-RM-
+        state representative (`2pc.rs:165-182` — ties broken by original
+        position, so reduced counts are exploration-order-specific; the
+        reference's own DFS pins 665 at n=5) for an ORBIT-INVARIANT one
+        that sorts the complete per-RM record (state, prepared bit,
+        Prepared-message bit). Every engine then reduces to exactly the
+        orbit partition — 314 classes at n=5, computed by brute force
+        over all 120 RM permutations (NOTES.md)."""
         assert 1 <= n <= 16, "packed 2pc supports up to 16 RMs"
         self.n = n
+        self.complete_symmetry = complete_symmetry
         self.max_actions = 2 + 5 * n
         # measured batch branching is ~12 valid children per state at
         # n=7 (profile()['vmax'] / fmax) — high enough that the engine's
         # fa//2 default candidate buffer is already right; no hint
 
     def cache_key(self):
-        return ("twopc", self.n)
+        return ("twopc", self.n, self.complete_symmetry)
 
     # ------------------------------------------------------------------
     # Host side (2pc.rs:43-121)
@@ -118,9 +127,17 @@ class TwoPhaseSys(PackedModel):
         ]
 
     def representative(self, state: State) -> State:
-        """Canonical member under RM-permutation symmetry (2pc.rs:165-182)."""
+        """Canonical member under RM-permutation symmetry: the
+        reference's sort-by-RM-state (2pc.rs:165-182), or the
+        orbit-invariant complete-record sort under
+        ``complete_symmetry``."""
         rm_state, tm_state, tm_prepared, msgs = state
-        plan = RewritePlan.from_values_to_sort(rm_state)
+        if self.complete_symmetry:
+            keys = [(rm_state[i], tm_prepared[i],
+                     1 if i in msgs else 0) for i in range(self.n)]
+            plan = RewritePlan.from_values_to_sort(keys)
+        else:
+            plan = RewritePlan.from_values_to_sort(rm_state)
         return (
             tuple(plan.reindex(rm_state)),
             tm_state,
@@ -156,9 +173,11 @@ class TwoPhaseSys(PackedModel):
 
     def packed_representative(self, words):
         """Device canonicalization under RM permutation: stable sort of
-        the per-RM (state, prepared, message) triples by RM state —
-        bit-exact with :meth:`representative` (the host uses the same
-        stable value sort, `2pc.rs:165-182`)."""
+        the per-RM (state, prepared, message) triples — by RM state
+        (bit-exact with the reference-style :meth:`representative`,
+        `2pc.rs:165-182`), or by the packed complete record
+        ``state*4 + prepared*2 + msg`` (== the host's tuple
+        lexicographic order) under ``complete_symmetry``."""
         import jax.numpy as jnp
         n = self.n
         rmw, tm, prep, msgs = words[0], words[1], words[2], words[3]
@@ -166,7 +185,9 @@ class TwoPhaseSys(PackedModel):
         r = (rmw >> (2 * idx)) & 3
         p = (prep >> idx) & 1
         m = (msgs >> idx) & 1  # message bit i = "RM i sent Prepared"
-        order = jnp.argsort(r, stable=True)
+        sort_key = (r << 2) | (p << 1) | m if self.complete_symmetry \
+            else r
+        order = jnp.argsort(sort_key, stable=True)
         r, p, m = r[order], p[order], m[order]
         nrmw = (r << (2 * idx)).sum().astype(jnp.uint32)
         nprep = (p << idx).sum().astype(jnp.uint32)

@@ -145,11 +145,10 @@ def candidate_matrix(exp: Expansion, n_actions: int, width: int,
        | parent key hi/lo | original fp hi/lo (symmetry/sound only)]
 
     Under ``sound`` the caller splices node-key columns in at W+3 AFTER
-    compaction (they are computed at kmax lanes); ``key_cols`` and
-    ``log_off`` already account for that splice. Returns
-    ``(cand, key_col, log_off)`` where ``key_col`` is the dedup-key hi
-    column inside the FINAL (post-splice) layout and ``log_off`` the
-    start of the contiguous log block.
+    compaction (they are computed at kmax lanes); ``log_off`` already
+    accounts for that splice. Returns ``(cand, log_off)`` where
+    ``log_off`` is the start of the contiguous log block in the FINAL
+    (post-splice) layout — its first two columns are the dedup keys.
     """
     cand_cols = [exp.flat,
                  jnp.repeat(exp.ebits, n_actions)[:, None],
@@ -159,9 +158,8 @@ def candidate_matrix(exp: Expansion, n_actions: int, width: int,
     if symmetry or sound:
         cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
     cand = jnp.concatenate(cand_cols, axis=1)
-    key_col = width + 3 if sound else width + 1
     log_off = width + 3 if sound else width + 1
-    return cand, key_col, log_off
+    return cand, log_off
 
 
 def splice_node_keys(k_all, width: int, nk_hi, nk_lo):

@@ -51,11 +51,6 @@ class ShardedTpuChecker(TpuChecker):
             raise ValueError(
                 "visitors are a host feature; use single-chip spawn_tpu "
                 "(per-level mode) or the host engines")
-        if builder.resume_path_ is not None and (
-                self._symmetry or getattr(self, "_sound", False)):
-            raise NotImplementedError(
-                "checkpoint resume under symmetry/sound_eventually is "
-                "not supported")
         if getattr(self, "_sound", False) and self._host_props:
             raise NotImplementedError(
                 "sound_eventually() with host-evaluated properties is "
@@ -92,13 +87,25 @@ class ShardedTpuChecker(TpuChecker):
         if self._resume_path is not None:
             # checkpoints are shard-agnostic (the single-chip format):
             # the frontier re-routes by owner on THIS mesh, which may
-            # differ from the mesh (or single chip) that wrote it
-            init_rows, seed_ebits, frontier_fps = self._load_checkpoint(
-                discoveries)
+            # differ from the mesh (or single chip) that wrote it.
+            # Routing uses the DEDUP key — the cached fp as-is (state,
+            # or canonical under symmetry), or the node key re-derived
+            # from it plus the row's pending ebits under sound — so it
+            # matches the in-loop owner computation exactly.
+            init_rows, seed_ebits, resume_cache_fps = \
+                self._load_checkpoint(discoveries)
+            if self._sound:
+                from ..fingerprint import fp64_node
+                frontier_fps = [
+                    fp64_node(fp, int(eb))
+                    for fp, eb in zip(resume_cache_fps, seed_ebits)]
+            else:
+                frontier_fps = list(resume_cache_fps)
         else:
             init_rows = self._seed_inits()
             seed_ebits = full_ebits
             frontier_fps = list(generated.keys())
+            resume_cache_fps = None
         table_fps = list(generated.keys())
         base_unique = len(generated)
         n_init = len(init_rows)
@@ -131,7 +138,7 @@ class ShardedTpuChecker(TpuChecker):
         # the queue caches STATE fps; frontier_fps (the routing/dedup
         # keys) are node keys under sound — see seed_sharded_carry
         cache_fps = (self._seed_cache_fps
-                     if self._resume_path is None else frontier_fps)
+                     if self._resume_path is None else resume_cache_fps)
         carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
                                    init_rows, frontier_fps, seed_ebits,
                                    prop_count, symmetry=self._symmetry,
@@ -233,13 +240,13 @@ class ShardedTpuChecker(TpuChecker):
             width = model.packed_width
             q_h, qh, qt = jax.device_get(
                 (carry.q, carry.q_head, carry.q_tail))
-            rows_l = [q_h[s * qloc + int(qh[s]):
-                          s * qloc + int(qt[s]), :width]
+            pend_l = [q_h[s * qloc + int(qh[s]):s * qloc + int(qt[s])]
                       for s in range(D)]
-            ebs_l = [q_h[s * qloc + int(qh[s]):
-                         s * qloc + int(qt[s]), width] for s in range(D)]
-            self._resume_frontier = (np.concatenate(rows_l),
-                                     np.concatenate(ebs_l))
+            pend = np.concatenate(pend_l) if pend_l else \
+                np.zeros((0, width + 3), np.uint32)
+            self._resume_frontier = (
+                pend[:, :width].copy(), pend[:, width].copy(),
+                _combine64(pend[:, width + 1], pend[:, width + 2]))
         self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
 

@@ -120,7 +120,9 @@ def carry_specs(axis: str) -> ShardedCarry:
         kovf=r, vmax=r, steps=r, go=r)
 
 
-_SHARDED_CACHE: dict = {}
+from ..checker.device_loop import LruCache as _LruCache
+
+_SHARDED_CACHE = _LruCache()
 
 
 def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
@@ -156,8 +158,6 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
                                  fmax, kmax, symmetry, sound)
     if key is not None:
-        if len(_SHARDED_CACHE) >= 64:
-            _SHARDED_CACHE.clear()
         _SHARDED_CACHE[key] = fn
     return fn
 
@@ -259,7 +259,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # contiguous slice starting at log_off.
         src = shrink_indices(cvalid, kmax)
         kvalid = (jnp.arange(kmax, dtype=jnp.int32) < vcount) & ~kovf
-        cand, key_col, log_off = candidate_matrix(
+        cand, log_off = candidate_matrix(
             exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
         k_all = cand[src]
         if sound:
@@ -269,7 +269,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             k_all = splice_node_keys(k_all, width, nk_hi, nk_lo)
 
         if kbits:
-            owner = k_all[:, key_col] >> jnp.uint32(32 - kbits)
+            owner = k_all[:, log_off] >> jnp.uint32(32 - kbits)
         else:
             owner = jnp.zeros((kmax,), jnp.uint32)
 
@@ -286,7 +286,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             k_c, val_c, own_c = rc
             mine = val_c & (own_c == me)
             inserted, key_hi, key_lo, o = table_insert(
-                key_hi, key_lo, k_c[:, key_col], k_c[:, key_col + 1],
+                key_hi, key_lo, k_c[:, log_off], k_c[:, log_off + 1],
                 mine)
             t_ovf = t_ovf | o
             cnt = inserted.sum(dtype=jnp.int32)
@@ -538,8 +538,6 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
         fn = jax.jit(jax.shard_map(
             local, mesh=mesh, in_specs=(s, s),
             out_specs=carry_specs(axis), check_vma=False))
-        if len(_SHARDED_CACHE) >= 64:
-            _SHARDED_CACHE.clear()
         _SHARDED_CACHE[key] = fn
     sh = NamedSharding(mesh, P(axis))
     return fn(jax.device_put(init_block, sh), jax.device_put(q_tail, sh))

@@ -16,15 +16,40 @@ function esc(s) {
   return d.innerHTML;
 }
 
+/* Verdict wording per (expectation, discovered, done, bounded) — a
+ * discovery is the GOAL for sometimes-properties and a VIOLATION for
+ * always/eventually ones. A bounded (target_state_count) run that
+ * finishes without a discovery has not established a "holds" claim,
+ * only absence so far. */
+function verdict(expectation, discovered, done, bounded) {
+  if (discovered) {
+    return expectation === "sometimes"
+      ? "✅ example found" : "⚠️ counterexample found";
+  }
+  if (!done) return "🔎 searching";
+  if (bounded) {
+    return expectation === "sometimes"
+      ? "⚠️ example not found (bounded run)"
+      : "✅ no violation found (bounded run)";
+  }
+  switch (expectation) {
+    case "always": return "✅ safety holds";
+    case "eventually": return "✅ liveness holds";
+    default: return "⚠️ example not found";
+  }
+}
+
 async function renderStatus() {
   try {
     const r = await fetch("/.status");
     const s = await r.json();
     let html = `${s.model} &mdash; ${s.done ? "done" : "checking"}, ` +
       `states=${s.state_count}, unique=${s.unique_state_count}`;
+    if (s.chunks) html += `, device chunks=${s.chunks}`;
     for (const [expectation, name, discovery] of s.properties) {
       const cls = discovery ? "discovered" : "";
-      const label = `${expectation} ${esc(name)}`;
+      const label = `${expectation} ${esc(name)}: ` +
+        verdict(expectation, !!discovery, s.done, !!s.bounded);
       html += `<span class="prop ${cls}">` +
         (discovery ? `<a href="#/${discovery}">${label} &#9733;</a>`
                    : label) + `</span>`;

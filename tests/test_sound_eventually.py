@@ -7,9 +7,12 @@ is revisited with different pending bits — the documented FIXME at
 ``fixme_can_miss_counterexample_when_revisiting_a_state`` test
 (`src/checker.rs:402-414`). Sound mode dedups on (state, pending-ebits)
 nodes, so the DAG-rejoin miss disappears on every supporting engine, and
-the DFS engine additionally reports lasso counterexamples for cycles
-that rejoin the current search path (cross-edge cycles into
-already-explored branches remain out of scope — pinned below).
+the DFS engine is lasso-COMPLETE: on-path rejoins report immediately,
+and a post-exhaustion SCC sweep over the explored node graph reports
+cycles entered via cross edges into already-explored branches (pinned
+below; under symmetry reduction only the on-path check runs — a
+cross-branch witness cannot be replayed through concrete orbit
+members).
 """
 
 import pytest
@@ -72,16 +75,33 @@ class TestHostSound:
         assert states[-1] == 2 and states.count(2) == 2
         assert not any(s % 2 == 1 for s in states)
 
-    def test_dfs_cross_edge_cycle_limitation(self):
-        # documented limitation: a cycle entered via a cross edge into an
-        # already-explored sibling branch (2->4->2 below, discovered from
-        # 0's two children) dedups at push time and is NOT detected —
-        # full lasso coverage needs an SCC/nested-DFS liveness pass
+    def test_dfs_cross_edge_cycle_found(self):
+        # a cycle entered via a cross edge into an already-explored
+        # sibling branch (2->4->2 below, discovered from 0's two
+        # children) dedups at push time so the on-path check never sees
+        # it; the post-exhaustion SCC sweep (round 4) reports it — this
+        # used to be the pinned limitation
         g = (DGraph.with_property(eventually_odd())
              .with_path([0, 2, 4, 2])
              .with_path([0, 4]))
         c = g.checker().sound_eventually().spawn_dfs().join()
-        assert c.discovery("odd") is None  # the documented miss
+        path = c.assert_any_discovery("odd")
+        states = path.into_states()
+        assert not any(s % 2 == 1 for s in states)
+        # the witness ends with one full lap of the cycle
+        assert states[-1] in (2, 4) and states.count(states[-1]) >= 2
+
+    def test_dfs_disjoint_branch_cycle_found(self):
+        # cycle spanning two sibling branches: 0->2, 0->4, 2->4, 4->2 —
+        # NO single DFS path contains both cycle edges, so only the SCC
+        # sweep can see it
+        g = (DGraph.with_property(eventually_odd())
+             .with_path([0, 2, 4])
+             .with_path([0, 4, 2]))
+        c = g.checker().sound_eventually().spawn_dfs().join()
+        path = c.assert_any_discovery("odd")
+        states = path.into_states()
+        assert not any(s % 2 == 1 for s in states)
 
     def test_no_false_positives(self):
         # graphs whose eventually-property holds stay clean in sound mode

@@ -126,6 +126,30 @@ class TestDeviceSymmetry:
             frontier = nxt
         return out
 
+    def test_2pc_complete_symmetry_pins_orbit_count(self):
+        # the orbit-invariant (complete per-RM record sort)
+        # representative makes every engine reduce to EXACTLY the orbit
+        # partition: 314 classes at n=5 (NOTES.md brute force), engine-
+        # and order-independent — unlike the reference representative,
+        # whose counts are exploration-order-specific
+        def mk():
+            return TwoPhaseSys(5, complete_symmetry=True)
+
+        host = mk().checker().symmetry_fn(mk().representative) \
+            .spawn_dfs().join()
+        assert host.unique_state_count() == 314
+        dev = (mk().checker().symmetry_fn(mk().representative)
+               .tpu_options(capacity=1 << 12, fmax=64)
+               .spawn_tpu().join())
+        assert dev.unique_state_count() == 314
+        sharded = (mk().checker().symmetry_fn(mk().representative)
+                   .tpu_options(capacity=1 << 12, fmax=64,
+                                mesh=_mesh(2))
+                   .spawn_tpu().join())
+        assert sharded.unique_state_count() == 314
+        # same verdicts as the unreduced model
+        dev.assert_properties()
+
     def test_increment_sym_8(self):
         # 13 plain states vs 8 canonical (increment.rs:36-105)
         plain = (Increment(2).checker()
