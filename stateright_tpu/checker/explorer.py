@@ -87,6 +87,10 @@ def status_view(checker, snapshot: Optional[Snapshot]) -> Dict[str, Any]:
         # a discovery so far (the UI softens its labels accordingly)
         "bounded": getattr(checker, "_target_state_count", None)
         is not None,
+        # without sound_eventually(), exhaustion does not establish
+        # liveness (the reference's documented cycle/DAG-rejoin miss,
+        # bfs.rs:239-256) — the UI must not claim "liveness holds"
+        "sound": bool(getattr(checker, "_sound", False)),
         "state_count": checker.state_count(),
         "unique_state_count": checker.unique_state_count(),
         "properties": properties,

@@ -213,15 +213,19 @@ def _level_helpers():
 def auto_fmax(model, shards: int = 1) -> int:
     """Default expansion width: ~16M child lane-words per iteration
     (divided across shards) — empirically the knee of the lane-cost curve
-    across model shapes (narrow 2pc, wide packed-actor states) after the
-    incremental-network/bucketed-probe rework dropped the per-lane cost.
-    Shared by the single-chip and sharded engines so the knee is tuned in
-    one place. The floor (1024 rows on a single chip, divided across
-    shards down to 256) keeps enough frontier rows per iteration to
-    amortize the fixed per-iteration cost on very wide models."""
+    across model shapes after the incremental-network/bucketed-probe
+    rework dropped the per-lane cost. VERY wide rows (packed actor
+    states, width >= 256) have a much lower knee (~6M lane-words —
+    ABD-ordered measured best near fmax=1024 at width 331, round 4): the
+    dense successor materialization is bandwidth-bound there, not
+    op-latency-bound. Shared by the single-chip and sharded engines so
+    the knee is tuned in one place. The floor (1024 rows on a single
+    chip, divided across shards down to 256) keeps enough frontier rows
+    per iteration to amortize the fixed per-iteration cost."""
+    target = (3 << 21) if model.packed_width >= 256 else (1 << 24)
     return max(max(256, (1 << 10) // shards), min(
         1 << 13,
-        (1 << 24) // (model.max_actions * model.packed_width * shards)))
+        target // (model.max_actions * model.packed_width * shards)))
 
 
 def _enable_compile_cache() -> None:

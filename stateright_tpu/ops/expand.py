@@ -163,8 +163,9 @@ def candidate_matrix(exp: Expansion, n_actions: int, width: int,
 
 
 def splice_node_keys(k_all, width: int, nk_hi, nk_lo):
-    """Insert the node-key columns at W+3 (sound mode, post-compaction) —
-    the splice :func:`candidate_matrix`'s key_col/log_off expect."""
+    """Insert the node-key columns at W+3 (sound mode, post-compaction)
+    — the splice :func:`candidate_matrix`'s ``log_off`` expects: after
+    it, the log block's first two columns are these node keys."""
     return jnp.concatenate(
         [k_all[:, :width + 3], nk_hi[:, None], nk_lo[:, None],
          k_all[:, width + 3:]], axis=1)
@@ -172,15 +173,18 @@ def splice_node_keys(k_all, width: int, nk_hi, nk_lo):
 
 def kmax_default(model, fmax: int, sound: bool) -> int:
     """Candidate-buffer width policy shared by both engines: models that
-    declare ``branching_hint`` get a hint-sized buffer; hint-less models
-    start at fa/8 (the in-batch :func:`pre_dedup` shrinks real batches
-    well below raw fa) and the kovf abort-and-rebuild protocol grows on
-    demand; sound mode skips pre-dedup and keeps the fa/2 sizing."""
+    declare ``branching_hint`` get a hint-sized buffer (halved outside
+    sound mode — the in-batch :func:`pre_dedup` drops duplicate lanes,
+    and measured post-dedup branching runs well under the raw hint, e.g.
+    paxos vmax ~1.9/state vs hint 4); hint-less models start at fa/8;
+    sound mode skips pre-dedup and keeps the raw sizing. Undersizing
+    costs one kovf abort-and-rebuild (compile-cached), oversizing makes
+    every downstream gather/probe wider forever."""
     fa = fmax * model.max_actions
     hint = getattr(model, "branching_hint", None)
     if hint:
-        return min(fa, max(
-            1 << 12, -(-(fmax * hint * 5 // 4) // 256) * 256))
+        scale = 5 * fmax * hint // (4 if sound else 8)
+        return min(fa, max(1 << 12, -(-scale // 256) * 256))
     return min(fa, max(1 << 12, fa // 2 if sound else fa // 8))
 
 

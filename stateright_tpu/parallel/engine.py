@@ -242,8 +242,7 @@ class ShardedTpuChecker(TpuChecker):
                 (carry.q, carry.q_head, carry.q_tail))
             pend_l = [q_h[s * qloc + int(qh[s]):s * qloc + int(qt[s])]
                       for s in range(D)]
-            pend = np.concatenate(pend_l) if pend_l else \
-                np.zeros((0, width + 3), np.uint32)
+            pend = np.concatenate(pend_l)
             self._resume_frontier = (
                 pend[:, :width].copy(), pend[:, width].copy(),
                 _combine64(pend[:, width + 1], pend[:, width + 2]))

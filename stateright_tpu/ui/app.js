@@ -21,7 +21,7 @@ function esc(s) {
  * always/eventually ones. A bounded (target_state_count) run that
  * finishes without a discovery has not established a "holds" claim,
  * only absence so far. */
-function verdict(expectation, discovered, done, bounded) {
+function verdict(expectation, discovered, done, bounded, sound) {
   if (discovered) {
     return expectation === "sometimes"
       ? "✅ example found" : "⚠️ counterexample found";
@@ -34,7 +34,10 @@ function verdict(expectation, discovered, done, bounded) {
   }
   switch (expectation) {
     case "always": return "✅ safety holds";
-    case "eventually": return "✅ liveness holds";
+    case "eventually":
+      /* without sound_eventually() exhaustion can miss cycle
+       * counterexamples (the reference's documented caveat) */
+      return sound ? "✅ liveness holds" : "✅ no counterexample found";
     default: return "⚠️ example not found";
   }
 }
@@ -49,7 +52,8 @@ async function renderStatus() {
     for (const [expectation, name, discovery] of s.properties) {
       const cls = discovery ? "discovered" : "";
       const label = `${expectation} ${esc(name)}: ` +
-        verdict(expectation, !!discovery, s.done, !!s.bounded);
+        verdict(expectation, !!discovery, s.done, !!s.bounded,
+                !!s.sound);
       html += `<span class="prop ${cls}">` +
         (discovery ? `<a href="#/${discovery}">${label} &#9733;</a>`
                    : label) + `</span>`;

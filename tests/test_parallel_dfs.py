@@ -45,12 +45,24 @@ class TestParallelDfs:
 
     def test_symmetry_reduction(self):
         # the parallel DFS preserves the canonicalize-then-hash-but-
-        # enqueue-original rule; 2pc 5 RMs reduces 8,832 -> 665
-        # (2pc.rs:138)
+        # enqueue-original rule. The reference representative breaks
+        # ties by original position, so the reduced count is
+        # exploration-order-specific: the SEQUENTIAL DFS pins the
+        # reference's 665 (2pc.rs:138), but racing workers interleave
+        # nondeterministically — any count in the sound range
+        # [314 true orbits, 1092 distinct representative keys]
+        # (brute-forced in NOTES.md) is a correct reduction
         p = par(TwoPhaseSys(5), symmetry_fn=lambda s:
                 TwoPhaseSys(5).representative(s))
-        assert p.unique_state_count() == 665
+        assert 314 <= p.unique_state_count() <= 1092, \
+            p.unique_state_count()
         p.assert_properties()
+        # the orbit-invariant representative is order-independent:
+        # every engine, any interleaving, exactly 314
+        m = TwoPhaseSys(5, complete_symmetry=True)
+        p2 = par(m, symmetry_fn=m.representative)
+        assert p2.unique_state_count() == 314
+        p2.assert_properties()
 
     def test_target_state_count(self):
         p = par(LinearEquation(2, 4, 7), target_state_count=500)

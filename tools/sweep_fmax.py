@@ -1,41 +1,77 @@
-import sys, time
+"""Engine tuning sweeps (fmax/kmax/chunk_steps) on the real chip."""
+import os
+import sys
+import time
 
-def paxos(fmax=None, kmax=None, cap=500_000, runs=2):
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bench(mk, warm_arg, runs):
+    mk(warm_arg)
+    rates = []
+    ck = None
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        ck, denom = mk(None)
+        rates.append(denom / (time.perf_counter() - t0))
+    return rates, ck
+
+
+def paxos(fmax=None, kmax=None, cap=500_000, runs=3, steps=None):
     from stateright_tpu.examples.paxos_packed import PackedPaxos
-    opts = {"capacity": 1 << 21}
-    if fmax: opts["fmax"] = fmax
-    if kmax: opts["kmax"] = kmax
-    def run(c):
-        t0 = time.perf_counter()
-        ck = (PackedPaxos(3).checker().tpu_options(**opts)
-              .target_state_count(c).spawn_tpu().join())
-        return time.perf_counter() - t0, ck
-    run(50_000)
-    rates = []
-    for _ in range(runs):
-        dt, ck = run(cap)
-        rates.append(ck.unique_state_count() / dt)
-    print(f"paxos fmax={fmax} kmax={kmax}: best={max(rates):,.0f} "
-          f"rates={[f'{r:,.0f}' for r in rates]} vmax={ck.profile().get('vmax')}")
+    opts = {"capacity": 1 << 21, "race": False}
+    for k, v in (("fmax", fmax), ("kmax", kmax), ("chunk_steps", steps)):
+        if v:
+            opts[k] = v
 
-def twopc(fmax=None, kmax=None, runs=2):
+    def mk(warm):
+        ck = (PackedPaxos(3).checker().tpu_options(**opts)
+              .target_state_count(warm or cap).spawn_tpu().join())
+        return ck, ck.unique_state_count()
+
+    rates, ck = _bench(mk, 50_000, runs)
+    print(f"paxos fmax={fmax} kmax={kmax} steps={steps}: "
+          f"best={max(rates):,.0f} rates={[f'{r:,.0f}' for r in rates]} "
+          f"vmax={ck.profile().get('vmax')}")
+
+
+def twopc(fmax=None, kmax=None, runs=3):
     from stateright_tpu.models.twopc import TwoPhaseSys
-    opts = {"capacity": 1 << 22}
-    if fmax: opts["fmax"] = fmax
-    if kmax: opts["kmax"] = kmax
-    def run():
-        t0 = time.perf_counter()
-        ck = (TwoPhaseSys(7).checker().tpu_options(**opts)
-              .spawn_tpu().join())
-        return time.perf_counter() - t0, ck
-    run()
-    rates = []
-    for _ in range(runs):
-        dt, ck = run()
+    opts = {"capacity": 1 << 22, "race": False}
+    for k, v in (("fmax", fmax), ("kmax", kmax)):
+        if v:
+            opts[k] = v
+
+    def mk(_warm):
+        ck = TwoPhaseSys(7).checker().tpu_options(**opts) \
+            .spawn_tpu().join()
         assert ck.unique_state_count() == 296448
-        rates.append(296448 / dt)
+        return ck, 296448
+
+    rates, ck = _bench(mk, None, runs)
     print(f"2pc fmax={fmax} kmax={kmax}: best={max(rates):,.0f} "
-          f"rates={[f'{r:,.0f}' for r in rates]} vmax={ck.profile().get('vmax')}")
+          f"rates={[f'{r:,.0f}' for r in rates]} "
+          f"vmax={ck.profile().get('vmax')}")
+
+
+def abd(fmax=None, kmax=None, cap=100_000, runs=3):
+    from stateright_tpu.examples.abd_packed import PackedAbd
+    opts = {"capacity": 1 << 20, "race": False}
+    for k, v in (("fmax", fmax), ("kmax", kmax)):
+        if v:
+            opts[k] = v
+
+    def mk(warm):
+        ck = (PackedAbd(2, server_count=3, ordered=True, channel_depth=8)
+              .checker().tpu_options(**opts)
+              .target_state_count(warm or cap).spawn_tpu().join())
+        return ck, ck.unique_state_count()
+
+    rates, ck = _bench(mk, 10_000, runs)
+    print(f"abd fmax={fmax} kmax={kmax}: best={max(rates):,.0f} "
+          f"rates={[f'{r:,.0f}' for r in rates]} "
+          f"vmax={ck.profile().get('vmax')}")
+
 
 if __name__ == "__main__":
     for arg in sys.argv[1:]:
