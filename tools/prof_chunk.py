@@ -10,6 +10,8 @@ import time
 
 import jax
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def run(cap=300_000):
     import os
