@@ -245,9 +245,9 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     # the iteration count; paying the full fmax*max_actions lane width for
     # them wastes most of the machine. The body therefore carries TWO
     # compiled expansion sizes and picks per iteration by pending count.
-    fmax_small = min(256, fmax)
-    kmax_small = min(fmax_small * n_actions, kmax)
-    two_size = fmax_small < fmax
+    from ..ops.expand import small_step_sizes
+    fmax_small, kmax_small, two_size = small_step_sizes(
+        fmax, kmax, n_actions)
 
     # the queue slice must cover BOTH the widest append (kmax rows) and
     # the frontier dequeue (fmax rows — dynamic_slice would silently

@@ -171,6 +171,21 @@ def splice_node_keys(k_all, width: int, nk_hi, nk_lo):
          k_all[:, width + 3:]], axis=1)
 
 
+#: thin-frontier knee: iterations with at most this many pending rows
+#: take the small compiled step (measured on the tunneled chip; shared
+#: by both engines so the knob lives in one place)
+FMAX_SMALL = 256
+
+
+def small_step_sizes(fmax: int, kmax: int, n_actions: int):
+    """The two-size (thin-frontier) compilation sizes shared by the
+    single-chip and sharded loops: ``(fmax_small, kmax_small,
+    two_size)``."""
+    fmax_small = min(FMAX_SMALL, fmax)
+    return (fmax_small, min(fmax_small * n_actions, kmax),
+            fmax_small < fmax)
+
+
 def kmax_default(model, fmax: int, sound: bool) -> int:
     """Candidate-buffer width policy shared by both engines: models that
     declare ``branching_hint`` get a hint-sized buffer (halved outside

@@ -189,6 +189,13 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     # its start near the end of the queue otherwise)
     ring_headroom = max(D * kmax, fmax)
     ring = [(i, (i + 1) % D) for i in range(D)]
+    # thin BFS levels (start/tail of every search) would pay the full
+    # fmax lane width; like the single-chip loop, the body carries TWO
+    # compiled expansion sizes and picks per iteration by the REPLICATED
+    # pending maximum (pmax), so every shard takes the same branch
+    from ..ops.expand import small_step_sizes
+    fmax_small, kmax_small, two_size = small_step_sizes(
+        fmax, kmax, n_actions)
 
     def go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf, kovf,
                 steps, target_remaining, grow_limit):
@@ -203,17 +210,18 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             go = go & ~disc_hit[jnp.array(device_prop_idx)].all()
         return go
 
-    def body(state):
+    def make_step(fmax_b: int, kmax_b: int):
+      def step(state):
         c, target_remaining, grow_limit = state
         me = lax.axis_index(axis).astype(jnp.uint32)
         q_head, q_tail, log_n = c.q_head[0], c.q_tail[0], c.log_n[0]
 
-        take = jnp.minimum(q_tail - q_head, fmax)
-        sl = lax.dynamic_slice(c.q, (q_head, 0), (fmax, width + 3))
+        take = jnp.minimum(q_tail - q_head, fmax_b)
+        sl = lax.dynamic_slice(c.q, (q_head, 0), (fmax_b, width + 3))
         frontier = sl[:, :width]
         ebits = sl[:, width]
         pfp = (sl[:, width + 1], sl[:, width + 2])
-        fvalid = jnp.arange(fmax, dtype=jnp.int32) < take
+        fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
         # shared check_block analog (ops/expand.py) on local rows; the
         # frontier fingerprints come from the queue cache, not a re-hash
@@ -224,9 +232,9 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         if not sound:
             # EXACT in-batch duplicate-lane drop (ops/expand.py): local
             # duplicates never enter the ring
-            cvalid = pre_dedup(exp, cvalid, fa)
+            cvalid = pre_dedup(exp, cvalid, fmax_b * n_actions)
         vcount = cvalid.sum(dtype=jnp.int32)
-        kovf = c.kovf | (lax.psum((vcount > kmax).astype(jnp.int32),
+        kovf = c.kovf | (lax.psum((vcount > kmax_b).astype(jnp.int32),
                                   axis) > 0)
 
         if sound:
@@ -257,8 +265,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # fa. Same candidate layout as the single-chip loop
         # (ops/expand.py): queue block = [:, :W+3], log block = one
         # contiguous slice starting at log_off.
-        src = shrink_indices(cvalid, kmax)
-        kvalid = (jnp.arange(kmax, dtype=jnp.int32) < vcount) & ~kovf
+        src = shrink_indices(cvalid, kmax_b)
+        kvalid = (jnp.arange(kmax_b, dtype=jnp.int32) < vcount) & ~kovf
         cand, log_off = candidate_matrix(
             exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
         k_all = cand[src]
@@ -271,7 +279,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         if kbits:
             owner = k_all[:, log_off] >> jnp.uint32(32 - kbits)
         else:
-            owner = jnp.zeros((kmax,), jnp.uint32)
+            owner = jnp.zeros((kmax_b,), jnp.uint32)
 
         take = jnp.where(kovf, 0, take)
         q_head = q_head + take
@@ -290,7 +298,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 mine)
             t_ovf = t_ovf | o
             cnt = inserted.sum(dtype=jnp.int32)
-            src2 = shrink_indices(inserted, kmax)
+            src2 = shrink_indices(inserted, kmax_b)
             n_all = k_c[src2]
             q = lax.dynamic_update_slice(
                 q, n_all[:, :width + 3], (q_tail, 0))
@@ -319,6 +327,21 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             gen=gen, ovf=ovf, xovf=xovf, kovf=kovf, vmax=vmax,
             steps=steps, go=go)
         return (nc, target_remaining, grow_limit)
+      return step
+
+    step_large = make_step(fmax, kmax)
+    if two_size:
+        step_small = make_step(fmax_small, kmax_small)
+
+        def body(state):
+            c, _tr, _gl = state
+            # REPLICATED branch predicate: every shard takes the same
+            # path, so the collectives inside both branches line up
+            avail = lax.pmax(c.q_tail[0] - c.q_head[0], axis)
+            return lax.cond(avail > fmax_small, step_large, step_small,
+                            state)
+    else:
+        body = step_large
 
     def local_chunk(carry, target_remaining, grow_limit):
         go = go_flag(carry.q_head[0], carry.q_tail[0], carry.log_n[0],
