@@ -452,7 +452,12 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # of a dozen scalars dominated the whole chunk sync. Layout
         # (tpu.py unpacks positionally — keep in sync):
         # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-        #  vmax, disc_hit[P], disc_hi[P], disc_lo[P]]
+        #  vmax, disc_hit[P], disc_hi[P], disc_lo[P],
+        #  recent queue row (W+3), hist window (hist_on only)]
+        # the most recently enqueued state's queue row rides the sync
+        # for free (the Explorer decodes it as live progress — the
+        # chunk loop has no per-state visitation to sample from)
+        recent = out.q[jnp.maximum(out.q_tail - 1, 0)]
         stats = jnp.concatenate([
             jnp.stack([out.q_head, out.q_tail, out.log_n, out.gen,
                        out.ovf.astype(jnp.int32),
@@ -462,7 +467,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                        out.hovf.astype(jnp.int32),
                        out.vmax]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
-            out.disc_hi, out.disc_lo])
+            out.disc_hi, out.disc_lo, recent])
         if not hist_on:
             return out, stats
         # window over the representatives logged this chunk: rides the

@@ -72,6 +72,15 @@ def status_view(checker, snapshot: Optional[Snapshot]) -> Dict[str, Any]:
     recent = None
     if snapshot is not None and snapshot.actions is not None:
         recent = repr(snapshot.actions)
+    elif getattr(checker, "_recent_row", None) is not None:
+        # device engine: no per-state visitation to snapshot, but each
+        # chunk sync carries the most recently enqueued state's row
+        try:
+            state = model.decode(checker._recent_row[:model.packed_width])
+            fmt = getattr(model, "format_state", repr)
+            recent = f"recent state: {fmt(state)}"
+        except Exception:
+            recent = None  # decode of a stale row mid-growth: skip
     discovered = checker.discoveries()  # one reconstruction pass
     properties = []
     for p in model.properties():

@@ -317,6 +317,9 @@ class TpuChecker(HostChecker):
         self._prof: Dict[str, float] = {}
         # device-resident search record, pulled lazily by _ensure_mirror
         self._mirror_carry = None
+        # most recently enqueued queue row (rides each chunk sync) —
+        # the Explorer's live-progress sample for the device engine
+        self._recent_row = None
         self._resume_path = builder.resume_path_
         self._resume_frontier = None
         self._base_fps: List[int] = []
@@ -605,9 +608,14 @@ class TpuChecker(HostChecker):
             disc_hit = stats[10:10 + prop_count].astype(bool)
             disc_hi = stats[10 + prop_count:10 + 2 * prop_count]
             disc_lo = stats[10 + 2 * prop_count:10 + 3 * prop_count]
+            tail0 = 10 + 3 * prop_count
+            width3 = model.packed_width + 3
+            if int(q_tail) > 0:
+                # most recently enqueued state (live Explorer progress)
+                self._recent_row = stats[tail0:tail0 + width3].copy()
             if want_reps and h_n > self._h_pulled:
                 from .device_loop import HIST_WINDOW
-                win = stats[10 + 3 * prop_count:].reshape(
+                win = stats[tail0 + width3:].reshape(
                     (HIST_WINDOW, -1))
                 hrows = win[:, :-2]
                 hwhi, hwlo = win[:, -2], win[:, -1]
