@@ -107,6 +107,35 @@ class TestDeviceFuzz:
             assert (dev.generated_fingerprints()
                     == host.generated_fingerprints())
 
+    @pytest.mark.parametrize("seed", [2, 9])
+    def test_raced_winner_agnostic(self, seed):
+        # the default spawn_tpu() races host BFS vs the device engine;
+        # whichever wins, a full enumeration must produce the same
+        # fingerprint set as the device engine forced alone. The graph
+        # is a cycle plus chords — NO terminal states — so the
+        # eventually-property never flushes a counterexample, both runs
+        # explore the whole graph, and the parity assertion is
+        # unconditional.
+        from stateright_tpu.models.fixtures import PackedDGraph
+
+        rng = random.Random(seed)
+        g = PackedDGraph.with_property(
+            Property.eventually("impossible", lambda _, s: s >= 10_000))
+        cycle = list(range(16)) + [0]
+        g = g.with_path(cycle)
+        for _ in range(10):
+            g = g.with_path([rng.randrange(16), rng.randrange(16)])
+        raced = (g.checker().tpu_options(capacity=1 << 10, fmax=16)
+                 .spawn_tpu().join())
+        forced = (g.checker().tpu_options(capacity=1 << 10, fmax=16,
+                                          race=False)
+                  .spawn_tpu().join())
+        assert raced.discovery("impossible") is None
+        assert forced.discovery("impossible") is None
+        assert (raced.generated_fingerprints()
+                == forced.generated_fingerprints())
+        assert raced.unique_state_count() == 16
+
     @pytest.mark.parametrize("seed", [5, 13, 21])
     def test_device_host_parity_sound(self, seed):
         from stateright_tpu.models.fixtures import PackedDGraph
