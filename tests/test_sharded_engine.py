@@ -194,3 +194,16 @@ class TestShardedEventually:
               .tpu_options(mesh=_mesh(2), capacity=1 << 10, fmax=16)
               .spawn_tpu().join())
         assert c2.discovery("odd") is None
+
+
+class TestShardedKmaxOverflowRecovery:
+    def test_undersized_kmax_grows_and_completes(self):
+        # the sharded kovf protocol: all shards abort the iteration in
+        # lockstep (replicated flag), the host rebuilds with a doubled
+        # kmax, and the enumeration stays exact
+        model = TwoPhaseSys(5)
+        sharded = _sharded_checker(model, 2, capacity=1 << 14, kmax=16)
+        assert sharded.unique_state_count() == 8832
+        host = model.checker().spawn_bfs().join()
+        assert (sharded.generated_fingerprints()
+                == host.generated_fingerprints())

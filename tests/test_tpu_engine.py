@@ -436,3 +436,17 @@ def test_plan_insert_host_matches_device_probe():
         jnp.ones(len(fps), bool))
     assert not bool(ovf)
     assert int(np.asarray(inserted).sum()) == 0
+
+
+class TestKmaxOverflowRecovery:
+    def test_undersized_kmax_grows_and_completes(self):
+        # force the kovf abort-and-rebuild protocol: a candidate buffer
+        # far below the real branching must abort the first iteration
+        # BEFORE any mutation, double (vmax-scaled), and still produce
+        # the exact enumeration
+        ck = (TwoPhaseSys(5).checker()
+              .tpu_options(capacity=1 << 14, kmax=16, race=False)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 8832  # 2pc.rs:133
+        host = TwoPhaseSys(5).checker().spawn_bfs().join()
+        assert ck.generated_fingerprints() == host.generated_fingerprints()
