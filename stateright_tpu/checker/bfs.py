@@ -113,7 +113,12 @@ class BfsChecker(HostChecker):
                 pending.append((next_state, next_fp, ebits))
             if is_terminal:
                 for i, prop in enumerate(properties):
-                    if i in ebits:
+                    # first discovery wins (the reference's insert-once
+                    # flush, `bfs.rs:265-272`): without the guard, a late
+                    # terminal whose path skipped ebit-clearing (the
+                    # property loop above stops evaluating discovered
+                    # properties) overwrites the real witness
+                    if i in ebits and prop.name not in discoveries:
                         discoveries[prop.name] = state_key
             if target is not None and self._state_count >= target:
                 return

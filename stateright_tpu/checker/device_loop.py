@@ -58,9 +58,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.expand import (candidate_matrix, discovery_candidates,
-                          eventually_indices, expand_frontier, pre_dedup,
-                          splice_node_keys)
+from ..ops.expand import (assemble_candidates, discovery_candidates,
+                          eventually_indices, expand_frontier, pre_dedup)
 from ..ops.hash_kernel import fp64_device, fp64_node_device
 from ..ops.hashtable import _BUCKET, table_insert
 
@@ -100,9 +99,15 @@ class ChunkCarry(NamedTuple):
     kovf: jax.Array     # bool[]   kmax candidate-buffer overflow (host
     #                              rebuilds with doubled kmax; no data loss)
     steps: jax.Array    # int32[]  remaining step budget for this chunk
-    vmax: jax.Array     # int32[]  max valid children in one iteration
-    #                              this chunk — the host right-sizes kmax
-    #                              from it (gather cost scales with kmax)
+    vmax: jax.Array     # int32[]  max RAW-valid children in one iteration
+    #                              this chunk — the host right-sizes kraw
+    #                              from it (gather cost scales with it)
+    dmax: jax.Array     # int32[]  max post-dedup children in one
+    #                              iteration this chunk — sizes kmax (the
+    #                              probe/append stage-two buffer)
+    rmax: jax.Array     # int32[]  max valid children of a single ROW
+    #                              this chunk — sizes hint_eff (the
+    #                              per-row compaction width)
     # --- host-property history dedup (models with host_property_indices;
     # 1-element dummies otherwise). The table dedups inserted states by
     # their host-property key columns IN the loop body, so the host's
@@ -182,14 +187,26 @@ def model_cache_key(model):
 
 def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                    symmetry: bool = False, sound: bool = False,
-                   hcap: int = 0, n_init: int = 0):
+                   hcap: int = 0, n_init: int = 0, kraw: int = 0,
+                   hint_eff: int = 0):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
-    Returned callable: ``chunk(carry, target_remaining, grow_limit) ->
-    carry`` where ``target_remaining`` bounds ``gen`` (INT32_MAX when
-    unbounded) and ``grow_limit`` is the log length at which the loop exits
-    so the host can grow the table. ``kmax`` bounds valid children per
-    iteration; exceeding it sets ``kovf`` and leaves the carry untouched.
+    Returned callable: ``chunk(carry, target_remaining, grow_limit,
+    h_base) -> (carry, stats)`` where ``target_remaining`` bounds ``gen``
+    (INT32_MAX when unbounded), ``grow_limit`` is the log length at which
+    the loop exits so the host can grow the table, and ``h_base`` anchors
+    the representative window at the host's already-pulled count.
+    ``kmax`` bounds valid children per iteration; exceeding it sets
+    ``kovf`` and leaves the carry untouched.
+
+    Thin frontiers (common at the start and tail of every search) run a
+    small compiled step; the program SEQUENCES three ``while_loop``s —
+    small, large, small — each gated on its frontier-size window, instead
+    of branching per iteration: an in-loop ``lax.cond`` over the two step
+    sizes copied every carried buffer per iteration (~1.4 ms at paxos
+    shapes, profiler-verified round 5 — the round-3 cond finding), and
+    host-chained separate programs paid the ~30 ms tunneled dispatch
+    floor per launch. Sequential loops in one launch pay neither.
 
     With ``sound`` (``CheckerBuilder.sound_eventually()``), dedup and the
     log work on (state, pending-ebits) NODE keys (``fp64_node_device``)
@@ -202,13 +219,13 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     """
     mkey = model_cache_key(model)
     key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound, hcap,
-           n_init)
+           n_init, kraw, hint_eff)
     if mkey is not None:
         cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
-                         sound, hcap, n_init)
+                         sound, hcap, n_init, kraw, hint_eff)
     if mkey is not None:
         _CHUNK_CACHE[key] = fn
     return fn
@@ -216,7 +233,7 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 
 def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                     symmetry: bool, sound: bool = False, hcap: int = 0,
-                    n_init: int = 0):
+                    n_init: int = 0, kraw: int = 0, hint_eff: int = 0):
     n_actions = model.max_actions
     width = model.packed_width
     properties = model.properties()
@@ -229,6 +246,30 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     device_prop_idx = [i for i in range(prop_count) if i not in host_idx]
     fa = fmax * n_actions
     kmax = min(kmax, fa)
+    # two-stage candidate compaction: raw-valid lanes compact to the
+    # kraw buffer (where hashing and in-batch dedup run); dedup
+    # SURVIVORS compact again to the narrower kmax buffer for the table
+    # probe, candidate assembly, and appends. Duplicate-heavy models
+    # (2pc: >80% duplicate lanes) keep their narrow probe while the
+    # hash/dedup still runs far below the fa width. kraw == kmax (the
+    # sound-mode default — node-key dedup happens in the table) makes
+    # stage two a trace-time no-op.
+    #
+    # With ``hint_eff`` (models declaring ``branching_hint``: a per-ROW
+    # bound on valid children), stage one is PER-ROW instead of global:
+    # a tiny top_k over each row's action axis selects its <= hint_eff
+    # valid slots and one gather reads them straight out of the 3-D
+    # successor tensor — no fa-wide cumsum/scatter, no F*A flat reshape
+    # (a tile relayout), and kraw is the static fmax*hint_eff. A row
+    # exceeding hint_eff aborts the iteration before any mutation
+    # (rmax rides the stats; the host rebuilds with a larger hint).
+    if hint_eff and hint_eff >= n_actions:
+        hint_eff = 0  # degenerate: the full action axis, use global path
+    if hint_eff:
+        kraw = fmax * hint_eff
+    else:
+        kraw = min(kraw, fa) if kraw else kmax
+    kmax = min(kmax, kraw)
     # in-loop history-key dedup for host-evaluated properties
     hist_on = hcap > 0
     if hist_on:
@@ -240,36 +281,37 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # small constant. Hitting the bound reports hovf (the growth
         # signal) instead of spinning out the default 4096 rounds.
         h_rounds = min(4096, hcap + 64)
-    # thin BFS levels (a few hundred pending states) are common at the
-    # start and tail of every search, and for narrow models they dominate
-    # the iteration count; paying the full fmax*max_actions lane width for
-    # them wastes most of the machine. The body therefore carries TWO
-    # compiled expansion sizes and picks per iteration by pending count.
-    from ..ops.expand import small_step_sizes
-    fmax_small, kmax_small, two_size = small_step_sizes(
-        fmax, kmax, n_actions)
-
     # the queue slice must cover BOTH the widest append (kmax rows) and
     # the frontier dequeue (fmax rows — dynamic_slice would silently
     # CLAMP its start near the end of the queue, re-expanding consumed
     # rows and skipping pending ones)
     qmargin = max(kmax, fmax)
 
-    def cond(state):
-        c, target_remaining, grow_limit = state
-        go = (c.q_tail > c.q_head) & (c.steps > 0) \
-            & ~c.ovf & ~c.xovf & ~c.kovf & ~c.hovf \
-            & (c.gen < target_remaining) \
-            & (c.log_n < grow_limit) \
-            & (c.q_tail <= qcap - qmargin)
-        if device_prop_idx and not host_idx:
-            # stop once every device-evaluated property has a discovery —
-            # but only when no host properties remain: those need the
-            # reached set to keep growing between post-hoc passes
-            go = go & ~c.disc_hit[jnp.array(device_prop_idx)].all()
-        return go
+    def make_cond(lo_water, hi_water):
+        def cond(state):
+            c, target_remaining, grow_limit = state
+            avail = c.q_tail - c.q_head
+            # [lo, hi] is the loop's frontier-size window: the small loop
+            # (hi = fmax_small) yields once the frontier outgrows it, the
+            # large loop (lo = fmax_small+1) yields once it thins; the
+            # next loop in the chunk's small-large-small sequence picks
+            # the frontier up, in the same launch
+            go = (avail > 0) & (avail >= lo_water) & (avail <= hi_water) \
+                & (c.steps > 0) \
+                & ~c.ovf & ~c.xovf & ~c.kovf & ~c.hovf \
+                & (c.gen < target_remaining) \
+                & (c.log_n < grow_limit) \
+                & (c.q_tail <= qcap - qmargin)
+            if device_prop_idx and not host_idx:
+                # stop once every device-evaluated property has a
+                # discovery — but only when no host properties remain:
+                # those need the reached set to keep growing between
+                # post-hoc passes
+                go = go & ~c.disc_hit[jnp.array(device_prop_idx)].all()
+            return go
+        return cond
 
-    def make_step(fmax_b: int, kmax_b: int):
+    def make_step(fmax_b: int, kraw_b: int, kfin_b: int):
         def step(state):
             c, target_remaining, grow_limit = state
             sl = jax.lax.dynamic_slice(
@@ -281,20 +323,23 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
             # the shared check_block analog (ops/expand.py); the frontier
-            # fingerprints come from the queue cache, not a re-hash
+            # fingerprints come from the queue cache, not a re-hash, and
+            # child fingerprints are deferred to the narrow buffer below
             exp = expand_frontier(model, frontier, fvalid, ebits,
                                   eventually_idx, symmetry=symmetry,
-                                  pfp=pfp)
+                                  pfp=pfp, child_fp=False)
             cvalid = exp.cvalid
             gen_count = cvalid.sum(dtype=jnp.int32)
-            if not sound:
-                # EXACT in-batch duplicate-lane drop (ops/expand.py).
-                # Load-bearing beyond the kmax shrink: WITHOUT it,
-                # same-fp duplicate lanes spiral the table probe's
-                # claim-retry rounds (paxos measured 23x slower)
-                cvalid = pre_dedup(exp, cvalid, fmax_b * n_actions)
-            vcount = cvalid.sum(dtype=jnp.int32)
-            kovf = vcount > kmax_b
+            vcount = gen_count
+            if hint_eff:
+                # per-row bound: abort (before any mutation) only when a
+                # single row outgrows the declared branching hint
+                rcnt = exp.avalid.sum(axis=1, dtype=jnp.int32)
+                rmax_it = rcnt.max()
+                kovf = rmax_it > hint_eff
+            else:
+                rmax_it = jnp.int32(0)
+                kovf = vcount > kraw_b
 
             if sound:
                 # node keys: dedup identity = (state fp, pending ebits).
@@ -316,54 +361,121 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 disc_lo = jnp.where(keep, disc_lo, cand_lo)
                 disc_hit = disc_hit | new_hit
 
+            # GATHER-EARLY, TWO-STAGE: compact the raw-valid lanes to the
+            # kraw_b buffer FIRST — hashing (and canonicalization, under
+            # symmetry) and the in-batch dedup run there instead of at
+            # the full fa width (at paxos shapes that was ~5
+            # scatter/gather passes of 131k lanes each; per-lane
+            # scatter/gather latency is the iteration's cost floor on
+            # this platform, NOTES.md). Dedup SURVIVORS then compact to
+            # the narrower kfin_b buffer where the table probe, the
+            # candidate-matrix gather, and the appends run — on
+            # duplicate-heavy models (2pc: >80% duplicate lanes) the
+            # probe would otherwise pay 3-4x its necessary lane width.
+            #
             # Abort protocol WITHOUT lax.cond: on this platform each
             # branch of a conditional that threads the big carried
             # buffers costs a full buffer copy EVERY iteration (~25 ms at
             # engine shapes, profiler-verified), so overflow handling is
             # expressed as masks instead. kovf pre-gates the table
             # insert's valid lanes, so nothing mutates and the host can
-            # re-expand the same frontier after resizing. hovf COMMITS
-            # the iteration (its inserted keys and rows are real) and
-            # only stops the loop; the unresolved lanes' keys went
+            # re-expand the same frontier after resizing (kraw and kmax
+            # are sized independently from the reported vmax/dmax). hovf
+            # COMMITS the iteration (its inserted keys and rows are real)
+            # and only stops the loop; the unresolved lanes' keys went
             # unlogged, which the host recovers by rescanning this
             # chunk's queue span (TpuChecker._rescan_history). Garbage
             # rows block-written past an un-advanced tail are never
             # observed: the tail only moves on commit and the next
             # commit overwrites them.
-            src = shrink_indices(cvalid, kmax_b)
-            kvalid = (jnp.arange(kmax_b, dtype=jnp.int32) < vcount) \
-                & ~kovf
-            # the probe only needs the dedup KEYS, so only those two
-            # columns compact to kmax lanes before it; the full candidate
-            # matrix is gathered ONCE, after the insert, for just the
-            # INSERTED lanes (via the composed plan src[src2]) — the wide
-            # every-valid-lane gather this replaces was ~1 ms at paxos
-            # shapes
-            k_chi = exp.chi[src]
-            k_clo = exp.clo[src]
+            if hint_eff:
+                # PER-ROW stage one: hint_eff rounds of argmax-and-mask
+                # over each row's action axis (pure elementwise/reduce —
+                # no cross-row scan, no fa-wide scatter) pick the row's
+                # valid slots in action order; the slots become GLOBAL
+                # flat indices for one plain 1-D gather. Parent-side
+                # columns broadcast along the hint axis — no gather.
+                # (A lax.top_k + 3-D take_along_axis variant measured ~2x
+                # slower end-to-end on this platform.)
+                avals = jnp.where(
+                    exp.avalid,
+                    jnp.arange(n_actions, 0, -1, dtype=jnp.int32)[None, :],
+                    0)
+                acols = jnp.arange(n_actions, dtype=jnp.int32)[None, :]
+                cols = []
+                for _s in range(hint_eff):
+                    j = jnp.argmax(avals, axis=1).astype(jnp.int32)
+                    cols.append(j)
+                    avals = jnp.where(acols == j[:, None], 0, avals)
+                j_table = jnp.stack(cols, axis=1)  # (F, hint)
+                src = (jnp.arange(fmax_b, dtype=jnp.int32)[:, None]
+                       * n_actions + j_table).reshape(-1)
+                rows_k = exp.flat[src]
+                rvalid = (jnp.arange(hint_eff, dtype=jnp.int32)[None, :]
+                          < rcnt[:, None]).reshape(-1)
+                par3 = jnp.broadcast_to(
+                    jnp.stack([exp.ebits, p_whi, p_wlo], axis=1)[:, None, :],
+                    (fmax_b, hint_eff, 3)).reshape(-1, 3)
+            else:
+                src = shrink_indices(cvalid, kraw_b)
+                rvalid = jnp.arange(kraw_b, dtype=jnp.int32) < vcount
+                rows_k = exp.flat[src]
+                ridx = src // n_actions  # parent frontier row per lane
+                # parent-side columns gathered in ONE 3-column pass
+                par3 = jnp.stack([exp.ebits, p_whi, p_wlo], axis=1)[ridx]
+            if symmetry:
+                canon = jax.vmap(model.packed_representative)
+                s_chi, s_clo = fp64_device(canon(rows_k))
+                o_hi, o_lo = fp64_device(rows_k)
+            else:
+                s_chi, s_clo = fp64_device(rows_k)
+                o_hi, o_lo = s_chi, s_clo
+            ebits_k = par3[:, 0]
             if sound:
                 # dedup identity under sound = (state fp, pending ebits)
                 # node keys; the state fps stay in the candidate matrix
-                # for the queue's fingerprint cache
-                s_chi, s_clo = k_chi, k_clo
-                k_ceb = jnp.repeat(exp.ebits, n_actions)[src]
-                k_chi, k_clo = fp64_node_device(s_chi, s_clo, k_ceb)
+                # for the queue's fingerprint cache. No in-batch dedup
+                # (the table resolves node-key duplicates), so stage two
+                # is a no-op: kraw == kmax.
+                k_chi, k_clo = fp64_node_device(s_chi, s_clo, ebits_k)
+                dvalid = rvalid
+            else:
+                # EXACT in-batch duplicate-lane drop (ops/expand.py).
+                # Load-bearing beyond dedup hygiene: WITHOUT it, same-fp
+                # duplicate lanes spiral the table probe's claim-retry
+                # rounds (paxos measured 23x slower)
+                dvalid = pre_dedup(s_chi, s_clo, rvalid)
+                k_chi, k_clo = s_chi, s_clo
+            dcount = dvalid.sum(dtype=jnp.int32)
+            kovf = kovf | (dcount > kfin_b)
+
+            # ONE candidate matrix, assembled at kraw_b lanes
+            # (ops/expand.assemble_candidates owns the column layout)
+            cand, log_off = assemble_candidates(
+                rows_k, ebits_k, s_chi, s_clo, par3[:, 1], par3[:, 2],
+                o_hi, o_lo, width, symmetry, sound,
+                nk_hi=k_chi if sound else None,
+                nk_lo=k_clo if sound else None)
+
+            if kfin_b < kraw_b:
+                # stage two: survivors to the narrow probe buffer
+                src2 = shrink_indices(dvalid, kfin_b)
+                cand = cand[src2]
+                k_chi = k_chi[src2]
+                k_clo = k_clo[src2]
+                kvalid = (jnp.arange(kfin_b, dtype=jnp.int32) < dcount) \
+                    & ~kovf
+            else:
+                kvalid = dvalid & ~kovf
 
             inserted, key_hi, key_lo, t_ovf = table_insert(
                 c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
             t_ovf = t_ovf & ~kovf
             cnt = inserted.sum(dtype=jnp.int32)
 
-            # ONE candidate matrix (shared layout — ops/expand.py),
-            # gathered ONCE for the inserted lanes
-            cand, log_off = candidate_matrix(
-                exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
-            src2 = shrink_indices(inserted, kmax_b)
-            n_all = cand[src[src2]]
-            if sound:
-                # splice the node keys (already computed at kmax lanes)
-                n_all = splice_node_keys(n_all, width,
-                                         k_chi[src2], k_clo[src2])
+            # the candidate matrix is gathered ONCE for the inserted lanes
+            src3 = shrink_indices(inserted, kfin_b)
+            n_all = cand[src3]
             n_flat = n_all[:, :width]
 
             if hist_on:
@@ -378,12 +490,12 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 # rescan of this chunk's queue span after growing the
                 # table (TpuChecker._rescan_history).
                 hhi, hlo = fp64_device(n_flat[:, hoff:hoff + hwidth])
-                hval = jnp.arange(kmax_b, dtype=jnp.int32) < cnt
+                hval = jnp.arange(kfin_b, dtype=jnp.int32) < cnt
                 h_ins, hkey_hi, hkey_lo, h_ovf = table_insert(
                     c.hkey_hi, c.hkey_lo, hhi, hlo, hval,
                     max_rounds=h_rounds)
                 h_ovf = h_ovf & ~kovf
-                hsrc = shrink_indices(h_ins, kmax_b)
+                hsrc = shrink_indices(h_ins, kfin_b)
                 hcnt = h_ins.sum(dtype=jnp.int32)
                 hidx = jax.lax.dynamic_update_slice(
                     c.hidx, (c.q_tail + hsrc).astype(jnp.int32),
@@ -422,37 +534,70 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 kovf=c.kovf | kovf, hovf=c.hovf | h_ovf,
                 xovf=c.xovf | exp.xovf,
                 steps=c.steps - 1,
-                vmax=jnp.maximum(c.vmax, vcount))
+                vmax=jnp.maximum(c.vmax, vcount),
+                dmax=jnp.maximum(c.dmax, dcount),
+                rmax=jnp.maximum(c.rmax, rmax_it))
         return step
 
-    step_large = make_step(fmax, kmax)
+    # thin BFS frontiers (a few hundred pending states) are common at the
+    # start and tail of every search, and for narrow models they dominate
+    # the iteration count; paying the full fmax*max_actions lane width for
+    # them wastes most of the machine — so the chunk sequences a small
+    # step loop, the large loop, and a tail small loop (see the
+    # build_chunk_fn docstring for why sequencing beats an in-loop cond)
+    from ..ops.expand import small_step_sizes
+    fmax_small, kmax_small, two_size = small_step_sizes(
+        fmax, kmax, n_actions)
+    fa_small = fmax_small * n_actions
+    kraw_small = fmax_small * hint_eff if hint_eff \
+        else min(fa_small, kraw)
+    step_large = make_step(fmax, kraw, kmax)
     if two_size:
-        step_small = make_step(fmax_small, kmax_small)
+        # the small step's raw bound is fa_small itself; its stage-two
+        # buffer shrinks with kmax but never below what dedup can survive
+        step_small = make_step(fmax_small, kraw_small,
+                               min(kmax_small, kraw_small))
 
+    def make_body(step):
         def body(state):
-            c, _tr, _gl = state
-            avail = c.q_tail - c.q_head
-            nc = jax.lax.cond(avail > fmax_small, step_large, step_small,
-                              state)
-            return (nc, _tr, _gl)
-    else:
-        def body(state):
-            return (step_large(state), state[1], state[2])
+            return (step(state), state[1], state[2])
+        return body
 
-    def chunk(carry: ChunkCarry, target_remaining, grow_limit):
-        # the window anchor is the entry h_n: the engine maintains the
-        # invariant that everything logged before this chunk has been
-        # host-evaluated (window or fallback pull) before the next launch
-        h0 = carry.h_n
-        out, _, _ = jax.lax.while_loop(
-            cond, body, (carry, target_remaining, grow_limit))
+    def chunk(carry: ChunkCarry, target_remaining, grow_limit, h_base):
+        # h_base anchors the representative window at the host's pulled
+        # count (NOT this launch's entry h_n), covering everything the
+        # whole small/large loop sequence logged
+        state = (carry, target_remaining, grow_limit)
+        imax = jnp.int32(2**31 - 1)
+        if two_size:
+            # outer loop over the [small-loop, large-loop] pair: a
+            # frontier oscillating around the knee keeps running until
+            # the steps budget (or another exit condition) is spent,
+            # instead of ending the chunk at the first re-crossing and
+            # paying a host round trip per crossing
+            small = (jnp.int32(0), jnp.int32(fmax_small))
+            large = (jnp.int32(fmax_small + 1), imax)
+
+            def outer_body(state):
+                state = jax.lax.while_loop(
+                    make_cond(*small), make_body(step_small), state)
+                return jax.lax.while_loop(
+                    make_cond(*large), make_body(step_large), state)
+
+            state = jax.lax.while_loop(
+                make_cond(jnp.int32(0), imax), outer_body, state)
+        else:
+            state = jax.lax.while_loop(
+                make_cond(jnp.int32(0), imax),
+                make_body(step_large), state)
+        out, _, _ = state
         # ALL host-read scalars packed into ONE uint32 vector: on a
         # tunneled device every device->host transfer is a round trip
         # (profiler-measured ~10-60 ms each), and a per-leaf device_get
         # of a dozen scalars dominated the whole chunk sync. Layout
         # (tpu.py unpacks positionally — keep in sync):
         # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-        #  vmax, disc_hit[P], disc_hi[P], disc_lo[P],
+        #  vmax, dmax, rmax, disc_hit[P], disc_hi[P], disc_lo[P],
         #  recent queue row (W+3), hist window (hist_on only)]
         # the most recently enqueued state's queue row rides the sync
         # for free (the Explorer decodes it as live progress — the
@@ -465,7 +610,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                        out.kovf.astype(jnp.int32),
                        out.h_n,
                        out.hovf.astype(jnp.int32),
-                       out.vmax]).astype(jnp.uint32),
+                       out.vmax, out.dmax, out.rmax]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo, recent])
         if not hist_on:
@@ -478,7 +623,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # device->host transfer on the tunneled chip costs ~100 ms of
         # latency regardless of size, so a separate window transfer
         # doubled the per-chunk sync cost.
-        sel = out.hidx[jnp.minimum(h0 + jnp.arange(HIST_WINDOW),
+        sel = out.hidx[jnp.minimum(h_base + jnp.arange(HIST_WINDOW),
                                    out.hidx.shape[0] - 1)]
         rows = out.q[jnp.minimum(sel, out.q.shape[0] - 1)][:, :width]
         li = jnp.clip(sel - n_init, 0, out.log.shape[0] - 1)
@@ -555,7 +700,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 hkey_lo=jnp.zeros((hcap if hcap else 1,), jnp.uint32),
                 hidx=jnp.zeros((logcap if hcap else 1,), jnp.int32),
                 h_n=jnp.int32(0), hovf=jnp.bool_(False),
-                vmax=jnp.int32(0))
+                vmax=jnp.int32(0), dmax=jnp.int32(0),
+                rmax=jnp.int32(0))
 
         fn = jax.jit(build)
         _SEED_CACHE[key] = fn

@@ -172,7 +172,11 @@ class DfsChecker(HostChecker):
                      next_key))
             if is_terminal:
                 for i, prop in enumerate(properties):
-                    if i in ebits:
+                    # first discovery wins (the reference's insert-once
+                    # flush): a late terminal whose path skipped
+                    # ebit-clearing (discovered properties stop being
+                    # evaluated) must not overwrite the real witness
+                    if i in ebits and prop.name not in discoveries:
                         discoveries[prop.name] = list(fingerprints)
             if target is not None and self._state_count >= target:
                 return

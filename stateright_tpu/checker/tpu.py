@@ -473,15 +473,36 @@ class TpuChecker(HostChecker):
         opts = self._tpu_options
         fmax = int(opts.get("fmax", auto_fmax(model)))
         fa = fmax * model.max_actions
-        # candidate-buffer width: every gather/probe in the loop body
-        # scales with it, so models that know their branching (max valid
-        # children per state) can shrink it well below the fa//2 default
-        # via ``branching_hint``; a frontier that spikes past it triggers
-        # the cheap kovf resize
-        from ..ops.expand import kmax_default
-        kmax = min(int(opts.get("kmax",
+        # two-stage candidate-buffer widths (ops/expand.py): kraw holds
+        # the raw-valid lanes (hash + in-batch dedup width), kmax the
+        # dedup survivors (probe/append width) — every gather/probe in
+        # the loop body scales with one of them, so models that know
+        # their branching (max valid children per state) shrink both via
+        # ``branching_hint``; an iteration that spikes past either
+        # triggers the cheap kovf resize
+        from ..ops.expand import kfinal_default, kmax_default
+        kraw = min(int(opts.get("kraw",
                                 kmax_default(model, fmax, self._sound))),
                    fa)
+        kmax = min(int(opts.get("kmax",
+                                kfinal_default(model, fmax,
+                                               self._sound))),
+                   kraw)
+        # OPT-IN per-row stage-one compaction (device_loop.py): kraw
+        # becomes the static fmax*hint; a row outgrowing it triggers the
+        # same kovf rebuild protocol. Off by default: ``branching_hint``
+        # is a batch-average heuristic, not a per-row bound (paxos
+        # declares 4 but rows reach 10 — measured via profile()['rmax']),
+        # so the global cross-row compaction usually packs tighter. Only
+        # worth trying on models whose TRUE per-row branching is small
+        # and uniform.
+        hint_eff = int(opts.get("hint", 0))
+        if hint_eff < 0 or hint_eff >= model.max_actions:
+            # mirror the device-side degenerate fallback
+            # (device_loop.py): the host must agree it is running the
+            # global path, or the kovf resize logic would never grow
+            # kraw and the chunk loop would rebuild forever
+            hint_eff = 0
         k_steps = int(opts.get("chunk_steps", 64))
         insert_fn = _insert_jit()
 
@@ -566,10 +587,14 @@ class TpuChecker(HostChecker):
             # in-flight seed slowed the loop ~2.5x no longer reproduces
             # with the consolidated carry (q/log matrices, 2-D table);
             # PJRT orders the dependent programs itself.
-        chunk_fn = build_chunk_fn(model, qcap, self._capacity, fmax,
+        def mk_chunk():
+            return build_chunk_fn(model, qcap, self._capacity, fmax,
                                   kmax, symmetry=self._symmetry,
                                   sound=self._sound, hcap=hcap,
-                                  n_init=n_init)
+                                  n_init=n_init, kraw=kraw,
+                                  hint_eff=hint_eff)
+
+        chunk_fn = mk_chunk()
 
         # --- chunk loop -------------------------------------------------
         while True:
@@ -589,26 +614,24 @@ class TpuChecker(HostChecker):
                 # history dedup is dead work now (and, saturated, would
                 # stall the loop via hovf) — rebuild without it
                 hcap = 0
-                chunk_fn = build_chunk_fn(model, qcap, self._capacity,
-                                          fmax, kmax,
-                                          symmetry=self._symmetry,
-                                          sound=self._sound, hcap=0,
-                                          n_init=n_init)
+                chunk_fn = mk_chunk()
             with self._timed("chunk"):
-                carry, stats_d = chunk_fn(carry, remaining, grow_limit)
+                carry, stats_d = chunk_fn(carry, remaining, grow_limit,
+                                          np.int32(self._h_pulled))
                 # ONE transfer for everything the host reads per chunk
                 # (scalars + the representative window when host props
                 # are on): each transfer costs ~100 ms of tunnel latency
                 stats = np.asarray(stats_d)
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-             vmax) = (int(stats[0]), int(stats[1]), int(stats[2]),
-                      int(stats[3]), bool(stats[4]), bool(stats[5]),
-                      bool(stats[6]), int(stats[7]), bool(stats[8]),
-                      int(stats[9]))
-            disc_hit = stats[10:10 + prop_count].astype(bool)
-            disc_hi = stats[10 + prop_count:10 + 2 * prop_count]
-            disc_lo = stats[10 + 2 * prop_count:10 + 3 * prop_count]
-            tail0 = 10 + 3 * prop_count
+             vmax, dmax, rmax) = (
+                int(stats[0]), int(stats[1]), int(stats[2]),
+                int(stats[3]), bool(stats[4]), bool(stats[5]),
+                bool(stats[6]), int(stats[7]), bool(stats[8]),
+                int(stats[9]), int(stats[10]), int(stats[11]))
+            disc_hit = stats[12:12 + prop_count].astype(bool)
+            disc_hi = stats[12 + prop_count:12 + 2 * prop_count]
+            disc_lo = stats[12 + 2 * prop_count:12 + 3 * prop_count]
+            tail0 = 12 + 3 * prop_count
             width3 = model.packed_width + 3
             if int(q_tail) > 0:
                 # most recently enqueued state (live Explorer progress)
@@ -621,8 +644,11 @@ class TpuChecker(HostChecker):
                 hwhi, hwlo = win[:, -2], win[:, -1]
             q_size = int(q_tail) - int(q_head)
             self._prof["chunks"] = self._prof.get("chunks", 0) + 1
-            # observed branching, for tuning model.branching_hint
+            # observed branching (raw / post-dedup), for tuning
+            # model.branching_hint and the kraw/kmax buffer sizes
             self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
+            self._prof["dmax"] = max(self._prof.get("dmax", 0), dmax)
+            self._prof["rmax"] = max(self._prof.get("rmax", 0), rmax)
             self._state_count += int(gen)
             self._unique_state_count = base_unique + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
@@ -696,22 +722,34 @@ class TpuChecker(HostChecker):
                                 qcap, n_init, discoveries)
                             if not rescan_ovf:
                                 break
-                    chunk_fn = build_chunk_fn(
-                        model, qcap, self._capacity, fmax, kmax,
-                        symmetry=self._symmetry, sound=self._sound,
-                        hcap=hcap, n_init=n_init)
+                    chunk_fn = mk_chunk()
                 self._hscan_tail = int(q_tail)
             if bool(kovf):
-                # a batch produced more valid children than the candidate
-                # buffer; nothing was committed — resize to the observed
-                # branching (at least doubling) and resume
-                kmax = min(max(kmax * 2,
-                               -(-(vmax + vmax // 4) // 256) * 256), fa)
-                chunk_fn = build_chunk_fn(model, qcap, self._capacity,
-                                          fmax, kmax,
-                                          symmetry=self._symmetry,
-                                          sound=self._sound, hcap=hcap,
-                                          n_init=n_init)
+                # a batch overflowed one of the candidate buffers;
+                # nothing was committed — resize the overflowed stage(s)
+                # to the observed branching (at least doubling) and
+                # resume. rmax = per-row max (sizes hint_eff), vmax =
+                # raw-valid max (sizes kraw), dmax = post-dedup max
+                # (sizes kmax).
+                grew = False
+                if hint_eff and rmax > hint_eff:
+                    hint_eff = max(hint_eff + 1, rmax + rmax // 4)
+                    if hint_eff >= model.max_actions:
+                        hint_eff = 0  # degenerate: fall back to global
+                    grew = True
+                if not hint_eff and vmax > kraw:
+                    kraw = min(max(kraw * 2,
+                                   -(-(vmax + vmax // 4) // 256) * 256),
+                               fa)
+                    grew = True
+                if dmax > kmax or not grew:
+                    kmax = min(max(kmax * 2,
+                                   -(-(dmax + dmax // 4) // 256) * 256),
+                               kraw if not hint_eff
+                               else fmax * hint_eff)
+                kmax = min(kmax, kraw if not hint_eff
+                           else fmax * hint_eff)
+                chunk_fn = mk_chunk()
                 carry = carry._replace(kovf=jnp.bool_(False))
                 continue
             done = (q_size == 0
@@ -727,11 +765,7 @@ class TpuChecker(HostChecker):
                 with self._timed("grow"):
                     carry, qcap = self._grow_device(carry, qcap, n_init,
                                                     headroom, insert_fn)
-                chunk_fn = build_chunk_fn(model, qcap, self._capacity,
-                                          fmax, kmax,
-                                          symmetry=self._symmetry,
-                                          sound=self._sound, hcap=hcap,
-                                          n_init=n_init)
+                chunk_fn = mk_chunk()
 
         if self._tpu_options.get("resumable"):
             # pull the pending frontier eagerly so save() needs no pinned

@@ -24,6 +24,7 @@ class Expansion(NamedTuple):
     pbits: jax.Array     # bool[F, P]  property bits per frontier row
     ebits: jax.Array     # uint32[F]   eventually-bits after clearing
     flat: jax.Array      # uint32[F*A, W] children (action-major per row)
+    avalid: jax.Array    # bool[F, A]  per-(row, action) validity
     cvalid: jax.Array    # bool[F*A]   child validity (enabled & non-no-op)
     chi: jax.Array       # uint32[F*A] child fingerprints (canonical under
     clo: jax.Array       #             symmetry reduction)
@@ -46,7 +47,8 @@ def eventually_indices(properties) -> list:
 
 def expand_frontier(model, frontier, fvalid, ebits,
                     eventually_idx: Sequence[int],
-                    symmetry: bool = False, pfp=None) -> Expansion:
+                    symmetry: bool = False, pfp=None,
+                    child_fp: bool = True) -> Expansion:
     """Evaluate properties and expand one frontier batch (pure JAX).
 
     With ``symmetry``, fingerprints are taken over
@@ -71,7 +73,13 @@ def expand_frontier(model, frontier, fvalid, ebits,
     frontier every iteration (a ~W-column hash graph, the single biggest
     op-count item for wide models) is skipped. Under symmetry the cached
     values are the CANONICAL fingerprints (the queue appends exactly what
-    dedup inserted)."""
+    dedup inserted).
+
+    With ``child_fp=False`` the child fingerprints (chi/clo/ohi/olo) are
+    skipped (returned as None): callers on the gather-early path compact
+    valid lanes to the narrow candidate buffer FIRST and hash there —
+    hashing (and canonicalizing, under symmetry) at the full ``F*A`` lane
+    width was one of the widest per-iteration op groups."""
     fcount = frontier.shape[0]
     width = model.packed_width
     pbits = jax.vmap(model.packed_properties)(frontier)
@@ -91,47 +99,54 @@ def expand_frontier(model, frontier, fvalid, ebits,
     avalid = avalid & fvalid[:, None]
     flat = succ.reshape((-1, width))
     if symmetry:
+        phi, plo = pfp if pfp is not None \
+            else fp64_device(jax.vmap(model.packed_representative)(frontier))
+    else:
+        phi, plo = pfp if pfp is not None else fp64_device(frontier)
+    if not child_fp:
+        chi = clo = ohi = olo = None
+    elif symmetry:
         canon = jax.vmap(model.packed_representative)
         chi, clo = fp64_device(canon(flat))
         ohi, olo = fp64_device(flat)
-        phi, plo = pfp if pfp is not None \
-            else fp64_device(canon(frontier))
     else:
         chi, clo = fp64_device(flat)
         ohi, olo = chi, clo
-        phi, plo = pfp if pfp is not None else fp64_device(frontier)
     terminal = fvalid & ~avalid.any(axis=1)
     return Expansion(pbits=pbits, ebits=ebits, flat=flat,
-                     cvalid=avalid.reshape(-1), chi=chi, clo=clo,
-                     ohi=ohi, olo=olo,
+                     avalid=avalid, cvalid=avalid.reshape(-1),
+                     chi=chi, clo=clo, ohi=ohi, olo=olo,
                      phi=phi, plo=plo, terminal=terminal, xovf=xovf)
 
 
-def pre_dedup(exp: Expansion, cvalid, fa: int):
+def pre_dedup(chi, clo, cvalid):
     """EXACT in-batch duplicate-lane mask: drop candidate lanes whose
     fingerprint already appears at an earlier valid lane of this batch.
 
     One scatter-min claim arena keyed by fingerprint hash; a losing lane
     is dropped only when the winner's fingerprint VERIFIES equal (one
     2-column row gather), so distinct keys colliding on an arena cell
-    are kept — sound by construction. High-merge models (2pc: >80%
-    duplicate lanes) then fit a far narrower ``kmax``, which every
-    downstream gather/probe/ring-hop scales with. Callers skip this
-    under sound mode, where dedup identity is (state, ebits) node keys
-    computed only post-compaction.
+    are kept — sound by construction. Duplicate-heavy models (2pc: >80%
+    duplicate lanes) then spend far fewer probe claim-retry rounds, and
+    every retained lane is a distinct key. Runs at whatever lane width
+    the caller hands it — the gather-early engines compact raw-valid
+    lanes to the ``kmax`` candidate buffer first and dedup there.
+    Callers skip this under sound mode, where dedup identity is
+    (state, ebits) node keys.
     """
+    fa = chi.shape[0]
     acells = 1 << max((2 * fa - 1).bit_length(), 0)
     lane = jnp.arange(fa, dtype=jnp.int32)
-    slot = ((exp.clo ^ (exp.chi * jnp.uint32(0x9E3779B9)))
+    slot = ((clo ^ (chi * jnp.uint32(0x9E3779B9)))
             & jnp.uint32(acells - 1)).astype(jnp.int32)
     slot = jnp.where(cvalid, slot, acells)
     arena = jnp.full((acells,), fa, jnp.int32) \
         .at[slot].min(lane, mode="drop")
     win = jnp.minimum(arena[jnp.minimum(slot, acells - 1)], fa - 1)
-    fp2 = jnp.stack([exp.chi, exp.clo], axis=1)
+    fp2 = jnp.stack([chi, clo], axis=1)
     wfp = fp2[win]
     dup = cvalid & (win != lane) \
-        & (wfp[:, 0] == exp.chi) & (wfp[:, 1] == exp.clo)
+        & (wfp[:, 0] == chi) & (wfp[:, 1] == clo)
     return cvalid & ~dup
 
 
@@ -162,6 +177,31 @@ def candidate_matrix(exp: Expansion, n_actions: int, width: int,
     return cand, log_off
 
 
+def assemble_candidates(rows_k, ebits_k, s_chi, s_clo, pw_hi, pw_lo,
+                        o_hi, o_lo, width: int, symmetry: bool,
+                        sound: bool, nk_hi=None, nk_lo=None):
+    """ONE source of truth for the candidate-matrix column layout, built
+    from pre-gathered per-lane columns (the gather-early engines): the
+    same contract as :func:`candidate_matrix` —
+
+      [packed row (0..W-1) | child ebits (W) | state fp hi/lo (W+1,W+2)
+       | (node key hi/lo at W+3,W+4 under sound)
+       | parent key hi/lo | original fp hi/lo (symmetry/sound only)]
+
+    so the queue block is ``[:, :W+3]`` and the log block the contiguous
+    slice from the returned ``log_off``. Under ``sound`` pass the node
+    keys (``nk_hi``/``nk_lo``); they are spliced at W+3."""
+    cand_cols = [rows_k, ebits_k[:, None],
+                 s_chi[:, None], s_clo[:, None],
+                 pw_hi[:, None], pw_lo[:, None]]
+    if symmetry or sound:
+        cand_cols += [o_hi[:, None], o_lo[:, None]]
+    cand = jnp.concatenate(cand_cols, axis=1)
+    if sound:
+        cand = splice_node_keys(cand, width, nk_hi, nk_lo)
+    return cand, (width + 3 if sound else width + 1)
+
+
 def splice_node_keys(k_all, width: int, nk_hi, nk_lo):
     """Insert the node-key columns at W+3 (sound mode, post-compaction)
     — the splice :func:`candidate_matrix`'s ``log_off`` expects: after
@@ -187,20 +227,40 @@ def small_step_sizes(fmax: int, kmax: int, n_actions: int):
 
 
 def kmax_default(model, fmax: int, sound: bool) -> int:
-    """Candidate-buffer width policy shared by both engines: models that
-    declare ``branching_hint`` get a hint-sized buffer (halved outside
-    sound mode — the in-batch :func:`pre_dedup` drops duplicate lanes,
-    and measured post-dedup branching runs well under the raw hint, e.g.
-    paxos vmax ~1.9/state vs hint 4); hint-less models start at fa/8;
-    sound mode skips pre-dedup and keeps the raw sizing. Undersizing
-    costs one kovf abort-and-rebuild (compile-cached), oversizing makes
-    every downstream gather/probe wider forever."""
+    """RAW candidate-buffer (``kraw``) width policy shared by the device
+    engines: the buffer holds every RAW-valid child lane of an iteration
+    (the gather-early engines compact valid lanes into it BEFORE hashing
+    and in-batch dedup), so models that declare ``branching_hint`` (max
+    valid children per state) get ``fmax*hint`` with a 1/4 margin;
+    hint-less models start at fa/2 (2pc's raw branching measures ~30% of
+    fa — an fa/4 start cost it a kovf round, and each extra chunk round
+    is a ~100 ms tunneled stats pull). Undersizing costs one kovf
+    abort-and-rebuild (compile-cached) sized to the observed branching,
+    oversizing makes the hash/dedup stage wider forever."""
     fa = fmax * model.max_actions
     hint = getattr(model, "branching_hint", None)
     if hint:
-        scale = 5 * fmax * hint // (4 if sound else 8)
+        scale = 5 * fmax * hint // 4
         return min(fa, max(1 << 12, -(-scale // 256) * 256))
-    return min(fa, max(1 << 12, fa // 2 if sound else fa // 8))
+    return min(fa, max(1 << 12, fa // 2))
+
+
+def kfinal_default(model, fmax: int, sound: bool) -> int:
+    """Stage-two (post-dedup) candidate-buffer width: the table probe,
+    candidate gather, and appends run at this width. Post-dedup
+    branching runs well under the raw hint (paxos vmax ~1.9/state vs
+    hint 4; 2pc >80% duplicate lanes), so the halved-hint / fa-8th
+    sizing from the round-4 single-stage design applies here. Sound
+    mode has no in-batch dedup (node-key identity) — stage two
+    degenerates and the raw sizing rules."""
+    if sound:
+        return kmax_default(model, fmax, sound)
+    fa = fmax * model.max_actions
+    hint = getattr(model, "branching_hint", None)
+    if hint:
+        scale = 5 * fmax * hint // 8
+        return min(fa, max(1 << 12, -(-scale // 256) * 256))
+    return min(fa, max(1 << 12, fa // 8))
 
 
 def discovery_candidates(properties, exp: Expansion, fvalid,

@@ -112,10 +112,13 @@ class ShardedTpuChecker(TpuChecker):
         if prop_count == 0:
             return  # vacuously done (bfs.rs:121-128)
 
-        from ..ops.expand import kmax_default
+        # the sharded step is still single-stage (dedup at fa, then one
+        # compaction), so its candidate buffer wants the POST-dedup
+        # sizing — kfinal_default is the round-4 kmax_default policy
+        from ..ops.expand import kfinal_default
         fmax = int(opts.get("fmax", auto_fmax(model, shards=D)))
         fa = fmax * n_actions
-        kmax = min(int(opts.get("kmax", kmax_default(
+        kmax = min(int(opts.get("kmax", kfinal_default(
             model, fmax, self._sound))), fa)
         headroom = max(D * kmax, fmax)
         # per-shard slice must keep one worst-case iteration of headroom

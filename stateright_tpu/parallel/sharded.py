@@ -232,7 +232,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         if not sound:
             # EXACT in-batch duplicate-lane drop (ops/expand.py): local
             # duplicates never enter the ring
-            cvalid = pre_dedup(exp, cvalid, fmax_b * n_actions)
+            cvalid = pre_dedup(exp.chi, exp.clo, cvalid)
         vcount = cvalid.sum(dtype=jnp.int32)
         kovf = c.kovf | (lax.psum((vcount > kmax_b).astype(jnp.int32),
                                   axis) > 0)
