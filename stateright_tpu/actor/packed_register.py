@@ -105,6 +105,14 @@ class PackedRegisterModel(PackedActorModel):
         self.history_width = 1 + 3 * client_count
         self.max_sends = max_sends
         self.host_property_indices = (0,)  # linearizable
+        # packed fast path (TpuChecker._host_props_results): evaluate
+        # linearizability from the history columns alone — the full
+        # decode() rebuilt every actor/server and the network per
+        # representative, ~4x the cost of the history walk itself
+        self.host_property_fns = [
+            lambda row: self.decode_history(
+                [int(w) for w in row[self._hist_off:]]
+            ).serialized_history() is not None]
         if ordered:
             # declare the flows the register protocol actually uses —
             # client<->server and server<->server; client<->client FIFOs

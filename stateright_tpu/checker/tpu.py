@@ -99,6 +99,15 @@ def _compact(mask, *columns):
 from .device_loop import LruCache as _LruCache
 
 _LEVEL_CACHE = _LruCache()
+#: observed per-iteration maxima by engine config — (vmax raw, dmax
+#: post-dedup), keyed like the chunk-program cache. Later runs of the
+#: same model config start with candidate buffers sized to what the
+#: config actually branches (+17%), instead of the static defaults
+#: (2pc's fa/2 default is ~65% wider than its observed raw maximum —
+#: every dedup/compaction pass scales with that width). An unlucky
+#: shallow first run that under-observes costs at most one kovf
+#: abort-and-rebuild, the same protocol that covers undersized defaults.
+_SIZE_MEMO = _LruCache(limit=256)
 _INSERT_JIT = None
 
 
@@ -224,7 +233,7 @@ def auto_fmax(model, shards: int = 1) -> int:
     per iteration to amortize the fixed per-iteration cost."""
     target = (3 << 21) if model.packed_width >= 256 else (1 << 24)
     return max(max(256, (1 << 10) // shards), min(
-        1 << 13,
+        3 << 12,
         target // (model.max_actions * model.packed_width * shards)))
 
 
@@ -481,13 +490,32 @@ class TpuChecker(HostChecker):
         # ``branching_hint``; an iteration that spikes past either
         # triggers the cheap kovf resize
         from ..ops.expand import kfinal_default, kmax_default
-        kraw = min(int(opts.get("kraw",
-                                kmax_default(model, fmax, self._sound))),
-                   fa)
-        kmax = min(int(opts.get("kmax",
-                                kfinal_default(model, fmax,
-                                               self._sound))),
-                   kraw)
+        from .device_loop import model_cache_key
+
+        def _fit(observed):
+            # quantize to 1/8-power-of-two buckets: run-to-run drift in
+            # the observed maxima (batch boundaries move) must not move
+            # the compiled shapes, or every run would recompile
+            want = observed + observed // 6
+            step = max(256, 1 << (max(want.bit_length(), 9) - 3))
+            return -(-want // step) * step
+
+        size_key = model_cache_key(model)
+        if size_key is not None:
+            size_key = (size_key, fmax, self._sound, self._symmetry)
+        kraw = kmax_default(model, fmax, self._sound)
+        kmax = kfinal_default(model, fmax, self._sound)
+        if "kraw" not in opts and "kmax" not in opts:
+            # the memo only tightens the DEFAULTS: a user-tuned size is
+            # an explicit instruction and must not be clamped by what a
+            # (possibly shallow) earlier run happened to observe
+            seen = _SIZE_MEMO.get(size_key) \
+                if size_key is not None else None
+            if seen is not None:
+                kraw = min(kraw, max(1 << 12, _fit(seen[0])))
+                kmax = min(kmax, max(1 << 12, _fit(seen[1])))
+        kraw = min(int(opts.get("kraw", kraw)), fa)
+        kmax = min(int(opts.get("kmax", kmax)), kraw)
         # OPT-IN per-row stage-one compaction (device_loop.py): kraw
         # becomes the static fmax*hint; a row outgrowing it triggers the
         # same kovf rebuild protocol. Off by default: ``branching_hint``
@@ -649,6 +677,8 @@ class TpuChecker(HostChecker):
             self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
             self._prof["dmax"] = max(self._prof.get("dmax", 0), dmax)
             self._prof["rmax"] = max(self._prof.get("rmax", 0), rmax)
+            if size_key is not None:
+                _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += int(gen)
             self._unique_state_count = base_unique + int(log_n)
             disc_fps = _combine64(disc_hi, disc_lo)
@@ -879,9 +909,13 @@ class TpuChecker(HostChecker):
                                                            :q.shape[1] - 3]
                 # queue row i >= n_init is log entry i - n_init (queue
                 # and log append in lockstep); seed rows never appear in
-                # hidx (they are evaluated host-side at seed time)
+                # hidx (they are evaluated host-side at seed time).
+                # ONE output array: each transferred leaf pays its own
+                # ~100 ms tunnel round trip, so the witness-fp columns
+                # ride the row matrix
                 li = jnp.clip(sel - n_init, 0, log.shape[0] - 1)
-                return rows, log[li, 0], log[li, 1]
+                return jnp.concatenate(
+                    [rows, log[li, 0:1], log[li, 1:2]], axis=1)
 
             cls._HPULL_JIT = jax.jit(fn, static_argnums=(5,))
         return cls._HPULL_JIT
@@ -900,11 +934,12 @@ class TpuChecker(HostChecker):
             return
         count = h_n - start
         bucket = _bucket(count)
-        rows_d, whi_d, wlo_d = self._hpull_jit()(
+        out_d = self._hpull_jit()(
             carry.q, carry.hidx, carry.log,
             jnp.int32(start), jnp.int32(n_init), bucket)
-        rows_h, whi_h, wlo_h = jax.device_get((rows_d, whi_d, wlo_d))
-        wfp = _combine64(whi_h, wlo_h)
+        out_h = np.asarray(jax.device_get(out_d))
+        rows_h = out_h[:, :-2]
+        wfp = _combine64(out_h[:, -2], out_h[:, -1])
         for j in range(count):
             if all(p.name in discoveries for _i, p in self._host_props):
                 break
@@ -1261,9 +1296,18 @@ class TpuChecker(HostChecker):
         key = model.host_property_key(row)
         results = self._host_prop_cache.get(key)
         if results is None:
-            state = model.decode(row)
-            results = [bool(prop.condition(model, state))
-                       for _i, prop in self._host_props]
+            fns = getattr(model, "host_property_fns", None)
+            if fns is not None:
+                # packed fast path: the model evaluates each host
+                # property straight off the packed row (e.g. ABD's
+                # linearizability needs only the history columns) —
+                # the full decode() built the whole actor/network state
+                # per representative, ~4x the cost of the history walk
+                results = [bool(fn(row)) for fn in fns]
+            else:
+                state = model.decode(row)
+                results = [bool(prop.condition(model, state))
+                           for _i, prop in self._host_props]
             self._host_prop_cache[key] = results
         return results
 
