@@ -107,6 +107,18 @@ def _context() -> None:
              lambda: (TwoPhaseSys(7).checker()
                       .tpu_options(capacity=1 << 22, race=False)
                       .spawn_tpu().join()))
+    # the sharded (mesh) engine on the real chip: D=1 exercises the full
+    # shard_map + ring machinery; its gap to the plain-engine 2pc entry
+    # above IS the sharded-path overhead (round-4 brief item: <10%)
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shards",))
+    _sampled("tpu 2pc7 sharded D=1 full 296448",
+             lambda: (TwoPhaseSys(7).checker()
+                      .tpu_options(capacity=1 << 22, race=False,
+                                   mesh=mesh1)
+                      .spawn_tpu().join()))
     _sampled("tpu 2pc10 capped 1M-gen",
              lambda: (TwoPhaseSys(10).checker()
                       .tpu_options(capacity=1 << 22, race=False)

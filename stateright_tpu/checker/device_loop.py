@@ -162,29 +162,27 @@ class LruCache(dict):
                 return super().__getitem__(key)
             return default
 
+    def _set(self, key, value):
+        """Unlocked insert-with-eviction shared by the locked writers."""
+        if key not in self:
+            while len(self._order) >= self._limit:
+                super().__delitem__(self._order.pop(0))
+            self._order.append(key)
+        super().__setitem__(key, value)
+
     def __setitem__(self, key, value):
         with self._lock:
-            if key not in self:
-                while len(self._order) >= self._limit:
-                    super().__delitem__(self._order.pop(0))
-                self._order.append(key)
-            super().__setitem__(key, value)
+            self._set(key, value)
 
     def merge_max(self, key, values):
         """Atomic elementwise-max merge (the observed-size memo): a
         separate get-max-set would let a concurrent smaller observation
         overwrite a larger one."""
         with self._lock:
-            old = super().__getitem__(key) if key in self else None
-            if old is None:
-                if key not in self:
-                    while len(self._order) >= self._limit:
-                        super().__delitem__(self._order.pop(0))
-                    self._order.append(key)
-                super().__setitem__(key, tuple(values))
-            else:
-                super().__setitem__(
-                    key, tuple(max(a, b) for a, b in zip(old, values)))
+            if key in self:
+                old = super().__getitem__(key)
+                values = tuple(max(a, b) for a, b in zip(old, values))
+            self._set(key, tuple(values))
 
 
 _CHUNK_CACHE = LruCache()

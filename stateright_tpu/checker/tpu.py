@@ -111,6 +111,37 @@ _SIZE_MEMO = _LruCache(limit=256)
 _INSERT_JIT = None
 
 
+def _size_fit(observed: int) -> int:
+    """Quantize an observed maximum (+1/6 margin) to 1/8-power-of-two
+    buckets: run-to-run drift in the maxima (batch boundaries move) must
+    not move the compiled shapes, or every run would recompile."""
+    want = observed + observed // 6
+    step = max(256, 1 << (max(want.bit_length(), 9) - 3))
+    return -(-want // step) * step
+
+
+def candidate_sizes(model, fmax: int, sound: bool, opts: dict,
+                    size_key) -> "tuple":
+    """The kraw/kmax candidate-buffer sizing shared by the single-chip
+    and sharded engines: static defaults (ops/expand.py), tightened by
+    the observed-size memo — which only tightens the DEFAULTS (a
+    user-tuned kraw/kmax is an explicit instruction and must not be
+    clamped by what a possibly-shallow earlier run happened to
+    observe)."""
+    from ..ops.expand import kfinal_default, kmax_default
+    fa = fmax * model.max_actions
+    kraw = kmax_default(model, fmax, sound)
+    kmax = kfinal_default(model, fmax, sound)
+    if "kraw" not in opts and "kmax" not in opts and size_key is not None:
+        seen = _SIZE_MEMO.get(size_key)
+        if seen is not None:
+            kraw = min(kraw, max(1 << 12, _size_fit(seen[0])))
+            kmax = min(kmax, max(1 << 12, _size_fit(seen[1])))
+    kraw = min(int(opts.get("kraw", kraw)), fa)
+    kmax = min(int(opts.get("kmax", kmax)), kraw)
+    return kraw, kmax
+
+
 def _insert_jit():
     """Process-wide jitted ``table_insert`` (shapes retrace within one
     wrapper; a fresh ``jax.jit`` per run would recompile every time)."""
@@ -489,33 +520,13 @@ class TpuChecker(HostChecker):
         # their branching (max valid children per state) shrink both via
         # ``branching_hint``; an iteration that spikes past either
         # triggers the cheap kovf resize
-        from ..ops.expand import kfinal_default, kmax_default
         from .device_loop import model_cache_key
-
-        def _fit(observed):
-            # quantize to 1/8-power-of-two buckets: run-to-run drift in
-            # the observed maxima (batch boundaries move) must not move
-            # the compiled shapes, or every run would recompile
-            want = observed + observed // 6
-            step = max(256, 1 << (max(want.bit_length(), 9) - 3))
-            return -(-want // step) * step
 
         size_key = model_cache_key(model)
         if size_key is not None:
             size_key = (size_key, fmax, self._sound, self._symmetry)
-        kraw = kmax_default(model, fmax, self._sound)
-        kmax = kfinal_default(model, fmax, self._sound)
-        if "kraw" not in opts and "kmax" not in opts:
-            # the memo only tightens the DEFAULTS: a user-tuned size is
-            # an explicit instruction and must not be clamped by what a
-            # (possibly shallow) earlier run happened to observe
-            seen = _SIZE_MEMO.get(size_key) \
-                if size_key is not None else None
-            if seen is not None:
-                kraw = min(kraw, max(1 << 12, _fit(seen[0])))
-                kmax = min(kmax, max(1 << 12, _fit(seen[1])))
-        kraw = min(int(opts.get("kraw", kraw)), fa)
-        kmax = min(int(opts.get("kmax", kmax)), kraw)
+        kraw, kmax = candidate_sizes(model, fmax, self._sound, opts,
+                                     size_key)
         # OPT-IN per-row stage-one compaction (device_loop.py): kraw
         # becomes the static fmax*hint; a row outgrowing it triggers the
         # same kovf rebuild protocol. Off by default: ``branching_hint``

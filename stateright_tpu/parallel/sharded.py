@@ -33,12 +33,15 @@ jobs", `bfs.rs:94-98`). Everything the host reads per chunk rides ONE
 replicated uint32 stats vector (a device->host transfer costs ~100 ms of
 tunnel latency regardless of size — NOTES.md round 4).
 
-The ring costs D permutes of the kmax-lane candidate matrix. Compacting
-to ``kmax`` BEFORE the ring (round 4) cut the permuted bytes by the
-pre-dedup's duplicate factor times the invalid-lane factor (~8x on 2pc)
-— this, not a bucketed ``all_to_all``, was the data-volume fix; a
-bucketed exchange would still need the same compaction first and adds
-per-destination bookkeeping.
+Two exchanges implement the owner routing (``tpu_options(exchange=...)``):
+the **bucketed all_to_all** (default for D > 1; round 5) ranks each
+candidate within its destination, scatters into a ``(D, kb)`` send
+buffer, and pays ONE collective plus ONE insert/append round; the
+**ring** pays D-1 ``ppermute`` hops with an insert/append round per hop.
+Compacting to ``kmax`` BEFORE either exchange (round 4) is what bounds
+the exchanged bytes (~8x cut on 2pc); the bucketed exchange then removes
+the D-sequential-rounds cost on top — measured 1.5x (D=2, 2pc n=5) to
+3.3x (D=8) faster on the virtual mesh, exact reached-set parity.
 
 Queue-overflow safety is static: the loop condition requires every shard's
 queue to have ``D * kmax`` free slots — the worst case of one iteration
@@ -60,10 +63,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.expand import (candidate_matrix, discovery_candidates,
-                          eventually_indices, expand_frontier, pre_dedup,
-                          splice_node_keys)
-from ..ops.hash_kernel import fp64_node_device
+from ..ops.expand import (assemble_candidates, discovery_candidates,
+                          eventually_indices, expand_frontier, pre_dedup)
+from ..ops.hash_kernel import fp64_device, fp64_node_device
 from ..ops.hashtable import _BUCKET, table_insert
 
 
@@ -100,15 +102,36 @@ class ShardedCarry(NamedTuple):
     xovf: jax.Array     # bool[]   replicated: model capacity overflow
     kovf: jax.Array     # bool[]   replicated: kmax candidate overflow
     #                              (host rebuilds with doubled kmax)
-    vmax: jax.Array     # int32[]  replicated: max post-dedup children in
-    #                              one shard-iteration this chunk
+    vmax: jax.Array     # int32[]  replicated: max RAW-valid children in
+    #                              one shard-iteration this chunk (sizes
+    #                              the kraw hash/dedup buffer)
+    dmax: jax.Array     # int32[]  replicated: max post-dedup children in
+    #                              one shard-iteration this chunk (sizes
+    #                              the kmax ring/probe buffer)
+    bmax: jax.Array     # int32[]  replicated: max children bound for ONE
+    #                              destination shard in one iteration
+    #                              (sizes the bucketed exchange's kb;
+    #                              0 under the ring exchange)
     steps: jax.Array    # int32[]  replicated: remaining step budget
     go: jax.Array       # bool[]   replicated: loop condition
+    pavail: jax.Array   # int32[]  replicated: max pending rows on any
+    #                              shard — the two-size loop windows key
+    #                              on it so every shard takes the same
+    #                              sized step
 
 
 def _owner_bits(d: int) -> int:
     assert d & (d - 1) == 0, "mesh axis size must be a power of two"
     return d.bit_length() - 1
+
+
+def effective_kb(kmax: int, d: int, kb: int = 0) -> int:
+    """Per-destination bucket size for the bucketed exchange — ONE
+    formula shared by the device build and the host's kovf resize
+    (fingerprints are hash-uniform, so counts concentrate near
+    dcount/d; the default doubles that)."""
+    return min(kmax,
+               kb or max(1 << 10, -(-(2 * kmax) // d // 256) * 256))
 
 
 def carry_specs(axis: str) -> ShardedCarry:
@@ -117,7 +140,7 @@ def carry_specs(axis: str) -> ShardedCarry:
     return ShardedCarry(
         q=s, q_head=s, q_tail=s, key_hi=s, key_lo=s, log=s, log_n=s,
         disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
-        kovf=r, vmax=r, steps=r, go=r)
+        kovf=r, vmax=r, dmax=r, bmax=r, steps=r, go=r, pavail=r)
 
 
 from ..checker.device_loop import LruCache as _LruCache
@@ -127,7 +150,9 @@ _SHARDED_CACHE = _LruCache()
 
 def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                            capacity: int, fmax: int, kmax: int,
-                           symmetry: bool = False, sound: bool = False):
+                           symmetry: bool = False, sound: bool = False,
+                           kraw: int = 0, exchange: str = "ring",
+                           kb: int = 0):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
@@ -151,12 +176,13 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     key = None
     if mkey is not None:
         key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax, kmax,
-               symmetry, sound)
+               symmetry, sound, kraw, exchange, kb)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
-                                 fmax, kmax, symmetry, sound)
+                                 fmax, kmax, symmetry, sound, kraw,
+                                 exchange, kb)
     if key is not None:
         _SHARDED_CACHE[key] = fn
     return fn
@@ -165,7 +191,8 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                             capacity: int, fmax: int, kmax: int,
                             symmetry: bool = False,
-                            sound: bool = False):
+                            sound: bool = False, kraw: int = 0,
+                            exchange: str = "ring", kb: int = 0):
     from ..checker.device_loop import shrink_indices
 
     D = mesh.shape[axis]
@@ -183,26 +210,48 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     logcap = closc
     fa = fmax * n_actions
     kmax = min(kmax, fa)
+    # two-stage candidate compaction, exactly like the single-chip loop
+    # (checker/device_loop.py): raw-valid lanes compact to kraw (where
+    # hashing and in-batch dedup run), dedup survivors compact to the
+    # narrower kmax that the ring exchange, per-hop probes, and appends
+    # all scale with
+    kraw = min(kraw, fa) if kraw else kmax
+    kmax = min(kmax, kraw)
     # the queue slice must cover BOTH the worst-case routed appends
     # (every candidate machine-wide on one shard: D*kmax rows) and the
     # frontier dequeue (fmax rows — dynamic_slice would silently CLAMP
     # its start near the end of the queue otherwise)
     ring_headroom = max(D * kmax, fmax)
     ring = [(i, (i + 1) % D) for i in range(D)]
+    # bucketed all_to_all exchange (tpu_options(exchange="bucket")): one
+    # collective + ONE insert/append round instead of the ring's D-1
+    # permutes and D sequential rounds. kb bounds the children any one
+    # iteration routes to ONE destination; fingerprints are hash-uniform
+    # so counts concentrate near dcount/D — the default doubles that,
+    # and a skewed batch aborts pre-mutation via the kovf protocol (the
+    # observed bound rides the stats as bmax).
+    bucket = exchange == "bucket" and D > 1
+    if bucket:
+        kb = effective_kb(kmax, D, kb)
     # thin BFS levels (start/tail of every search) would pay the full
-    # fmax lane width; like the single-chip loop, the body carries TWO
-    # compiled expansion sizes and picks per iteration by the REPLICATED
-    # pending maximum (pmax), so every shard takes the same branch
+    # fmax lane width; like the single-chip loop, the chunk sequences a
+    # small-step loop and a large-step loop (an in-loop lax.cond copies
+    # every carried buffer per iteration — NOTES.md round 3/5), gated on
+    # the REPLICATED pending maximum so every shard takes the same loop
     from ..ops.expand import small_step_sizes
     fmax_small, kmax_small, two_size = small_step_sizes(
         fmax, kmax, n_actions)
+    fa_small = fmax_small * n_actions
+    kraw_small = min(fa_small, kraw)
 
-    def go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf, kovf,
-                steps, target_remaining, grow_limit):
-        total_q = lax.psum(q_tail - q_head, axis)
-        max_tail = lax.pmax(q_tail, axis)
-        max_log = lax.pmax(log_n, axis)
-        go = ((total_q > 0) & (steps > 0) & ~ovf & ~xovf & ~kovf
+    def go_from(pavail, max_tail, max_log, disc_hit, gen, ovf, xovf,
+                kovf, steps, target_remaining, grow_limit):
+        """Replicated loop condition from already-reduced maxima — NO
+        collectives here: the step folds every per-iteration reduction
+        into three fused collectives (measured ~13 separate psum/pmax
+        dispatches per iteration before, a ~1-2 ms/iteration floor even
+        at D=1)."""
+        go = ((pavail > 0) & (steps > 0) & ~ovf & ~xovf & ~kovf
               & (gen < target_remaining)
               & (max_log < grow_limit)
               & (max_tail <= qloc - ring_headroom))
@@ -210,10 +259,11 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             go = go & ~disc_hit[jnp.array(device_prop_idx)].all()
         return go
 
-    def make_step(fmax_b: int, kmax_b: int):
+    def make_step(fmax_b: int, kraw_b: int, kfin_b: int):
       def step(state):
         c, target_remaining, grow_limit = state
         me = lax.axis_index(axis).astype(jnp.uint32)
+        me_i = me.astype(jnp.int32)
         q_head, q_tail, log_n = c.q_head[0], c.q_tail[0], c.log_n[0]
 
         take = jnp.minimum(q_tail - q_head, fmax_b)
@@ -224,62 +274,105 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
         # shared check_block analog (ops/expand.py) on local rows; the
-        # frontier fingerprints come from the queue cache, not a re-hash
+        # frontier fingerprints come from the queue cache, not a re-hash,
+        # and child fingerprints are deferred to the narrow buffer below
         exp = expand_frontier(model, frontier, fvalid, ebits,
-                              eventually_idx, symmetry=symmetry, pfp=pfp)
+                              eventually_idx, symmetry=symmetry, pfp=pfp,
+                              child_fp=False)
         cvalid = exp.cvalid
         gen_count = cvalid.sum(dtype=jnp.int32)
-        if not sound:
-            # EXACT in-batch duplicate-lane drop (ops/expand.py): local
-            # duplicates never enter the ring
-            cvalid = pre_dedup(exp.chi, exp.clo, cvalid)
-        vcount = cvalid.sum(dtype=jnp.int32)
-        kovf = c.kovf | (lax.psum((vcount > kmax_b).astype(jnp.int32),
-                                  axis) > 0)
+        vcount = gen_count
 
         if sound:
             p_whi, p_wlo = fp64_node_device(exp.phi, exp.plo, ebits)
         else:
             p_whi, p_wlo = exp.phi, exp.plo
 
-        # sticky discovery registers: pick the lowest-indexed shard with a
-        # local candidate, broadcast its fingerprint via psum (idempotent:
-        # safe under kovf re-expansion)
+        # local discovery candidates; the cross-shard selection rides
+        # the fused collectives below (idempotent: safe under kovf
+        # re-expansion)
         disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
         if prop_count:
             hit_l, cand_hi, cand_lo = discovery_candidates(
                 properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
-            sel = jnp.where(hit_l, me, jnp.uint32(D))
-            min_shard = lax.pmin(sel, axis)
-            pick = hit_l & (me == min_shard)
-            g_hi = lax.psum(jnp.where(pick, cand_hi, jnp.uint32(0)), axis)
-            g_lo = lax.psum(jnp.where(pick, cand_lo, jnp.uint32(0)), axis)
-            g_hit = min_shard < D
-            keep = disc_hit | ~g_hit
-            disc_hi = jnp.where(keep, disc_hi, g_hi)
-            disc_lo = jnp.where(keep, disc_lo, g_lo)
-            disc_hit = disc_hit | g_hit
+            # pmax of (D-1 - shard) selects the LOWEST-indexed shard
+            # with a hit; -1 encodes "no hit anywhere"
+            negsel = jnp.where(hit_l, jnp.int32(D - 1) - me_i,
+                               jnp.int32(-1))
+        else:
+            negsel = jnp.zeros((0,), jnp.int32)
 
-        # compact the candidates to kmax lanes BEFORE the ring: the D-hop
-        # exchange and every per-hop insert/append then run at kmax, not
-        # fa. Same candidate layout as the single-chip loop
-        # (ops/expand.py): queue block = [:, :W+3], log block = one
-        # contiguous slice starting at log_off.
-        src = shrink_indices(cvalid, kmax_b)
-        kvalid = (jnp.arange(kmax_b, dtype=jnp.int32) < vcount) & ~kovf
-        cand, log_off = candidate_matrix(
-            exp, n_actions, width, p_whi, p_wlo, symmetry, sound)
-        k_all = cand[src]
+        # stage one: compact raw-valid lanes to the kraw buffer; hash
+        # (and canonicalize, under symmetry) and in-batch dedup there —
+        # local duplicates never enter the ring
+        src = shrink_indices(cvalid, kraw_b)
+        rvalid = jnp.arange(kraw_b, dtype=jnp.int32) < vcount
+        rows_k = exp.flat[src]
+        ridx = src // n_actions
+        if symmetry:
+            canon = jax.vmap(model.packed_representative)
+            s_chi, s_clo = fp64_device(canon(rows_k))
+            o_hi, o_lo = fp64_device(rows_k)
+        else:
+            s_chi, s_clo = fp64_device(rows_k)
+            o_hi, o_lo = s_chi, s_clo
+        par3 = jnp.stack([exp.ebits, p_whi, p_wlo], axis=1)[ridx]
+        ebits_k = par3[:, 0]
         if sound:
-            nk_hi, nk_lo = fp64_node_device(
-                k_all[:, width + 1], k_all[:, width + 2],
-                k_all[:, width])
-            k_all = splice_node_keys(k_all, width, nk_hi, nk_lo)
+            # dedup/routing identity under sound = node keys
+            k_chi, k_clo = fp64_node_device(s_chi, s_clo, ebits_k)
+            dvalid = rvalid
+        else:
+            dvalid = pre_dedup(s_chi, s_clo, rvalid)
+            k_chi, k_clo = s_chi, s_clo
+        dcount = dvalid.sum(dtype=jnp.int32)
+        if bucket:
+            # exact per-destination counts (the dedup key's top bits
+            # pick the owner), pre-abort: a skewed batch must not
+            # overflow a send bucket mid-mutation
+            own_raw = (k_chi >> jnp.uint32(32 - kbits)).astype(jnp.int32)
+            oh_raw = (own_raw[:, None]
+                      == jnp.arange(D, dtype=jnp.int32)[None, :]) \
+                & dvalid[:, None]
+            bmax_it = oh_raw.sum(axis=0, dtype=jnp.int32).max()
+        else:
+            bmax_it = jnp.int32(0)
+
+        # --- fused collective 1 of 3 (pre-ring): every reduction the
+        # abort gating needs, in ONE pmax
+        pm1 = lax.pmax(jnp.concatenate([
+            jnp.stack([vcount, dcount, exp.xovf.astype(jnp.int32),
+                       bmax_it]),
+            negsel]), axis)
+        vshard, dshard, bshard = pm1[0], pm1[1], pm1[3]
+        xovf_any = pm1[2] > 0
+        kovf = c.kovf | (vshard > kraw_b) | (dshard > kfin_b)
+        if bucket:
+            kovf = kovf | (bshard > kb)
+        if prop_count:
+            min_shard = jnp.int32(D - 1) - pm1[4:4 + prop_count]
+            g_hit = pm1[4:4 + prop_count] >= 0
+            pick = hit_l & (me_i == min_shard)
+
+        cand, log_off = assemble_candidates(
+            rows_k, ebits_k, s_chi, s_clo, par3[:, 1], par3[:, 2],
+            o_hi, o_lo, width, symmetry, sound,
+            nk_hi=k_chi if sound else None,
+            nk_lo=k_clo if sound else None)
+        if kfin_b < kraw_b:
+            # stage two: dedup survivors to the ring-width buffer
+            src2 = shrink_indices(dvalid, kfin_b)
+            k_all = cand[src2]
+            kvalid = (jnp.arange(kfin_b, dtype=jnp.int32) < dcount) \
+                & ~kovf
+        else:
+            k_all = cand
+            kvalid = dvalid & ~kovf
 
         if kbits:
             owner = k_all[:, log_off] >> jnp.uint32(32 - kbits)
         else:
-            owner = jnp.zeros((kmax_b,), jnp.uint32)
+            owner = jnp.zeros((kfin_b,), jnp.uint32)
 
         take = jnp.where(kovf, 0, take)
         q_head = q_head + take
@@ -287,19 +380,35 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         q, log = c.q, c.log
         t_ovf = jnp.bool_(False)
 
-        # ownership routing: D hops around the ring; each shard claims and
-        # dedups the in-flight children it owns, then forwards the buffer
-        rc = (k_all, kvalid, owner)
-        for hop in range(D):
-            k_c, val_c, own_c = rc
-            mine = val_c & (own_c == me)
-            inserted, key_hi, key_lo, o = table_insert(
-                key_hi, key_lo, k_c[:, log_off], k_c[:, log_off + 1],
+        if bucket:
+            # bucketed exchange: rank each lane within its destination
+            # (exclusive one-hot cumsum — pure elementwise), ONE scatter
+            # into the (D, kb) send buffer (a trailing validity column
+            # rides along so no separate count exchange is needed), ONE
+            # all_to_all, then ONE insert/append round over the D*kb
+            # received lanes.
+            own_f = owner.astype(jnp.int32)
+            oh = ((own_f[:, None]
+                   == jnp.arange(D, dtype=jnp.int32)[None, :])
+                  & kvalid[:, None]).astype(jnp.int32)
+            rank = jnp.take_along_axis(
+                jnp.cumsum(oh, axis=0), own_f[:, None], axis=1)[:, 0] - 1
+            dst = jnp.where(kvalid, own_f * kb + rank, D * kb)
+            sendbuf = jnp.zeros((D * kb, k_all.shape[1] + 1),
+                                jnp.uint32)
+            payload = jnp.concatenate(
+                [k_all, jnp.ones((kfin_b, 1), jnp.uint32)], axis=1)
+            sendbuf = sendbuf.at[dst].set(payload, mode="drop")
+            recv = lax.all_to_all(
+                sendbuf.reshape(D, kb, -1), axis, split_axis=0,
+                concat_axis=0, tiled=True).reshape(D * kb, -1)
+            mine = recv[:, -1] == 1
+            inserted, key_hi, key_lo, t_ovf = table_insert(
+                key_hi, key_lo, recv[:, log_off], recv[:, log_off + 1],
                 mine)
-            t_ovf = t_ovf | o
             cnt = inserted.sum(dtype=jnp.int32)
-            src2 = shrink_indices(inserted, kmax_b)
-            n_all = k_c[src2]
+            src3 = shrink_indices(inserted, D * kb)
+            n_all = recv[src3]
             q = lax.dynamic_update_slice(
                 q, n_all[:, :width + 3], (q_tail, 0))
             log = lax.dynamic_update_slice(
@@ -307,54 +416,109 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 (log_n, 0))
             q_tail = q_tail + cnt
             log_n = log_n + cnt
-            if D > 1 and hop < D - 1:
-                rc = tuple(lax.ppermute(x, axis, ring) for x in rc)
+        else:
+            # ownership routing: D hops around the ring; each shard
+            # claims and dedups the in-flight children it owns, then
+            # forwards the buffer
+            rc = (k_all, kvalid, owner)
+            for hop in range(D):
+                k_c, val_c, own_c = rc
+                mine = val_c & (own_c == me)
+                inserted, key_hi, key_lo, o = table_insert(
+                    key_hi, key_lo, k_c[:, log_off],
+                    k_c[:, log_off + 1], mine)
+                t_ovf = t_ovf | o
+                cnt = inserted.sum(dtype=jnp.int32)
+                src3 = shrink_indices(inserted, kfin_b)
+                n_all = k_c[src3]
+                q = lax.dynamic_update_slice(
+                    q, n_all[:, :width + 3], (q_tail, 0))
+                log = lax.dynamic_update_slice(
+                    log, n_all[:, log_off:log_off + c.log.shape[1]],
+                    (log_n, 0))
+                q_tail = q_tail + cnt
+                log_n = log_n + cnt
+                if D > 1 and hop < D - 1:
+                    rc = tuple(lax.ppermute(x, axis, ring) for x in rc)
 
-        gen = c.gen + jnp.where(
-            kovf, 0, lax.psum(gen_count, axis))
-        ovf = c.ovf | ((lax.psum(t_ovf.astype(jnp.int32), axis) > 0)
-                       & ~kovf)
-        xovf = c.xovf | (lax.psum(exp.xovf.astype(jnp.int32), axis) > 0)
-        vmax = jnp.maximum(c.vmax, lax.pmax(vcount, axis))
+        # --- fused collectives 2 and 3 of 3 (post-ring): the loop
+        # condition's maxima in ONE pmax, the sums (generated count and
+        # the picked discovery fingerprints) in ONE psum
+        pm2 = lax.pmax(jnp.stack([q_tail - q_head, q_tail, log_n,
+                                  t_ovf.astype(jnp.int32)]), axis)
+        pavail, max_tail, max_log = pm2[0], pm2[1], pm2[2]
+        ovf = c.ovf | ((pm2[3] > 0) & ~kovf)
+        xovf = c.xovf | xovf_any
+        if prop_count:
+            ps = lax.psum(jnp.concatenate([
+                jnp.stack([gen_count.astype(jnp.uint32)]),
+                jnp.where(pick, cand_hi, jnp.uint32(0)),
+                jnp.where(pick, cand_lo, jnp.uint32(0))]), axis)
+            gen_sum = ps[0].astype(jnp.int32)
+            g_hi = ps[1:1 + prop_count]
+            g_lo = ps[1 + prop_count:1 + 2 * prop_count]
+            keep = disc_hit | ~g_hit
+            disc_hi = jnp.where(keep, disc_hi, g_hi)
+            disc_lo = jnp.where(keep, disc_lo, g_lo)
+            disc_hit = disc_hit | g_hit
+        else:
+            gen_sum = lax.psum(gen_count, axis)
+        gen = c.gen + jnp.where(kovf, 0, gen_sum)
+        vmax = jnp.maximum(c.vmax, vshard)
+        dmax = jnp.maximum(c.dmax, dshard)
+        bmax_c = jnp.maximum(c.bmax, bshard)
         steps = c.steps - 1
-        go = go_flag(q_head, q_tail, log_n, disc_hit, gen, ovf, xovf,
-                     kovf, steps, target_remaining, grow_limit)
+        go = go_from(pavail, max_tail, max_log, disc_hit, gen, ovf,
+                     xovf, kovf, steps, target_remaining, grow_limit)
         nc = ShardedCarry(
             q=q, q_head=q_head[None], q_tail=q_tail[None],
             key_hi=key_hi, key_lo=key_lo,
             log=log, log_n=log_n[None],
             disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
             gen=gen, ovf=ovf, xovf=xovf, kovf=kovf, vmax=vmax,
-            steps=steps, go=go)
+            dmax=dmax, bmax=bmax_c, steps=steps, go=go, pavail=pavail)
         return (nc, target_remaining, grow_limit)
       return step
 
-    step_large = make_step(fmax, kmax)
+    step_large = make_step(fmax, kraw, kmax)
     if two_size:
-        step_small = make_step(fmax_small, kmax_small)
-
-        def body(state):
-            c, _tr, _gl = state
-            # REPLICATED branch predicate: every shard takes the same
-            # path, so the collectives inside both branches line up
-            avail = lax.pmax(c.q_tail[0] - c.q_head[0], axis)
-            return lax.cond(avail > fmax_small, step_large, step_small,
-                            state)
-    else:
-        body = step_large
+        step_small = make_step(fmax_small, kraw_small,
+                               min(kmax_small, kraw_small))
 
     def local_chunk(carry, target_remaining, grow_limit):
-        go = go_flag(carry.q_head[0], carry.q_tail[0], carry.log_n[0],
-                     carry.disc_hit, carry.gen, carry.ovf, carry.xovf,
-                     carry.kovf, carry.steps, target_remaining,
-                     grow_limit)
-        out, _, _ = lax.while_loop(
-            lambda s: s[0].go, body,
-            (carry._replace(go=go), target_remaining, grow_limit))
+        pm = lax.pmax(jnp.stack([carry.q_tail[0] - carry.q_head[0],
+                                 carry.q_tail[0], carry.log_n[0]]), axis)
+        go = go_from(pm[0], pm[1], pm[2], carry.disc_hit, carry.gen,
+                     carry.ovf, carry.xovf, carry.kovf, carry.steps,
+                     target_remaining, grow_limit)
+        state = (carry._replace(go=go, pavail=pm[0]), target_remaining,
+                 grow_limit)
+        # sequenced small/large while_loops gated on the REPLICATED
+        # pending maximum (carried in pavail, so the loop conditions
+        # stay collective-free), wrapped in an outer loop so a frontier
+        # oscillating around the knee still spends the whole steps
+        # budget in one launch — same structure as the single-chip
+        # chunk, for the same reason (an in-loop lax.cond copies every
+        # carried buffer per iteration)
+        if two_size:
+            def cond_small(s):
+                return s[0].go & (s[0].pavail <= fmax_small)
+
+            def cond_large(s):
+                return s[0].go & (s[0].pavail > fmax_small)
+
+            def outer_body(s):
+                s = lax.while_loop(cond_small, step_small, s)
+                return lax.while_loop(cond_large, step_large, s)
+
+            state = lax.while_loop(lambda s: s[0].go, outer_body, state)
+        else:
+            state = lax.while_loop(lambda s: s[0].go, step_large, state)
+        out = state[0]
         # ONE replicated sync vector for everything the host reads per
         # chunk (layout parsed by parallel/engine.py — keep in sync):
         # [q_head[D], q_tail[D], log_n[D],
-        #  gen, ovf, xovf, kovf, vmax,
+        #  gen, ovf, xovf, kovf, vmax, dmax, bmax,
         #  disc_hit[P], disc_hi[P], disc_lo[P]]
         hs = lax.all_gather(out.q_head, axis, tiled=True)
         ts = lax.all_gather(out.q_tail, axis, tiled=True)
@@ -366,7 +530,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                        out.ovf.astype(jnp.int32),
                        out.xovf.astype(jnp.int32),
                        out.kovf.astype(jnp.int32),
-                       out.vmax]).astype(jnp.uint32),
+                       out.vmax, out.dmax,
+                       out.bmax]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo])
         return out, stats
@@ -491,7 +656,7 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                        capacity: int, init_rows, init_fps, full_ebits,
                        prop_count: int, symmetry: bool = False,
                        sound: bool = False,
-                       cache_fps=None) -> ShardedCarry:
+                       cache_fps=None, table_plan=None) -> ShardedCarry:
     """Construct the initial sharded carry ON DEVICE: the host routes
     only the init rows (tiny) to their owner shards' blocks; every big
     buffer is zeroed by a shard_map'd device program. device_put-ing
@@ -533,34 +698,68 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
             init_block[s * pad:s * pad + len(block)] = np.stack(block)
         q_tail[s] = len(block)
 
+    # per-shard host placement plans scattered inside the seed program
+    # (small seeds): no bulk-insert dispatch, no blocking overflow pull
+    if table_plan is not None:
+        plans, keys_by_shard = table_plan
+        kt = 1 << max((max((len(b) for b in keys_by_shard), default=1)
+                       - 1).bit_length(), 0)
+        t_idx = np.full((D * kt,), capacity // D, np.int64)
+        t_hi = np.zeros((D * kt,), np.uint32)
+        t_lo = np.zeros((D * kt,), np.uint32)
+        for s, (plan, keys) in enumerate(zip(plans, keys_by_shard)):
+            arr = np.asarray(keys, np.uint64)
+            t_idx[s * kt:s * kt + len(plan)] = np.where(
+                plan >= 0, plan, capacity // D)
+            t_hi[s * kt:s * kt + len(keys)] = \
+                (arr >> np.uint64(32)).astype(np.uint32)
+            t_lo[s * kt:s * kt + len(keys)] = arr.astype(np.uint32)
+        t_idx = t_idx.astype(np.int32)
+    else:
+        kt = 0
+        t_idx = np.zeros((D,), np.int32)
+        t_hi = t_lo = np.zeros((D,), np.uint32)
+
     key = ("seed", mesh, axis, qcap, capacity, width, log_w, pad,
-           prop_count)
+           prop_count, kt)
     fn = _SHARDED_CACHE.get(key)
     if fn is None:
-        def local(blk, tail):
+        def local(blk, tail, t_idx, t_hi, t_lo):
             q = jnp.zeros((qloc, width + 3), jnp.uint32)
             q = lax.dynamic_update_slice(q, blk, (0, 0))
             z = jnp.int32(0)
             f = jnp.bool_(False)
+            key_hi = jnp.zeros(
+                (capacity // D // _BUCKET, _BUCKET), jnp.uint32)
+            key_lo = jnp.zeros(
+                (capacity // D // _BUCKET, _BUCKET), jnp.uint32)
+            if kt:
+                key_hi = key_hi.at[t_idx // _BUCKET,
+                                   t_idx % _BUCKET].set(t_hi,
+                                                        mode="drop")
+                key_lo = key_lo.at[t_idx // _BUCKET,
+                                   t_idx % _BUCKET].set(t_lo,
+                                                        mode="drop")
             return ShardedCarry(
                 q=q,
                 q_head=jnp.zeros((1,), jnp.int32),
                 q_tail=tail,
-                key_hi=jnp.zeros(
-                    (capacity // D // _BUCKET, _BUCKET), jnp.uint32),
-                key_lo=jnp.zeros(
-                    (capacity // D // _BUCKET, _BUCKET), jnp.uint32),
+                key_hi=key_hi,
+                key_lo=key_lo,
                 log=jnp.zeros((capacity // D, log_w), jnp.uint32),
                 log_n=jnp.zeros((1,), jnp.int32),
                 disc_hit=jnp.zeros((prop_count,), bool),
                 disc_hi=jnp.zeros((prop_count,), jnp.uint32),
                 disc_lo=jnp.zeros((prop_count,), jnp.uint32),
-                gen=z, ovf=f, xovf=f, kovf=f, vmax=z, steps=z, go=f)
+                gen=z, ovf=f, xovf=f, kovf=f, vmax=z, dmax=z, bmax=z,
+                steps=z, go=f, pavail=z)
 
         s = P(axis)
         fn = jax.jit(jax.shard_map(
-            local, mesh=mesh, in_specs=(s, s),
+            local, mesh=mesh, in_specs=(s, s, s, s, s),
             out_specs=carry_specs(axis), check_vma=False))
         _SHARDED_CACHE[key] = fn
     sh = NamedSharding(mesh, P(axis))
-    return fn(jax.device_put(init_block, sh), jax.device_put(q_tail, sh))
+    return fn(jax.device_put(init_block, sh), jax.device_put(q_tail, sh),
+              jax.device_put(t_idx, sh), jax.device_put(t_hi, sh),
+              jax.device_put(t_lo, sh))
