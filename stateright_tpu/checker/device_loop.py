@@ -123,6 +123,13 @@ class ChunkCarry(NamedTuple):
     #                              exits; the host grows hcap, re-seeds the
     #                              table from hidx, and resumes (no loss —
     #                              the iteration aborts before mutation)
+    # --- sound-mode cross-edge log (1-row dummy otherwise): dedup HITS
+    # whose child node still has pending eventually-bits, as (parent
+    # node key, child node key) rows. Insert edges live in the main log;
+    # together they are the full node graph the post-exhaustion lasso
+    # sweep (checker/lasso.py) needs for cycle-complete liveness.
+    elog: jax.Array     # uint32[ecap | 1, 4]
+    e_n: jax.Array      # int32[]  edges logged so far
 
 
 def shrink_indices(mask, k: int):
@@ -202,7 +209,7 @@ def model_cache_key(model):
 def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                    symmetry: bool = False, sound: bool = False,
                    hcap: int = 0, n_init: int = 0, kraw: int = 0,
-                   hint_eff: int = 0):
+                   hint_eff: int = 0, ecap: int = 0):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit,
@@ -233,13 +240,13 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     """
     mkey = model_cache_key(model)
     key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound, hcap,
-           n_init, kraw, hint_eff)
+           n_init, kraw, hint_eff, ecap)
     if mkey is not None:
         cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
-                         sound, hcap, n_init, kraw, hint_eff)
+                         sound, hcap, n_init, kraw, hint_eff, ecap)
     if mkey is not None:
         _CHUNK_CACHE[key] = fn
     return fn
@@ -247,7 +254,8 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 
 def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                     symmetry: bool, sound: bool = False, hcap: int = 0,
-                    n_init: int = 0, kraw: int = 0, hint_eff: int = 0):
+                    n_init: int = 0, kraw: int = 0, hint_eff: int = 0,
+                    ecap: int = 0):
     n_actions = model.max_actions
     width = model.packed_width
     properties = model.properties()
@@ -316,6 +324,10 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 & (c.gen < target_remaining) \
                 & (c.log_n < grow_limit) \
                 & (c.q_tail <= qcap - qmargin)
+            if ecap:
+                # the cross-edge log must keep one iteration of headroom;
+                # the host grows it on exit
+                go = go & (c.e_n <= ecap - qmargin)
             if device_prop_idx and not host_idx:
                 # stop once every device-evaluated property has a
                 # discovery — but only when no host properties remain:
@@ -492,6 +504,23 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             n_all = cand[src3]
             n_flat = n_all[:, :width]
 
+            if sound and ecap:
+                # cross edges: dedup HITS whose child node still has
+                # pending bits — with the main log's insert edges this
+                # completes the node graph for the lasso sweep
+                ehit = kvalid & ~inserted & (cand[:, width] != 0)
+                ecnt = ehit.sum(dtype=jnp.int32)
+                esrc = shrink_indices(ehit, kfin_b)
+                erows = jnp.concatenate(
+                    [cand[:, width + 5:width + 7],   # parent node key
+                     cand[:, width + 3:width + 5]],  # child node key
+                    axis=1)[esrc]
+                elog = jax.lax.dynamic_update_slice(
+                    c.elog, erows, (c.e_n, 0))
+                e_n = c.e_n + ecnt
+            else:
+                elog, e_n = c.elog, c.e_n
+
             if hist_on:
                 # dedup the fresh rows by host-property key against the
                 # persistent history table; the queue index of each NEW
@@ -542,6 +571,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 log=log,
                 log_n=c.log_n + cnt,
                 hkey_hi=hkey_hi, hkey_lo=hkey_lo, hidx=hidx, h_n=h_n,
+                elog=elog, e_n=e_n,
                 gen=c.gen + vgen,
                 ovf=c.ovf | t_ovf,
                 disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
@@ -611,7 +641,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # of a dozen scalars dominated the whole chunk sync. Layout
         # (tpu.py unpacks positionally — keep in sync):
         # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-        #  vmax, dmax, rmax, disc_hit[P], disc_hi[P], disc_lo[P],
+        #  vmax, dmax, rmax, e_n, disc_hit[P], disc_hi[P], disc_lo[P],
         #  recent queue row (W+3), hist window (hist_on only)]
         # the most recently enqueued state's queue row rides the sync
         # for free (the Explorer decodes it as live progress — the
@@ -624,7 +654,8 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                        out.kovf.astype(jnp.int32),
                        out.h_n,
                        out.hovf.astype(jnp.int32),
-                       out.vmax, out.dmax, out.rmax]).astype(jnp.uint32),
+                       out.vmax, out.dmax, out.rmax,
+                       out.e_n]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo, recent])
         if not hist_on:
@@ -658,7 +689,7 @@ _SEED_CACHE = LruCache()
 
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                steps: int = 0, symmetry: bool = False, hcap: int = 0,
-               init_fps=None, table_plan=None):
+               init_fps=None, table_plan=None, ecap: int = 0):
     """Host-side construction of the initial carry (init states enqueued;
     the caller bulk-inserts their fingerprints into the table).
     ``full_ebits`` is a scalar for fresh runs or a per-row array when
@@ -678,7 +709,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     k = len(init_rows)
     kt = 0 if table_plan is None else 1 << max(
         (len(table_plan[1]) - 1).bit_length(), 0)
-    key = (qcap, capacity, width, prop_count, symmetry, k, hcap, kt)
+    key = (qcap, capacity, width, prop_count, symmetry, k, hcap, kt,
+           ecap)
     fn = _SEED_CACHE.get(key)
     if fn is None:
         logcap = capacity
@@ -714,6 +746,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 hkey_lo=jnp.zeros((hcap if hcap else 1,), jnp.uint32),
                 hidx=jnp.zeros((logcap if hcap else 1,), jnp.int32),
                 h_n=jnp.int32(0), hovf=jnp.bool_(False),
+                elog=jnp.zeros((ecap if ecap else 1, 4), jnp.uint32),
+                e_n=jnp.int32(0),
                 vmax=jnp.int32(0), dmax=jnp.int32(0),
                 rmax=jnp.int32(0))
 

@@ -189,108 +189,14 @@ class DfsChecker(HostChecker):
 
     # ------------------------------------------------------------------
     def _lasso_sweep(self, discoveries: Dict[str, List[int]]) -> None:
-        """SCC pass over the explored (state, pending-ebits) node graph.
+        """SCC pass over the explored (state, pending-ebits) node graph
+        (the shared `checker/lasso.py` sweep, also run by the device
+        engines at exhaustion); the on-path back-edge check alone
+        reports only when the cycle closes through the CURRENT path."""
+        from .lasso import lasso_sweep
 
-        Around any cycle of the node graph the pending mask is invariant
-        (bits only ever clear along a path and the cycle returns to the
-        same node), so a cyclic SCC whose mask still holds bit ``i`` is
-        an infinite run on which property ``i`` never holds — a liveness
-        counterexample the reference cannot see at all (`bfs.rs:239-256`)
-        and the on-path back-edge check alone reports only when the
-        cycle closes through the CURRENT path. Runs at exhaustion only
-        (an early exit leaves the graph partial); witnesses replay as
-        stem (init -> cycle entry, via the parent map) + one full lap.
-        """
-        from ..core import Expectation
-
-        properties = self._properties
-        want = [i for i, p in enumerate(properties)
-                if p.expectation == Expectation.EVENTUALLY
-                and p.name not in discoveries]
-        if not want:
-            return
-        edges = self._node_edges
-        masks = self._node_mask
-
-        # iterative Tarjan
-        index: Dict[int, int] = {}
-        low: Dict[int, int] = {}
-        on_stack: set = set()
-        stack: List[int] = []
-        counter = 0
-        for root in list(masks.keys()):
-            if root in index:
-                continue
-            work = [(root, 0)]
-            while work:
-                node, pi = work[-1]
-                if pi == 0:
-                    index[node] = low[node] = counter
-                    counter += 1
-                    stack.append(node)
-                    on_stack.add(node)
-                nbrs = edges.get(node, ())
-                advanced = False
-                for j in range(pi, len(nbrs)):
-                    w = nbrs[j]
-                    if w not in index:
-                        work[-1] = (node, j + 1)
-                        work.append((w, 0))
-                        advanced = True
-                        break
-                    if w in on_stack:
-                        low[node] = min(low[node], index[w])
-                if advanced:
-                    continue
-                work.pop()
-                if low[node] == index[node]:
-                    comp = []
-                    while True:
-                        w = stack.pop()
-                        on_stack.discard(w)
-                        comp.append(w)
-                        if w == node:
-                            break
-                    cyclic = len(comp) > 1 or node in edges.get(node, ())
-                    if cyclic:
-                        mask = masks[comp[0]]
-                        hit = [i for i in want
-                               if (mask >> i) & 1
-                               and properties[i].name not in discoveries]
-                        if hit:
-                            witness = self._lasso_witness(comp)
-                            for i in hit:
-                                discoveries[properties[i].name] = witness
-                if work:
-                    pnode = work[-1][0]
-                    low[pnode] = min(low[pnode], low[node])
-
-    def _lasso_witness(self, comp: List[int]) -> List[int]:
-        """Concrete fingerprint path: init -> SCC entry, then one lap of
-        a cycle through the entry (nodes translate to state fingerprints
-        via ``_node_fp``; every recorded edge is a real transition)."""
-        entry = comp[0]
-        chain: List[int] = []
-        k = entry
-        while k is not None:
-            pk, fp = self._node_parent[k]
-            chain.append(fp)
-            k = pk
-        chain.reverse()
-        compset = set(comp)
-        node_fp = self._node_fp
-        frontier = [(entry, [])]
-        visited = set()
-        while frontier:
-            node, path = frontier.pop()
-            for w in self._node_edges.get(node, ()):
-                if w == entry:
-                    return (chain + [node_fp[x] for x in path]
-                            + [node_fp[entry]])
-                if w in compset and w not in visited:
-                    visited.add(w)
-                    frontier.append((w, path + [w]))
-        return chain  # unreachable: a cyclic SCC always closes a lap
+        lasso_sweep(self._properties, discoveries, self._node_edges,
+                    self._node_mask, self._node_parent, self._node_fp)
 
     def discoveries(self) -> Dict[str, Path]:
         return {

@@ -75,8 +75,15 @@ class HostChecker(Checker):
         return Path.from_fingerprints(self._model, fingerprints)
 
     def discoveries(self):
+        from .path import Path
+
+        # a list-valued discovery is an explicit fingerprint path (lasso
+        # witnesses: stem + one cycle lap — NOT a parent-chain walk);
+        # scalars reconstruct by walking the mirror as usual
         return {
-            name: self._reconstruct_path(fp)
+            name: (Path.from_fingerprints(self._model, fp)
+                   if isinstance(fp, (list, tuple))
+                   else self._reconstruct_path(fp))
             for name, fp in list(self._discovery_fps.items())
         }
 

@@ -586,6 +586,9 @@ class TpuChecker(HostChecker):
         # growth point (n_init + grow_limit) plus one iteration of appends
         qcap = self._device_qcap(n_init, headroom)
         hcap = self._posthoc_cap if self._host_props else 0
+        # sound mode logs cross edges (dedup hits with pending bits) for
+        # the post-exhaustion lasso sweep; grows independently on demand
+        ecap = self._capacity if self._sound else 0
         with self._timed("seed"):
             # the block before the first chunk launch is deliberate:
             # launching the chunk (which donates the carry) while the
@@ -613,7 +616,7 @@ class TpuChecker(HostChecker):
             carry = seed_carry(
                 model, qcap, self._capacity, init_rows, seed_ebits,
                 symmetry=self._symmetry or self._sound, hcap=hcap,
-                init_fps=cache_fps, table_plan=table_plan)
+                init_fps=cache_fps, table_plan=table_plan, ecap=ecap)
             if table_plan is None:
                 key_hi, key_lo, seed_ovf = self._bulk_insert_async(
                     insert_fn, carry.key_hi, carry.key_lo, seed_keys)
@@ -631,7 +634,7 @@ class TpuChecker(HostChecker):
                                   kmax, symmetry=self._symmetry,
                                   sound=self._sound, hcap=hcap,
                                   n_init=n_init, kraw=kraw,
-                                  hint_eff=hint_eff)
+                                  hint_eff=hint_eff, ecap=ecap)
 
         chunk_fn = mk_chunk()
 
@@ -662,15 +665,16 @@ class TpuChecker(HostChecker):
                 # are on): each transfer costs ~100 ms of tunnel latency
                 stats = np.asarray(stats_d)
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-             vmax, dmax, rmax) = (
+             vmax, dmax, rmax, e_n) = (
                 int(stats[0]), int(stats[1]), int(stats[2]),
                 int(stats[3]), bool(stats[4]), bool(stats[5]),
                 bool(stats[6]), int(stats[7]), bool(stats[8]),
-                int(stats[9]), int(stats[10]), int(stats[11]))
-            disc_hit = stats[12:12 + prop_count].astype(bool)
-            disc_hi = stats[12 + prop_count:12 + 2 * prop_count]
-            disc_lo = stats[12 + 2 * prop_count:12 + 3 * prop_count]
-            tail0 = 12 + 3 * prop_count
+                int(stats[9]), int(stats[10]), int(stats[11]),
+                int(stats[12]))
+            disc_hit = stats[13:13 + prop_count].astype(bool)
+            disc_hi = stats[13 + prop_count:13 + 2 * prop_count]
+            disc_lo = stats[13 + 2 * prop_count:13 + 3 * prop_count]
+            tail0 = 13 + 3 * prop_count
             width3 = model.packed_width + 3
             if int(q_tail) > 0:
                 # most recently enqueued state (live Explorer progress)
@@ -800,6 +804,16 @@ class TpuChecker(HostChecker):
                     or self._cancel_event.is_set())
             if done:
                 break
+            if ecap and e_n >= ecap - max(kmax, fmax):
+                # cross-edge log full: quadruple it and resume
+                with self._timed("grow"):
+                    new_elog = jnp.zeros((ecap * 4, 4), jnp.uint32)
+                    new_elog = jax.lax.dynamic_update_slice(
+                        new_elog, carry.elog, (0, 0))
+                    ecap *= 4
+                    carry = carry._replace(elog=new_elog)
+                chunk_fn = mk_chunk()
+                continue
             need_grow = (int(log_n) >= int(grow_limit)
                          or int(q_tail) > qcap - headroom)
             if need_grow:
@@ -807,6 +821,21 @@ class TpuChecker(HostChecker):
                     carry, qcap = self._grow_device(carry, qcap, n_init,
                                                     headroom, insert_fn)
                 chunk_fn = mk_chunk()
+
+        if (self._sound and q_size == 0 and self._resume_path is None
+                and not self._cancel_event.is_set()):
+            # full exhaustion under sound mode: run the shared lasso
+            # sweep (checker/lasso.py) over the node graph rebuilt from
+            # the device logs — insert edges from the main log, cross
+            # edges (dedup hits with pending bits) from the round-5 edge
+            # log. Cycles entered via cross edges into explored branches
+            # are liveness counterexamples neither the per-row flush nor
+            # the reference can see. Skipped on resume: the
+            # pre-checkpoint subgraph's edges are not in this run's logs.
+            with self._timed("lasso"):
+                self._device_lasso_sweep(carry, int(q_tail), int(log_n),
+                                         int(e_n), n_init,
+                                         int(full_ebits), discoveries)
 
         if self._tpu_options.get("resumable"):
             # pull the pending frontier eagerly so save() needs no pinned
@@ -825,6 +854,37 @@ class TpuChecker(HostChecker):
         # the log fields so the table/queue HBM is freed promptly.
         self._mirror_carry = (carry.log, carry.log_n)
         self._discovery_fps.update(discoveries)
+
+    def _device_lasso_sweep(self, carry, q_tail: int, log_n: int,
+                            e_n: int, n_init: int, full_mask: int,
+                            discoveries: Dict[str, object]) -> None:
+        """Rebuild the (state, pending-ebits) node graph from the device
+        logs and run the shared SCC sweep. Node masks come from the
+        queue's at-enqueue ebits column (queue row ``n_init + i`` aligns
+        with log row ``i``); witnesses land in ``discoveries`` as
+        explicit fingerprint paths (stem + one cycle lap)."""
+        import jax
+
+        from .lasso import lasso_sweep
+
+        from .lasso import add_log_block, add_seed_nodes
+
+        model = self._model
+        width = model.packed_width
+        node_fp: Dict[int, int] = {}
+        node_parent: Dict[int, tuple] = {}
+        node_mask: Dict[int, int] = {}
+        node_edges: Dict[int, list] = {}
+        add_seed_nodes(node_fp, node_parent, node_mask, self._base_fps,
+                       self._orig_of, full_mask)
+        log_h = np.asarray(jax.device_get(carry.log[:max(log_n, 1)]))
+        eb_h = np.asarray(jax.device_get(
+            carry.q[n_init:n_init + max(log_n, 1), width]))
+        edges_h = np.asarray(jax.device_get(carry.elog[:max(e_n, 1)]))
+        add_log_block(node_fp, node_parent, node_mask, node_edges,
+                      log_h[:log_n], eb_h[:log_n], edges_h[:e_n])
+        lasso_sweep(self._properties, discoveries, node_edges,
+                    node_mask, node_parent, node_fp)
 
     def _device_qcap(self, n_init: int, headroom: int) -> int:
         """Queue rows needed between growths: every enqueued state is
@@ -1416,7 +1476,11 @@ class TpuChecker(HostChecker):
 
         meta = json.dumps({
             "model": self._model_tag(),
-            "discoveries": {n: int(fp)
+            # list-valued discoveries are explicit fingerprint paths
+            # (lasso witnesses) and round-trip as lists
+            "discoveries": {n: ([int(f) for f in fp]
+                                if isinstance(fp, (list, tuple))
+                                else int(fp))
                             for n, fp in self._discovery_fps.items()},
             # dedup-key semantics must match at resume: node keys under
             # sound, canonical-orbit keys under symmetry
@@ -1473,7 +1537,8 @@ class TpuChecker(HostChecker):
         self._state_count = int(data["state_count"])
         self._unique_state_count = len(self._generated)
         for name, fp in meta["discoveries"].items():
-            discoveries[name] = int(fp)
+            discoveries[name] = ([int(f) for f in fp]
+                                 if isinstance(fp, list) else int(fp))
         rows = [np.asarray(r, np.uint32) for r in data["rows"]]
         if "ffps" in data:
             fps = [int(f) for f in data["ffps"]]

@@ -139,6 +139,9 @@ class ShardedTpuChecker(TpuChecker):
                 f"unknown tpu_options exchange {exchange!r}; expected "
                 "'ring' or 'bucket'")
         kb = int(opts.get("kb", 0))
+        # sound mode logs cross edges for the post-exhaustion lasso
+        # sweep, exactly like the single-chip engine
+        ecap = self._capacity if self._sound else 0
         headroom = max(D * kmax, fmax)
         # per-shard slice must keep one worst-case iteration of headroom
         # below the growth limit (same invariant as the single-chip loop)
@@ -181,7 +184,7 @@ class ShardedTpuChecker(TpuChecker):
                                    prop_count, symmetry=self._symmetry,
                                    sound=self._sound,
                                    cache_fps=cache_fps,
-                                   table_plan=table_plan)
+                                   table_plan=table_plan, ecap=ecap)
         if table_plan is None:
             key_hi, key_lo = self._sharded_bulk_insert(
                 insert_fn, carry.key_hi, carry.key_lo, table_fps, D)
@@ -191,7 +194,7 @@ class ShardedTpuChecker(TpuChecker):
             return build_sharded_chunk_fn(
                 model, mesh, axis, qcap, self._capacity, fmax, kmax,
                 symmetry=self._symmetry, sound=self._sound, kraw=kraw,
-                exchange=exchange, kb=kb)
+                exchange=exchange, kb=kb, ecap=ecap)
 
         chunk_fn = rebuild_chunk()
 
@@ -228,6 +231,8 @@ class ShardedTpuChecker(TpuChecker):
             disc_hit = stats[base:base + prop_count].astype(bool)
             disc_hi = stats[base + prop_count:base + 2 * prop_count]
             disc_lo = stats[base + 2 * prop_count:base + 3 * prop_count]
+            e_n = stats[base + 3 * prop_count:
+                        base + 3 * prop_count + D].astype(np.int64)
             self._prof["chunks"] = self._prof.get("chunks", 0) + 1
             self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
             self._prof["dmax"] = max(self._prof.get("dmax", 0), dmax)
@@ -291,10 +296,48 @@ class ShardedTpuChecker(TpuChecker):
                 break
             need_grow = (int(log_n.max()) >= int(grow_limit)
                          or int(q_tail.max()) > qcap // D - headroom)
+            if (ecap and not need_grow
+                    and int(e_n.max()) >= ecap // D - headroom):
+                # cross-edge log full: grow JUST the shard-local elog
+                # (cross edges scale with transitions, not states — a
+                # full capacity/table/queue regrow would inflate every
+                # buffer toward O(edges))
+                with self._timed("grow"):
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as P)
+                    old_eloc = ecap // D
+                    ecap *= 4
+                    eloc = ecap // D
+                    elog_h, en_h = jax.device_get(
+                        (carry.elog, carry.e_n))
+                    new_elog = np.zeros((ecap, 4), np.uint32)
+                    for s in range(D):
+                        en = int(en_h[s])
+                        new_elog[s * eloc:s * eloc + en] = \
+                            elog_h[s * old_eloc:s * old_eloc + en]
+                    sh = NamedSharding(mesh, P(axis))
+                    carry = carry._replace(
+                        elog=jax.device_put(new_elog, sh))
+                chunk_fn = rebuild_chunk()
+                continue
             if need_grow:
+                self._prof["grows"] = self._prof.get("grows", 0) + 1
                 carry, qcap = self._grow_sharded(
                     carry, qcap, n_init, headroom, table_fps, insert_fn)
+                if ecap:
+                    ecap = max(self._capacity, ecap)
                 chunk_fn = rebuild_chunk()
+
+        if (self._sound and int((q_tail - q_head).sum()) == 0
+                and self._resume_path is None):
+            # full exhaustion under sound mode: merged lasso sweep over
+            # every shard's node graph (insert edges from the per-shard
+            # logs, cross edges from the per-shard edge logs) — the
+            # sharded twin of TpuChecker._device_lasso_sweep
+            with self._timed("lasso"):
+                self._sharded_lasso_sweep(carry, qcap, q_tail, log_n,
+                                          e_n, discoveries,
+                                          int(full_ebits))
 
         if self._tpu_options.get("resumable"):
             # pull the pending per-shard frontiers eagerly so save()
@@ -362,13 +405,16 @@ class ShardedTpuChecker(TpuChecker):
         D = mesh.shape[axis]
         # pull only what the rebuild reads — NOT the old table halves,
         # which are discarded and re-derived from the logs
-        (q_h, qh, qt, log_h, ln_h, disc_hit, disc_hi, disc_lo, gen,
-         xovf, steps) = jax.device_get(
+        (q_h, qh, qt, log_h, ln_h, elog_h, en_h, disc_hit, disc_hi,
+         disc_lo, gen, xovf, steps) = jax.device_get(
             (carry.q, carry.q_head, carry.q_tail, carry.log,
-             carry.log_n, carry.disc_hit, carry.disc_hi, carry.disc_lo,
+             carry.log_n, carry.elog, carry.e_n, carry.disc_hit,
+             carry.disc_hi, carry.disc_lo,
              carry.gen, carry.xovf, carry.steps))
         old_qloc = qcap // D
         old_closc = self._capacity // D
+        old_eloc = elog_h.shape[0] // D
+        sound_on = old_eloc > 0 and elog_h.shape[0] > D
         self._capacity *= 4
         new_qcap = self._sharded_qcap(n_init, headroom, D)
         qloc = new_qcap // D
@@ -378,6 +424,11 @@ class ShardedTpuChecker(TpuChecker):
 
         q = np.zeros((new_qcap, width + 3), dtype=np.uint32)
         log = np.zeros((self._capacity, log_w), dtype=np.uint32)
+        # the elog may have outgrown the main capacity via its own
+        # standalone growth path — never shrink it here
+        elog = np.zeros((max(self._capacity, D * old_eloc)
+                         if sound_on else D, 4), dtype=np.uint32)
+        eloc = elog.shape[0] // D
         for s in range(D):
             tail = int(qt[s])
             q[s * qloc:s * qloc + tail] = \
@@ -385,6 +436,10 @@ class ShardedTpuChecker(TpuChecker):
             ln = int(ln_h[s])
             log[s * closc:s * closc + ln] = \
                 log_h[s * old_closc:s * old_closc + ln]
+            if sound_on:
+                en = int(en_h[s])
+                elog[s * eloc:s * eloc + en] = \
+                    elog_h[s * old_eloc:s * old_eloc + en]
 
         sh = NamedSharding(mesh, P(axis))
         rep = NamedSharding(mesh, P())
@@ -410,6 +465,8 @@ class ShardedTpuChecker(TpuChecker):
             key_hi=key_hi, key_lo=key_lo,
             log=d_log,
             log_n=jax.device_put(ln_h, sh),
+            elog=jax.device_put(elog, sh),
+            e_n=jax.device_put(en_h, sh),
             disc_hit=jax.device_put(disc_hit, rep),
             disc_hi=jax.device_put(disc_hi, rep),
             disc_lo=jax.device_put(disc_lo, rep),
@@ -418,8 +475,11 @@ class ShardedTpuChecker(TpuChecker):
             xovf=jax.device_put(xovf, rep),
             kovf=jax.device_put(np.bool_(False), rep),
             vmax=jax.device_put(np.int32(0), rep),
+            dmax=jax.device_put(np.int32(0), rep),
+            bmax=jax.device_put(np.int32(0), rep),
             steps=jax.device_put(steps, rep),
-            go=jax.device_put(np.bool_(False), rep))
+            go=jax.device_put(np.bool_(False), rep),
+            pavail=jax.device_put(np.int32(0), rep))
         return new_carry, new_qcap
 
     # ------------------------------------------------------------------
@@ -470,6 +530,49 @@ class ShardedTpuChecker(TpuChecker):
                       else int(wfp[j]))
                 self._eval_host_props_row(rows_h[s * hmax + j], fp,
                                           discoveries)
+
+    # ------------------------------------------------------------------
+    def _sharded_lasso_sweep(self, carry: ShardedCarry, qcap: int,
+                             q_tail, log_n, e_n,
+                             discoveries: Dict[str, object],
+                             full_mask: int) -> None:
+        """Merge every shard's node graph and run the shared SCC sweep
+        (checker/lasso.py). Per-shard queue row ``n_init_s + i`` aligns
+        with per-shard log row ``i``; node masks come from the queue's
+        at-enqueue ebits column."""
+        import jax
+
+        from ..checker.lasso import (add_log_block, add_seed_nodes,
+                                     lasso_sweep)
+
+        mesh, axis = self._mesh, self._axis
+        D = mesh.shape[axis]
+        model = self._model
+        width = model.packed_width
+        qloc = qcap // D
+        closc = self._capacity // D
+        q_h, log_h, elog_h = jax.device_get(
+            (carry.q, carry.log, carry.elog))
+        eloc = elog_h.shape[0] // D
+        node_fp: Dict[int, int] = {}
+        node_parent: Dict[int, tuple] = {}
+        node_mask: Dict[int, int] = {}
+        node_edges: Dict[int, list] = {}
+        for s in range(D):
+            add_seed_nodes(node_fp, node_parent, node_mask,
+                           self._init_by_shard[s], self._orig_of,
+                           full_mask)
+        for s in range(D):
+            n0 = len(self._init_by_shard[s])
+            ln = int(log_n[s])
+            en = int(e_n[s])
+            add_log_block(
+                node_fp, node_parent, node_mask, node_edges,
+                log_h[s * closc:s * closc + ln],
+                q_h[s * qloc + n0:s * qloc + n0 + ln, width],
+                elog_h[s * eloc:s * eloc + en])
+        lasso_sweep(self._properties, discoveries, node_edges,
+                    node_mask, node_parent, node_fp)
 
     # ------------------------------------------------------------------
     def _finalize_sharded(self, carry: ShardedCarry) -> None:

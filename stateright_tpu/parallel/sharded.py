@@ -94,6 +94,11 @@ class ShardedCarry(NamedTuple):
     #                     hi/lo (node keys under sound), parent fp hi/lo,
     #                     original fp hi/lo (symmetry/sound only)
     log_n: jax.Array    # int32[D]          per-shard log length
+    elog: jax.Array     # uint32[D*eloc|D, 4] sound-mode cross-edge log
+    #                     (dedup hits with pending bits, as parent/child
+    #                     node-key rows — see checker/device_loop.py);
+    #                     1-row-per-shard dummy outside sound mode
+    e_n: jax.Array      # int32[D]          per-shard edge-log length
     disc_hit: jax.Array  # bool[P]    replicated: property discovered?
     disc_hi: jax.Array   # uint32[P]  replicated: witness fp (sticky first)
     disc_lo: jax.Array   # uint32[P]
@@ -139,6 +144,7 @@ def carry_specs(axis: str) -> ShardedCarry:
     s, r = P(axis), P()
     return ShardedCarry(
         q=s, q_head=s, q_tail=s, key_hi=s, key_lo=s, log=s, log_n=s,
+        elog=s, e_n=s,
         disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
         kovf=r, vmax=r, dmax=r, bmax=r, steps=r, go=r, pavail=r)
 
@@ -152,7 +158,7 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                            capacity: int, fmax: int, kmax: int,
                            symmetry: bool = False, sound: bool = False,
                            kraw: int = 0, exchange: str = "ring",
-                           kb: int = 0):
+                           kb: int = 0, ecap: int = 0):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
@@ -176,13 +182,13 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     key = None
     if mkey is not None:
         key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax, kmax,
-               symmetry, sound, kraw, exchange, kb)
+               symmetry, sound, kraw, exchange, kb, ecap)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
                                  fmax, kmax, symmetry, sound, kraw,
-                                 exchange, kb)
+                                 exchange, kb, ecap)
     if key is not None:
         _SHARDED_CACHE[key] = fn
     return fn
@@ -192,7 +198,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                             capacity: int, fmax: int, kmax: int,
                             symmetry: bool = False,
                             sound: bool = False, kraw: int = 0,
-                            exchange: str = "ring", kb: int = 0):
+                            exchange: str = "ring", kb: int = 0,
+                            ecap: int = 0):
     from ..checker.device_loop import shrink_indices
 
     D = mesh.shape[axis]
@@ -233,6 +240,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     bucket = exchange == "bucket" and D > 1
     if bucket:
         kb = effective_kb(kmax, D, kb)
+    eloc = ecap // D if ecap else 0
     # thin BFS levels (start/tail of every search) would pay the full
     # fmax lane width; like the single-chip loop, the chunk sequences a
     # small-step loop and a large-step loop (an in-loop lax.cond copies
@@ -244,8 +252,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     fa_small = fmax_small * n_actions
     kraw_small = min(fa_small, kraw)
 
-    def go_from(pavail, max_tail, max_log, disc_hit, gen, ovf, xovf,
-                kovf, steps, target_remaining, grow_limit):
+    def go_from(pavail, max_tail, max_log, max_e, disc_hit, gen, ovf,
+                xovf, kovf, steps, target_remaining, grow_limit):
         """Replicated loop condition from already-reduced maxima — NO
         collectives here: the step folds every per-iteration reduction
         into three fused collectives (measured ~13 separate psum/pmax
@@ -255,6 +263,10 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
               & (gen < target_remaining)
               & (max_log < grow_limit)
               & (max_tail <= qloc - ring_headroom))
+        if eloc:
+            # the cross-edge log must keep one iteration of headroom;
+            # the host grows all buffers when any shard approaches
+            go = go & (max_e <= eloc - ring_headroom)
         if device_prop_idx and not host_idx:
             go = go & ~disc_hit[jnp.array(device_prop_idx)].all()
         return go
@@ -265,6 +277,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         me = lax.axis_index(axis).astype(jnp.uint32)
         me_i = me.astype(jnp.int32)
         q_head, q_tail, log_n = c.q_head[0], c.q_tail[0], c.log_n[0]
+        elog, e_n = c.elog, c.e_n[0]
 
         take = jnp.minimum(q_tail - q_head, fmax_b)
         sl = lax.dynamic_slice(c.q, (q_head, 0), (fmax_b, width + 3))
@@ -407,6 +420,16 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 key_hi, key_lo, recv[:, log_off], recv[:, log_off + 1],
                 mine)
             cnt = inserted.sum(dtype=jnp.int32)
+            if sound and eloc:
+                # cross edges for the lasso sweep: dedup hits whose
+                # child node still has pending bits
+                ehit = mine & ~inserted & (recv[:, width] != 0)
+                esrc = shrink_indices(ehit, D * kb)
+                erows = jnp.concatenate(
+                    [recv[:, width + 5:width + 7],
+                     recv[:, width + 3:width + 5]], axis=1)[esrc]
+                elog = lax.dynamic_update_slice(elog, erows, (e_n, 0))
+                e_n = e_n + ehit.sum(dtype=jnp.int32)
             src3 = shrink_indices(inserted, D * kb)
             n_all = recv[src3]
             q = lax.dynamic_update_slice(
@@ -429,6 +452,15 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                     k_c[:, log_off + 1], mine)
                 t_ovf = t_ovf | o
                 cnt = inserted.sum(dtype=jnp.int32)
+                if sound and eloc:
+                    ehit = mine & ~inserted & (k_c[:, width] != 0)
+                    esrc = shrink_indices(ehit, kfin_b)
+                    erows = jnp.concatenate(
+                        [k_c[:, width + 5:width + 7],
+                         k_c[:, width + 3:width + 5]], axis=1)[esrc]
+                    elog = lax.dynamic_update_slice(elog, erows,
+                                                    (e_n, 0))
+                    e_n = e_n + ehit.sum(dtype=jnp.int32)
                 src3 = shrink_indices(inserted, kfin_b)
                 n_all = k_c[src3]
                 q = lax.dynamic_update_slice(
@@ -444,10 +476,10 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # --- fused collectives 2 and 3 of 3 (post-ring): the loop
         # condition's maxima in ONE pmax, the sums (generated count and
         # the picked discovery fingerprints) in ONE psum
-        pm2 = lax.pmax(jnp.stack([q_tail - q_head, q_tail, log_n,
+        pm2 = lax.pmax(jnp.stack([q_tail - q_head, q_tail, log_n, e_n,
                                   t_ovf.astype(jnp.int32)]), axis)
-        pavail, max_tail, max_log = pm2[0], pm2[1], pm2[2]
-        ovf = c.ovf | ((pm2[3] > 0) & ~kovf)
+        pavail, max_tail, max_log, max_e = pm2[0], pm2[1], pm2[2], pm2[3]
+        ovf = c.ovf | ((pm2[4] > 0) & ~kovf)
         xovf = c.xovf | xovf_any
         if prop_count:
             ps = lax.psum(jnp.concatenate([
@@ -468,12 +500,14 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         dmax = jnp.maximum(c.dmax, dshard)
         bmax_c = jnp.maximum(c.bmax, bshard)
         steps = c.steps - 1
-        go = go_from(pavail, max_tail, max_log, disc_hit, gen, ovf,
-                     xovf, kovf, steps, target_remaining, grow_limit)
+        go = go_from(pavail, max_tail, max_log, max_e, disc_hit, gen,
+                     ovf, xovf, kovf, steps, target_remaining,
+                     grow_limit)
         nc = ShardedCarry(
             q=q, q_head=q_head[None], q_tail=q_tail[None],
             key_hi=key_hi, key_lo=key_lo,
             log=log, log_n=log_n[None],
+            elog=elog, e_n=e_n[None],
             disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
             gen=gen, ovf=ovf, xovf=xovf, kovf=kovf, vmax=vmax,
             dmax=dmax, bmax=bmax_c, steps=steps, go=go, pavail=pavail)
@@ -487,10 +521,11 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
     def local_chunk(carry, target_remaining, grow_limit):
         pm = lax.pmax(jnp.stack([carry.q_tail[0] - carry.q_head[0],
-                                 carry.q_tail[0], carry.log_n[0]]), axis)
-        go = go_from(pm[0], pm[1], pm[2], carry.disc_hit, carry.gen,
-                     carry.ovf, carry.xovf, carry.kovf, carry.steps,
-                     target_remaining, grow_limit)
+                                 carry.q_tail[0], carry.log_n[0],
+                                 carry.e_n[0]]), axis)
+        go = go_from(pm[0], pm[1], pm[2], pm[3], carry.disc_hit,
+                     carry.gen, carry.ovf, carry.xovf, carry.kovf,
+                     carry.steps, target_remaining, grow_limit)
         state = (carry._replace(go=go, pavail=pm[0]), target_remaining,
                  grow_limit)
         # sequenced small/large while_loops gated on the REPLICATED
@@ -519,10 +554,11 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # chunk (layout parsed by parallel/engine.py — keep in sync):
         # [q_head[D], q_tail[D], log_n[D],
         #  gen, ovf, xovf, kovf, vmax, dmax, bmax,
-        #  disc_hit[P], disc_hi[P], disc_lo[P]]
+        #  disc_hit[P], disc_hi[P], disc_lo[P], e_n[D]]
         hs = lax.all_gather(out.q_head, axis, tiled=True)
         ts = lax.all_gather(out.q_tail, axis, tiled=True)
         ls = lax.all_gather(out.log_n, axis, tiled=True)
+        es = lax.all_gather(out.e_n, axis, tiled=True)
         stats = jnp.concatenate([
             hs.astype(jnp.uint32), ts.astype(jnp.uint32),
             ls.astype(jnp.uint32),
@@ -533,7 +569,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                        out.vmax, out.dmax,
                        out.bmax]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
-            out.disc_hi, out.disc_lo])
+            out.disc_hi, out.disc_lo, es.astype(jnp.uint32)])
         return out, stats
 
     specs = carry_specs(axis)
@@ -656,7 +692,8 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                        capacity: int, init_rows, init_fps, full_ebits,
                        prop_count: int, symmetry: bool = False,
                        sound: bool = False,
-                       cache_fps=None, table_plan=None) -> ShardedCarry:
+                       cache_fps=None, table_plan=None,
+                       ecap: int = 0) -> ShardedCarry:
     """Construct the initial sharded carry ON DEVICE: the host routes
     only the init rows (tiny) to their owner shards' blocks; every big
     buffer is zeroed by a shard_map'd device program. device_put-ing
@@ -721,7 +758,7 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
         t_hi = t_lo = np.zeros((D,), np.uint32)
 
     key = ("seed", mesh, axis, qcap, capacity, width, log_w, pad,
-           prop_count, kt)
+           prop_count, kt, ecap)
     fn = _SHARDED_CACHE.get(key)
     if fn is None:
         def local(blk, tail, t_idx, t_hi, t_lo):
@@ -748,6 +785,9 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                 key_lo=key_lo,
                 log=jnp.zeros((capacity // D, log_w), jnp.uint32),
                 log_n=jnp.zeros((1,), jnp.int32),
+                elog=jnp.zeros((ecap // D if ecap else 1, 4),
+                               jnp.uint32),
+                e_n=jnp.zeros((1,), jnp.int32),
                 disc_hit=jnp.zeros((prop_count,), bool),
                 disc_hi=jnp.zeros((prop_count,), jnp.uint32),
                 disc_lo=jnp.zeros((prop_count,), jnp.uint32),

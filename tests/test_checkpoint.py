@@ -283,3 +283,29 @@ class TestCheckpointModes:
              .tpu_options(capacity=1 << 10, fmax=4, race=False)
              .resume_from(path)
              .spawn_tpu().join())
+
+
+def test_save_with_lasso_witness_roundtrips(tmp_path):
+    # a lasso discovery is a list-valued fingerprint path; save()/resume
+    # metadata must round-trip it (round-5 regression)
+    import pytest
+    pytest.importorskip("jax")
+    from stateright_tpu.core import Property
+    from stateright_tpu.models.fixtures import PackedDGraph
+
+    # one property object: the model config tag keys on the condition's
+    # identity, and resume requires matching tags
+    prop = Property.eventually("odd", lambda _, s: s % 2 == 1)
+    g = (PackedDGraph.with_property(prop).with_path([0, 2, 4, 2]))
+    c = (g.checker().sound_eventually()
+         .tpu_options(capacity=1 << 10, fmax=16, resumable=True)
+         .spawn_tpu().join())
+    assert c.discovery("odd") is not None
+    p = tmp_path / "lasso.npz"
+    c.save(str(p))
+    g2 = (PackedDGraph.with_property(prop).with_path([0, 2, 4, 2]))
+    c2 = (g2.checker().sound_eventually()
+          .tpu_options(capacity=1 << 10, fmax=16)
+          .resume_from(str(p)).spawn_tpu().join())
+    states = c2.assert_any_discovery("odd").into_states()
+    assert not any(s % 2 == 1 for s in states)

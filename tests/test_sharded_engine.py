@@ -63,7 +63,12 @@ class TestShardedGrowth:
         # 2pc n=5 = 8,832 states (2pc.rs:133) with a deliberately small
         # table: the engine must grow mid-run and still enumerate exactly.
         model = TwoPhaseSys(5)
-        sharded = _sharded_checker(model, 2, capacity=1 << 12, fmax=32)
+        # small kraw/kmax keep the growth headroom small enough that the
+        # initial capacity pre-grow does not already cover the space —
+        # the run must actually exercise _grow_sharded
+        sharded = _sharded_checker(model, 2, capacity=1 << 12, fmax=32,
+                                   kraw=512, kmax=512)
+        assert sharded.profile().get("grows", 0) > 0
         assert sharded.unique_state_count() == 8832
         host = model.checker().spawn_bfs().join()
         assert (sharded.generated_fingerprints()
