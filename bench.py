@@ -30,9 +30,19 @@ import time
 N = 3  # samples per workload (best-of-N, all recorded)
 
 
+def _median(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
 def _sampled(name, mk, value=None, unit="uniq/s"):
-    """Run ``mk`` N+1 times (first unrecorded warm-up); report best rate
-    (or best latency when ``value='seconds'``) with all samples."""
+    """Run ``mk`` N+2 times (TWO unrecorded warm-ups: the first pays the
+    compile-cache load, the second pays the observed-size-memo shape
+    switch — checker/tpu.py autotuning); report best AND median rate
+    (or latency when ``value='seconds'``) with all samples. Timing on
+    the tunneled chip is bimodal (NOTES.md), so the median tracks the
+    typical run while best tracks the capability."""
+    mk()
     mk()
     samples = []
     ck = None
@@ -45,7 +55,8 @@ def _sampled(name, mk, value=None, unit="uniq/s"):
         else:
             samples.append(round(ck.unique_state_count() / dt, 1))
     best = min(samples) if value == "seconds" else max(samples)
-    print(json.dumps({"workload": name, "best": best, "unit":
+    print(json.dumps({"workload": name, "best": best,
+                      "median": _median(samples), "unit":
                       "s" if value == "seconds" else unit,
                       "uniq": ck.unique_state_count(),
                       "gen": ck.state_count(),
@@ -56,19 +67,27 @@ def _sampled(name, mk, value=None, unit="uniq/s"):
 def main() -> None:
     from stateright_tpu.examples.paxos_packed import PackedPaxos
 
-    # --- baseline: host BFS on paxos check 3, all cores ----------------
+    # --- baseline: host BFS on paxos check 3, all cores (best-of-3:
+    # the single-sample round-4 baseline was the noisiest number in the
+    # artifact) -------------------------------------------------------
     import os
-    t0 = time.perf_counter()
-    host_ck = (PackedPaxos(3).checker()
-               .threads(os.cpu_count() or 1)
-               .target_state_count(40_000)
-               .spawn_bfs().join())
-    host_dt = time.perf_counter() - t0
-    host_rate = host_ck.unique_state_count() / host_dt
+    host_samples = []
+    host_ck = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        host_ck = (PackedPaxos(3).checker()
+                   .threads(os.cpu_count() or 1)
+                   .target_state_count(40_000)
+                   .spawn_bfs().join())
+        host_dt = time.perf_counter() - t0
+        host_samples.append(
+            round(host_ck.unique_state_count() / host_dt, 1))
+    host_rate = max(host_samples)
     print(json.dumps({"workload": "host paxos3 allcores capped",
-                      "best": round(host_rate, 1), "unit": "uniq/s",
+                      "best": host_rate,
+                      "median": _median(host_samples), "unit": "uniq/s",
                       "uniq": host_ck.unique_state_count(),
-                      "samples": [round(host_rate, 1)]}), file=sys.stderr)
+                      "samples": host_samples}), file=sys.stderr)
 
     # --- primary: device paxos check 3 ---------------------------------
     tpu_rate = _sampled(
@@ -132,7 +151,10 @@ def _context() -> None:
                                 channel_depth=8).checker()
                       .tpu_options(capacity=1 << 20, race=False)
                       .target_state_count(100_000).spawn_tpu().join()))
-    _sampled("tpu abd3 ordered capped 100k",
+    # full enumeration: the space exhausts at 36,213 unique (gen 63,053)
+    # well under the 100k cap, so the round-4 "capped 100k" label never
+    # actually bound
+    _sampled("tpu abd3 ordered full 36213",
              lambda: (PackedAbd(3, server_count=2, ordered=True,
                                 channel_depth=8).checker()
                       .tpu_options(capacity=1 << 20, race=False)
@@ -147,17 +169,20 @@ def _context() -> None:
                       .tpu_options(capacity=1 << 14).spawn_tpu().join()),
              value="seconds")
 
-    # host oracle for the counterexample metric
-    t0 = time.perf_counter()
-    ck = SingleCopyModelCfg(
-        client_count=2, server_count=2,
-        network=Network.new_unordered_nonduplicating()).into_model() \
-        .checker().spawn_bfs().join()
-    dt = time.perf_counter() - t0
-    found = ck.discovery("linearizable") is not None
+    # host oracle for the counterexample metric (best-of-3)
+    samples = []
+    found = False
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ck = SingleCopyModelCfg(
+            client_count=2, server_count=2,
+            network=Network.new_unordered_nonduplicating()).into_model() \
+            .checker().spawn_bfs().join()
+        samples.append(round(time.perf_counter() - t0, 4))
+        found = ck.discovery("linearizable") is not None
     print(json.dumps({"workload": "host single-copy2+2 time-to-cx",
-                      "best": round(dt, 4), "unit": "s",
-                      "found": found, "samples": [round(dt, 4)]}),
+                      "best": min(samples), "median": _median(samples),
+                      "unit": "s", "found": found, "samples": samples}),
           file=sys.stderr)
 
 

@@ -77,7 +77,9 @@ class RacingChecker(Checker):
     """Adopts the first engine (host BFS vs device) to finish."""
 
     #: host racer budget: small models finish in milliseconds; anything
-    #: that outlives this is device territory
+    #: that outlives this is device territory. Overridable per run via
+    #: ``tpu_options(race_budget=seconds)`` — a model the host would
+    #: finish at ~2 s should not get its racer cancelled at the line.
     HOST_BUDGET_S = 1.5
 
     def __init__(self, builder: CheckerBuilder):
@@ -85,6 +87,9 @@ class RacingChecker(Checker):
         from .tpu import TpuChecker
 
         self._model = builder.model
+        budget = builder.tpu_options_.get("race_budget")
+        if budget is not None:
+            self.HOST_BUDGET_S = float(budget)
         self._tpu = TpuChecker(builder)
         try:
             self._host = BfsChecker(builder)

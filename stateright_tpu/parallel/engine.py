@@ -48,10 +48,6 @@ class ShardedTpuChecker(TpuChecker):
             raise ValueError("mesh axis size must be a power of two")
         if self._capacity % d:
             raise ValueError("capacity must be divisible by the mesh axis")
-        if self._visitor is not None:
-            raise ValueError(
-                "visitors are a host feature; use single-chip spawn_tpu "
-                "(per-level mode) or the host engines")
         if getattr(self, "_sound", False) and self._host_props:
             raise NotImplementedError(
                 "sound_eventually() with host-evaluated properties is "
@@ -329,7 +325,9 @@ class ShardedTpuChecker(TpuChecker):
                 chunk_fn = rebuild_chunk()
 
         if (self._sound and int((q_tail - q_head).sum()) == 0
-                and self._resume_path is None):
+                and self._resume_path is None and not self._symmetry):
+            # (not under symmetry — cross-branch witnesses cannot replay
+            # through concrete orbit members; see the single-chip sweep)
             # full exhaustion under sound mode: merged lasso sweep over
             # every shard's node graph (insert edges from the per-shard
             # logs, cross edges from the per-shard edge logs) — the
@@ -355,6 +353,12 @@ class ShardedTpuChecker(TpuChecker):
                 _combine64(pend[:, width + 1], pend[:, width + 2]))
         self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
+        if self._visitor is not None:
+            # same post-hoc visitation as the single-chip engine; the
+            # global interleaving of per-shard insertion orders is
+            # unspecified, like the reference's multithreaded visitors
+            with self._timed("visit"):
+                self._visit_reached()
 
     def _sharded_qcap(self, n_init: int, headroom: int, d: int) -> int:
         """Append-only per-shard queues: a shard's tail never exceeds its

@@ -73,3 +73,18 @@ class TestRace:
         text = out.getvalue()
         assert "Done. states=" in text
         assert ck.unique_state_count() == 288
+
+
+def test_race_budget_option():
+    # tpu_options(race_budget=...) overrides the 1.5 s host-racer budget
+    import pytest
+    pytest.importorskip("jax")
+    from stateright_tpu.checker.race import RacingChecker
+    from stateright_tpu.models.packed import PackedLinearEquation
+
+    ck = (PackedLinearEquation(2, 4, 8).checker()
+          .tpu_options(race_budget=9.0, capacity=1 << 10).spawn_tpu())
+    assert isinstance(ck, RacingChecker)
+    assert ck.HOST_BUDGET_S == 9.0
+    assert RacingChecker.HOST_BUDGET_S == 1.5  # class default untouched
+    ck.join().assert_any_discovery("solvable")

@@ -97,14 +97,19 @@ class TestShardedEarlyExit:
 
 
 class TestShardedValidation:
-    def test_visitor_rejected(self):
+    def test_visitor_rides_sharded_engine(self):
+        # round 5: visitors replay post-hoc from the per-shard logs
+        # (global interleaving unspecified, like the reference's
+        # multithreaded visitors) — the visited SET must match host BFS
         from stateright_tpu.checker.visitor import StateRecorder
+        rec, states = StateRecorder.new_with_accessor()
         model = TwoPhaseSys(3)
-        with pytest.raises(ValueError, match="visitor"):
-            (model.checker()
-             .tpu_options(mesh=_mesh(2))
-             .visitor(StateRecorder())
-             .spawn_tpu())
+        ck = (model.checker()
+              .tpu_options(mesh=_mesh(2), capacity=1 << 12)
+              .visitor(rec)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 288
+        assert len(states()) == 288
 
     def test_owner_routing_covers_all_shards(self):
         # the fingerprint-prefix partition actually spreads 2pc n=3's

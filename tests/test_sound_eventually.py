@@ -387,3 +387,40 @@ class TestShardedLasso:
         g = (PackedDGraph.with_property(eventually_odd())
              .with_path([0, 1, 2, 0]))
         self.check_sharded(g).assert_properties()
+
+
+class TestGuardedCombinations:
+    """The deliberately-unsupported feature combinations raise actionable
+    errors (pinned so the capability matrix in README.md stays honest)."""
+
+    @pytest.fixture(autouse=True)
+    def _jax(self):
+        pytest.importorskip("jax")
+
+    def test_sound_with_host_props_raises(self):
+        # sound dedup identity is (state, ebits) nodes; the host-property
+        # history dedup keys on state columns — the two identities cannot
+        # share one table. Sound mode only engages when an EVENTUALLY
+        # property exists, so the fixture layers one on.
+        import sys
+        sys.path.insert(0, "tests")
+        from test_tpu_engine import _HostPropEquation
+
+        class _SoundHostProp(_HostPropEquation):
+            def properties(self):
+                return super().properties() + [
+                    Property.eventually("never", lambda _m, _s: False)]
+
+        with pytest.raises(NotImplementedError, match="host-evaluated"):
+            (_SoundHostProp(2, 0, 10**9).checker().sound_eventually()
+             .tpu_options(capacity=1 << 10).spawn_tpu())
+
+    def test_sound_level_mode_raises(self):
+        from stateright_tpu.models.fixtures import PackedDGraph
+
+        g = (PackedDGraph.with_property(eventually_odd())
+             .with_path([0, 2]))
+        with pytest.raises(NotImplementedError, match="device engine"):
+            (g.checker().sound_eventually()
+             .tpu_options(capacity=1 << 10, mode="level")
+             .spawn_tpu().join())

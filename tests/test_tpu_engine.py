@@ -201,12 +201,21 @@ def test_tpu_level_mode_grows_mid_level():
     assert checker.generated_fingerprints() == host.generated_fingerprints()
 
 
-def test_tpu_visitor_with_device_mode_rejected():
+def test_tpu_visitor_rides_device_engine():
+    # round 5: a visitor no longer forces the per-level engine — visits
+    # replay post-hoc from the device log (insertion order); the visited
+    # set must equal the host BFS visitation exactly
     from stateright_tpu.checker.visitor import StateRecorder
-    rec, _ = StateRecorder.new_with_accessor()
-    with pytest.raises(ValueError):
-        (TwoPhaseSys(2).checker().visitor(rec)
-         .tpu_options(mode="device").spawn_tpu().join())
+    rec, states = StateRecorder.new_with_accessor()
+    ck = (TwoPhaseSys(3).checker().visitor(rec)
+          .tpu_options(mode="device", capacity=1 << 12, race=False)
+          .spawn_tpu().join())
+    assert ck.unique_state_count() == 288
+    assert len(states()) == 288
+    host_rec, host_states = StateRecorder.new_with_accessor()
+    TwoPhaseSys(3).checker().visitor(host_rec).spawn_bfs().join()
+    assert {tuple(map(str, (s,))) for s in states()} \
+        == {tuple(map(str, (s,))) for s in host_states()}
 
 
 def test_tpu_unknown_mode_rejected():
