@@ -35,15 +35,17 @@ def _median(xs):
     return s[len(s) // 2]
 
 
-def _sampled(name, mk, value=None, unit="uniq/s"):
-    """Run ``mk`` N+2 times (TWO unrecorded warm-ups: the first pays the
-    compile-cache load, the second pays the observed-size-memo shape
-    switch — checker/tpu.py autotuning); report best AND median rate
-    (or latency when ``value='seconds'``) with all samples. Timing on
-    the tunneled chip is bimodal (NOTES.md), so the median tracks the
-    typical run while best tracks the capability."""
-    mk()
-    mk()
+def _sampled(name, mk, value=None, unit="uniq/s", warmups=2,
+             extra_fn=None):
+    """Run ``mk`` warmups+N times (device workloads default to TWO
+    unrecorded warm-ups: the first pays the compile-cache load, the
+    second the observed-size-memo shape switch — checker/tpu.py
+    autotuning; host workloads pass ``warmups=0``); report best AND
+    median rate (or latency when ``value='seconds'``) with all samples.
+    Timing on the tunneled chip is bimodal (NOTES.md), so the median
+    tracks the typical run while best tracks the capability."""
+    for _ in range(warmups):
+        mk()
     samples = []
     ck = None
     for _ in range(N):
@@ -55,12 +57,14 @@ def _sampled(name, mk, value=None, unit="uniq/s"):
         else:
             samples.append(round(ck.unique_state_count() / dt, 1))
     best = min(samples) if value == "seconds" else max(samples)
-    print(json.dumps({"workload": name, "best": best,
-                      "median": _median(samples), "unit":
-                      "s" if value == "seconds" else unit,
-                      "uniq": ck.unique_state_count(),
-                      "gen": ck.state_count(),
-                      "samples": samples}), file=sys.stderr)
+    row = {"workload": name, "best": best, "median": _median(samples),
+           "unit": "s" if value == "seconds" else unit,
+           "uniq": ck.unique_state_count(),
+           "gen": ck.state_count(),
+           "samples": samples}
+    if extra_fn is not None:
+        row.update(extra_fn(ck))
+    print(json.dumps(row), file=sys.stderr)
     return best
 
 
@@ -71,23 +75,13 @@ def main() -> None:
     # the single-sample round-4 baseline was the noisiest number in the
     # artifact) -------------------------------------------------------
     import os
-    host_samples = []
-    host_ck = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        host_ck = (PackedPaxos(3).checker()
-                   .threads(os.cpu_count() or 1)
-                   .target_state_count(40_000)
-                   .spawn_bfs().join())
-        host_dt = time.perf_counter() - t0
-        host_samples.append(
-            round(host_ck.unique_state_count() / host_dt, 1))
-    host_rate = max(host_samples)
-    print(json.dumps({"workload": "host paxos3 allcores capped",
-                      "best": host_rate,
-                      "median": _median(host_samples), "unit": "uniq/s",
-                      "uniq": host_ck.unique_state_count(),
-                      "samples": host_samples}), file=sys.stderr)
+    host_rate = _sampled(
+        "host paxos3 allcores capped",
+        lambda: (PackedPaxos(3).checker()
+                 .threads(os.cpu_count() or 1)
+                 .target_state_count(40_000)
+                 .spawn_bfs().join()),
+        warmups=0)
 
     # --- primary: device paxos check 3 ---------------------------------
     tpu_rate = _sampled(
@@ -170,20 +164,15 @@ def _context() -> None:
              value="seconds")
 
     # host oracle for the counterexample metric (best-of-3)
-    samples = []
-    found = False
-    for _ in range(3):
-        t0 = time.perf_counter()
-        ck = SingleCopyModelCfg(
+    _sampled(
+        "host single-copy2+2 time-to-cx",
+        lambda: SingleCopyModelCfg(
             client_count=2, server_count=2,
-            network=Network.new_unordered_nonduplicating()).into_model() \
-            .checker().spawn_bfs().join()
-        samples.append(round(time.perf_counter() - t0, 4))
-        found = ck.discovery("linearizable") is not None
-    print(json.dumps({"workload": "host single-copy2+2 time-to-cx",
-                      "best": min(samples), "median": _median(samples),
-                      "unit": "s", "found": found, "samples": samples}),
-          file=sys.stderr)
+            network=Network.new_unordered_nonduplicating()).into_model()
+        .checker().spawn_bfs().join(),
+        value="seconds", warmups=0,
+        extra_fn=lambda ck: {
+            "found": ck.discovery("linearizable") is not None})
 
 
 if __name__ == "__main__":

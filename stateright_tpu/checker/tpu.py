@@ -251,10 +251,11 @@ def _level_helpers():
 
 
 def auto_fmax(model, shards: int = 1) -> int:
-    """Default expansion width: ~16M child lane-words per iteration
+    """Default expansion width: ~12.5M child lane-words per iteration
     (divided across shards) — empirically the knee of the lane-cost curve
-    across model shapes after the incremental-network/bucketed-probe
-    rework dropped the per-lane cost. VERY wide rows (packed actor
+    across model shapes (paxos at 8192 rows measures ~8% faster per
+    unique state than at 10922; narrow high-action models like 2pc keep
+    the 12288-row cap). VERY wide rows (packed actor
     states, width >= 256) have a much lower knee (~6M lane-words —
     ABD-ordered measured best near fmax=1024 at width 331, round 4): the
     dense successor materialization is bandwidth-bound there, not
@@ -262,7 +263,7 @@ def auto_fmax(model, shards: int = 1) -> int:
     the knee is tuned in one place. The floor (1024 rows on a single
     chip, divided across shards down to 256) keeps enough frontier rows
     per iteration to amortize the fixed per-iteration cost."""
-    target = (3 << 21) if model.packed_width >= 256 else (1 << 24)
+    target = (3 << 21) if model.packed_width >= 256 else (3 << 22)
     return max(max(256, (1 << 10) // shards), min(
         3 << 12,
         target // (model.max_actions * model.packed_width * shards)))
@@ -931,34 +932,51 @@ class TpuChecker(HostChecker):
             steps.append((cur, None))
             return Path(steps)
 
-        for key in list(self._generated):
+        # wave-based deferral: the sharded mirror concatenates per-shard
+        # logs, so a child can precede its cross-shard parent; deferred
+        # keys retry next wave (the parent relation is a forest, so each
+        # wave makes progress and replay work stays O(states))
+        pending = list(self._generated)
+        while pending:
             if self._cancel_event.is_set():
                 return
-            fp = self._orig_of.get(key, key) \
-                if (self._symmetry or self._sound) else key
-            parent_key = self._generated[key]
-            if parent_key is None or parent_key not in built:
-                # an init state (or a resumed root): full reconstruction
-                path = self._reconstruct_path(key)
-                built[key] = ("anchor", path._steps)
-                self._visitor.visit(model, path)
-                continue
-            ppath = built[parent_key]
-            parent_state = ppath[1][-1][0] if ppath[0] == "anchor" \
-                else ppath[1]
-            found = None
-            for action, state in model.next_steps(parent_state):
-                if model.fingerprint(state) == fp:
-                    found = (action, state)
-                    break
-            if found is None:
+            deferred = []
+            for key in pending:
+                fp = self._orig_of.get(key, key) \
+                    if (self._symmetry or self._sound) else key
+                parent_key = self._generated[key]
+                if parent_key is not None and parent_key not in built \
+                        and parent_key in self._generated:
+                    deferred.append(key)
+                    continue
+                if parent_key is None or parent_key not in built:
+                    # an init state (or a resumed root whose chain is
+                    # outside the mirror): full reconstruction
+                    path = self._reconstruct_path(key)
+                    built[key] = ("anchor", path._steps)
+                    self._visitor.visit(model, path)
+                    continue
+                ppath = built[parent_key]
+                parent_state = ppath[1][-1][0] if ppath[0] == "anchor" \
+                    else ppath[1]
+                found = None
+                for action, state in model.next_steps(parent_state):
+                    if model.fingerprint(state) == fp:
+                        found = (action, state)
+                        break
+                if found is None:
+                    raise NondeterministicModelError(
+                        "Unable to extend a visitation path: no "
+                        f"successor of the parent state has fingerprint "
+                        f"{fp}. This usually means Model.actions or "
+                        "Model.next_state vary across calls.")
+                built[key] = (parent_key, found[1], found[0])
+                self._visitor.visit(model, materialize(key))
+            if len(deferred) == len(pending):  # pragma: no cover
                 raise NondeterministicModelError(
-                    "Unable to extend a visitation path: no successor of "
-                    f"the parent state has fingerprint {fp}. This "
-                    "usually means Model.actions or Model.next_state "
-                    "vary across calls.")
-            built[key] = (parent_key, found[1], found[0])
-            self._visitor.visit(model, materialize(key))
+                    "visitation replay stalled: a parent chain in the "
+                    "mirror is cyclic or incomplete")
+            pending = deferred
 
     def _device_qcap(self, n_init: int, headroom: int) -> int:
         """Queue rows needed between growths: every enqueued state is

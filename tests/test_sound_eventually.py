@@ -402,8 +402,6 @@ class TestGuardedCombinations:
         # history dedup keys on state columns — the two identities cannot
         # share one table. Sound mode only engages when an EVENTUALLY
         # property exists, so the fixture layers one on.
-        import sys
-        sys.path.insert(0, "tests")
         from test_tpu_engine import _HostPropEquation
 
         class _SoundHostProp(_HostPropEquation):

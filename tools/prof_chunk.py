@@ -13,7 +13,7 @@ import jax
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run(cap=300_000):
+def run(cap=500_000):
     import os
     if os.environ.get("PROF_MODEL") == "2pc":
         from stateright_tpu.models.twopc import TwoPhaseSys
@@ -28,7 +28,7 @@ def run(cap=300_000):
     from stateright_tpu.examples.paxos_packed import PackedPaxos
     t0 = time.perf_counter()
     ck = (PackedPaxos(3).checker()
-          .tpu_options(capacity=1 << 21)
+          .tpu_options(capacity=1 << 21, race=False)
           .target_state_count(cap)
           .spawn_tpu().join())
     dt = time.perf_counter() - t0
@@ -40,7 +40,8 @@ def run(cap=300_000):
 
 outdir = "/tmp/jaxprof"
 shutil.rmtree(outdir, ignore_errors=True)
-run()  # warm
+run()  # warm (compile-cache load)
+run()  # warm (observed-size-memo shape switch)
 with jax.profiler.trace(outdir):
     run()
 
