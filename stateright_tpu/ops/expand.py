@@ -150,47 +150,22 @@ def pre_dedup(chi, clo, cvalid):
     return cvalid & ~dup
 
 
-def candidate_matrix(exp: Expansion, n_actions: int, width: int,
-                     p_whi, p_wlo, symmetry: bool, sound: bool):
-    """The per-iteration candidate matrix shared by the single-chip and
-    sharded loops, ONE concatenation whose column layout makes the queue
-    block and the log block each a contiguous slice post-compaction:
-
-      [packed row (0..W-1) | child ebits (W) | state fp hi/lo (W+1,W+2)
-       | parent key hi/lo | original fp hi/lo (symmetry/sound only)]
-
-    Under ``sound`` the caller splices node-key columns in at W+3 AFTER
-    compaction (they are computed at kmax lanes); ``log_off`` already
-    accounts for that splice. Returns ``(cand, log_off)`` where
-    ``log_off`` is the start of the contiguous log block in the FINAL
-    (post-splice) layout — its first two columns are the dedup keys.
-    """
-    cand_cols = [exp.flat,
-                 jnp.repeat(exp.ebits, n_actions)[:, None],
-                 exp.chi[:, None], exp.clo[:, None],
-                 jnp.repeat(p_whi, n_actions)[:, None],
-                 jnp.repeat(p_wlo, n_actions)[:, None]]
-    if symmetry or sound:
-        cand_cols += [exp.ohi[:, None], exp.olo[:, None]]
-    cand = jnp.concatenate(cand_cols, axis=1)
-    log_off = width + 3 if sound else width + 1
-    return cand, log_off
-
-
 def assemble_candidates(rows_k, ebits_k, s_chi, s_clo, pw_hi, pw_lo,
                         o_hi, o_lo, width: int, symmetry: bool,
                         sound: bool, nk_hi=None, nk_lo=None):
     """ONE source of truth for the candidate-matrix column layout, built
-    from pre-gathered per-lane columns (the gather-early engines): the
-    same contract as :func:`candidate_matrix` —
+    from pre-gathered per-lane columns (the gather-early engines). The
+    column order makes the queue block and the log block each ONE
+    contiguous slice of the compacted matrix:
 
       [packed row (0..W-1) | child ebits (W) | state fp hi/lo (W+1,W+2)
        | (node key hi/lo at W+3,W+4 under sound)
        | parent key hi/lo | original fp hi/lo (symmetry/sound only)]
 
     so the queue block is ``[:, :W+3]`` and the log block the contiguous
-    slice from the returned ``log_off``. Under ``sound`` pass the node
-    keys (``nk_hi``/``nk_lo``); they are spliced at W+3."""
+    slice from the returned ``log_off`` (its first two columns are the
+    dedup keys). Under ``sound`` pass the node keys
+    (``nk_hi``/``nk_lo``); they are spliced at W+3."""
     cand_cols = [rows_k, ebits_k[:, None],
                  s_chi[:, None], s_clo[:, None],
                  pw_hi[:, None], pw_lo[:, None]]
@@ -203,9 +178,9 @@ def assemble_candidates(rows_k, ebits_k, s_chi, s_clo, pw_hi, pw_lo,
 
 
 def splice_node_keys(k_all, width: int, nk_hi, nk_lo):
-    """Insert the node-key columns at W+3 (sound mode, post-compaction)
-    — the splice :func:`candidate_matrix`'s ``log_off`` expects: after
-    it, the log block's first two columns are these node keys."""
+    """Insert the node-key columns at W+3 (sound mode) — the splice
+    :func:`assemble_candidates`'s ``log_off`` expects: after it, the log
+    block's first two columns are these node keys."""
     return jnp.concatenate(
         [k_all[:, :width + 3], nk_hi[:, None], nk_lo[:, None],
          k_all[:, width + 3:]], axis=1)
