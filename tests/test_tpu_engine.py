@@ -459,3 +459,27 @@ class TestKmaxOverflowRecovery:
         assert ck.unique_state_count() == 8832  # 2pc.rs:133
         host = TwoPhaseSys(5).checker().spawn_bfs().join()
         assert ck.generated_fingerprints() == host.generated_fingerprints()
+
+
+def test_per_row_hint_path_parity():
+    # opt-in per-row stage-one compaction (tpu_options(hint=N),
+    # device_loop.py): same counts and discoveries as the default
+    # global-compaction path
+    from stateright_tpu.examples.paxos_packed import PackedPaxos
+    ck = (PackedPaxos(1).checker()
+          .tpu_options(capacity=1 << 12, fmax=64, hint=12, race=False)
+          .spawn_tpu().join())
+    assert ck.unique_state_count() == 265
+    assert ck.discovery("value chosen") is not None
+
+
+def test_per_row_hint_overflow_rebuilds():
+    # a hint below the true per-row branching must abort pre-mutation
+    # and rebuild with a grown hint (rmax rides the stats) — the run
+    # still enumerates exactly
+    from stateright_tpu.examples.paxos_packed import PackedPaxos
+    ck = (PackedPaxos(1).checker()
+          .tpu_options(capacity=1 << 12, fmax=64, hint=2, race=False)
+          .spawn_tpu().join())
+    assert ck.unique_state_count() == 265
+    assert ck.profile()["rmax"] > 2  # the observed bound that grew it
