@@ -106,6 +106,39 @@ class Actor:
     def on_timeout(self, id: Id, state: Any, o: Out) -> Optional[Any]:
         return None
 
+    # --- crash–restart fault injection (``ActorModel.crash_restart``) ----
+    def durable(self, id: Id, state: Any) -> Any:
+        """The substate that survives a crash (stable storage).
+
+        The default is ``None``: nothing survives, the fail-stop model.
+        Actors with durable state (an fsync'd log, an acceptor's promised
+        ballot) return the persisted projection of ``state``; the checker
+        hands it back via :meth:`on_restart`.
+        """
+        return None
+
+    def on_restart(self, id: Id, durable: Any, o: Out) -> Any:
+        """Rebuild state after a crash–restart; returns the new state.
+
+        The default re-runs :meth:`on_start` — a restarted actor rejoins
+        exactly like a fresh boot, ignoring ``durable`` (which is ``None``
+        unless :meth:`durable` was overridden). Actors that persist state
+        override this to merge ``durable`` back in.
+        """
+        return self.on_start(id, o)
+
+
+@dataclass(frozen=True)
+class Down:
+    """State marker for a crashed actor: volatile state is gone; only the
+    :meth:`Actor.durable` projection rides along until the matching
+    ``Restart`` action. Injected by ``ActorModel.crash_restart``."""
+    durable: Any = None
+
+    def rewrite(self, plan):
+        from ..checker.representative import rewrite_value
+        return Down(rewrite_value(self.durable, plan))
+
 
 def is_no_op(next_state: Optional[Any], out: Out) -> bool:
     """True if the actor neither changed state nor emitted commands

@@ -13,19 +13,28 @@ from dataclasses import dataclass
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 from ..core import Expectation, Model, Property
-from .core import (Actor, CancelTimer, Envelope, Id, Out, Send, SetTimer,
-                   is_no_op)
+from .core import (Actor, CancelTimer, Down, Envelope, Id, Out, Send,
+                   SetTimer, is_no_op)
 from .network import Network, Ordered
 
 
 @dataclass(frozen=True)
 class ActorModelState:
     """Snapshot of the entire actor system
-    (`src/actor/model_state.rs:10-15`)."""
+    (`src/actor/model_state.rs:10-15`).
+
+    ``crashes`` is ``None`` unless crash–restart fault injection is
+    configured (``ActorModel.crash_restart``), keeping state identity —
+    and thus fingerprints — bit-stable for existing models. With
+    injection on it is the per-actor crash-count tuple; a down actor
+    additionally has its slot in ``actor_states`` replaced by a
+    :class:`~stateright_tpu.actor.core.Down` marker.
+    """
     actor_states: Tuple[Any, ...]
     network: Network
     is_timer_set: Tuple[bool, ...]
     history: Any = None
+    crashes: Any = None
 
     def representative(self) -> "ActorModelState":
         """Symmetry canonicalization: sort actor states and rewrite ids
@@ -37,6 +46,8 @@ class ActorModelState:
             network=rewrite_value(self.network, plan),
             is_timer_set=plan.reindex(self.is_timer_set),
             history=rewrite_value(self.history, plan),
+            crashes=(None if self.crashes is None
+                     else plan.reindex(self.crashes)),
         )
 
 
@@ -64,6 +75,19 @@ class Timeout:
     id: Id
 
 
+@dataclass(frozen=True)
+class Crash:
+    """Fault injection: actor ``id`` loses its volatile state and timer;
+    only its ``Actor.durable()`` projection survives until ``Restart``."""
+    id: Id
+
+
+@dataclass(frozen=True)
+class Restart:
+    """Fault injection: a down actor rejoins via ``Actor.on_restart``."""
+    id: Id
+
+
 class ActorModel(Model):
     """Builder + ``Model`` implementation (`model.rs:79-155`, `:187-494`).
 
@@ -77,6 +101,8 @@ class ActorModel(Model):
         self.init_history = init_history
         self.init_network_: Network = Network.new_unordered_duplicating()
         self.lossy_network_: bool = False
+        self.max_crashes_: int = 0
+        self.crashable_: Optional[Tuple[int, ...]] = None
         self.properties_: List[Property] = []
         self.record_msg_in_: Callable = lambda cfg, history, env: None
         self.record_msg_out_: Callable = lambda cfg, history, env: None
@@ -98,6 +124,28 @@ class ActorModel(Model):
     def lossy_network(self, lossy: bool) -> "ActorModel":
         self.lossy_network_ = lossy
         return self
+
+    def crash_restart(self, max_crashes: int,
+                      actors: Optional[Iterable[int]] = None) \
+            -> "ActorModel":
+        """Enable crash–restart fault injection: each eligible actor may
+        crash up to ``max_crashes`` times (the bound keeps the state
+        space finite). A ``Crash`` wipes the actor's volatile state —
+        only its :meth:`~stateright_tpu.actor.core.Actor.durable`
+        projection survives — and cancels its timer; while down the
+        actor takes no deliveries or timeouts (its in-flight messages
+        wait in the network). A ``Restart`` rejoins it via
+        :meth:`~stateright_tpu.actor.core.Actor.on_restart`. ``actors``
+        restricts which actor indices may crash (default: all)."""
+        self.max_crashes_ = int(max_crashes)
+        self.crashable_ = None if actors is None \
+            else tuple(sorted({int(a) for a in actors}))
+        return self
+
+    def _crashable_indices(self) -> List[int]:
+        if self.crashable_ is None:
+            return list(range(len(self.actors)))
+        return [i for i in self.crashable_ if i < len(self.actors)]
 
     def property(self, *args):
         """Two roles, as in the reference: with one argument, the ``Model``
@@ -158,7 +206,9 @@ class ActorModel(Model):
                 id, out, actor_states, network, is_timer_set, history)
         return [ActorModelState(
             actor_states=tuple(actor_states), network=network,
-            is_timer_set=tuple(is_timer_set), history=history)]
+            is_timer_set=tuple(is_timer_set), history=history,
+            crashes=((0,) * len(self.actors) if self.max_crashes_
+                     else None))]
 
     def actions(self, state: ActorModelState, actions: List) -> None:
         # iter_deliverable already yields exactly one head per ordered
@@ -167,14 +217,36 @@ class ActorModel(Model):
             # option 1: message is lost
             if self.lossy_network_:
                 actions.append(Drop(env))
-            # option 2: message is delivered (ignored if recipient DNE)
-            if int(env.dst) < len(self.actors):
+            # option 2: message is delivered (ignored if recipient DNE or
+            # is down — a crashed actor's messages wait in the network)
+            if int(env.dst) < len(self.actors) \
+                    and not isinstance(state.actor_states[int(env.dst)],
+                                       Down):
                 actions.append(Deliver(src=env.src, dst=env.dst,
                                        msg=env.msg))
         # option 3: actor timeout
         for index, is_scheduled in enumerate(state.is_timer_set):
-            if is_scheduled:
+            if is_scheduled \
+                    and not isinstance(state.actor_states[index], Down):
                 actions.append(Timeout(Id(index)))
+        # options 4/5: crash–restart fault injection
+        if self.max_crashes_:
+            for index in self._crashable_indices():
+                if isinstance(state.actor_states[index], Down):
+                    actions.append(Restart(Id(index)))
+                elif state.crashes[index] < self.max_crashes_:
+                    actions.append(Crash(Id(index)))
+
+    # --- crash–restart projection hooks ----------------------------------
+    # PackedActorModel overrides both for bit-parity with the device
+    # kernels (the durable projection is the packed word mask there).
+    def _crash_durable(self, index: int, state: Any) -> Any:
+        """What survives actor ``index`` crashing in ``state``."""
+        return self.actors[index].durable(Id(index), state)
+
+    def _restart_state(self, index: int, durable: Any, out: Out) -> Any:
+        """The post-restart state (commands land in ``out``)."""
+        return self.actors[index].on_restart(Id(index), durable, out)
 
     def next_state(self, last_sys_state: ActorModelState,
                    action: Any) -> Optional[ActorModelState]:
@@ -183,13 +255,52 @@ class ActorModel(Model):
                 actor_states=last_sys_state.actor_states,
                 network=last_sys_state.network.on_drop(action.envelope),
                 is_timer_set=last_sys_state.is_timer_set,
-                history=last_sys_state.history)
+                history=last_sys_state.history,
+                crashes=last_sys_state.crashes)
+
+        if isinstance(action, Crash):
+            index = int(action.id)
+            state = last_sys_state.actor_states[index]
+            if isinstance(state, Down) \
+                    or last_sys_state.crashes[index] >= self.max_crashes_:
+                return None
+            actor_states = list(last_sys_state.actor_states)
+            actor_states[index] = Down(self._crash_durable(index, state))
+            is_timer_set = list(last_sys_state.is_timer_set)
+            is_timer_set[index] = False  # the pending timer dies too
+            crashes = list(last_sys_state.crashes)
+            crashes[index] += 1
+            return ActorModelState(
+                actor_states=tuple(actor_states),
+                network=last_sys_state.network,
+                is_timer_set=tuple(is_timer_set),
+                history=last_sys_state.history, crashes=tuple(crashes))
+
+        if isinstance(action, Restart):
+            index = int(action.id)
+            down = last_sys_state.actor_states[index]
+            if not isinstance(down, Down):
+                return None
+            out = Out()
+            actor_states = list(last_sys_state.actor_states)
+            actor_states[index] = self._restart_state(
+                index, down.durable, out)
+            is_timer_set = list(last_sys_state.is_timer_set)
+            network, history = self._process_commands(
+                Id(index), out, actor_states, last_sys_state.network,
+                is_timer_set, last_sys_state.history)
+            return ActorModelState(
+                actor_states=tuple(actor_states), network=network,
+                is_timer_set=tuple(is_timer_set), history=history,
+                crashes=last_sys_state.crashes)
 
         if isinstance(action, Deliver):
             index = int(action.dst)
             if index >= len(last_sys_state.actor_states):
                 return None  # not all messages can be delivered
             last_actor_state = last_sys_state.actor_states[index]
+            if isinstance(last_actor_state, Down):
+                return None  # recipient is crashed; the message waits
             out = Out()
             next_actor_state = self.actors[index].on_msg(
                 action.dst, last_actor_state, action.src, action.msg, out)
@@ -211,10 +322,13 @@ class ActorModel(Model):
                 history)
             return ActorModelState(
                 actor_states=tuple(actor_states), network=network,
-                is_timer_set=tuple(is_timer_set), history=history)
+                is_timer_set=tuple(is_timer_set), history=history,
+                crashes=last_sys_state.crashes)
 
         if isinstance(action, Timeout):
             index = int(action.id)
+            if isinstance(last_sys_state.actor_states[index], Down):
+                return None  # the crash cancelled the timer
             out = Out()
             next_actor_state = self.actors[index].on_timeout(
                 action.id, last_sys_state.actor_states[index], out)
@@ -231,7 +345,8 @@ class ActorModel(Model):
                 is_timer_set, last_sys_state.history)
             return ActorModelState(
                 actor_states=tuple(actor_states), network=network,
-                is_timer_set=tuple(is_timer_set), history=history)
+                is_timer_set=tuple(is_timer_set), history=history,
+                crashes=last_sys_state.crashes)
 
         raise TypeError(f"unknown action {action!r}")
 
@@ -330,6 +445,13 @@ class ActorModel(Model):
                 x, y = plot(int(action.id), time)
                 parts.append(f"<text x='{x}' y='{y}' "
                              "class='svg-event-label'>Timeout</text>")
+            elif isinstance(action, (Crash, Restart)):
+                x, y = plot(int(action.id), time)
+                label = "Crash" if isinstance(action, Crash) else "Restart"
+                parts.append(f"<rect x='{x - 8}' y='{y - 8}' width='16' "
+                             "height='16' class='svg-event-shape' />")
+                parts.append(f"<text x='{x}' y='{y}' "
+                             f"class='svg-event-label'>{label}</text>")
 
         parts.append("</svg>")
         return "".join(parts)
@@ -337,4 +459,8 @@ class ActorModel(Model):
     def format_action(self, action: Any) -> str:
         if isinstance(action, Deliver):
             return f"{action.src!r} → {action.msg!r} → {action.dst!r}"
+        if isinstance(action, Crash):
+            return f"Crash({int(action.id)})"
+        if isinstance(action, Restart):
+            return f"Restart({int(action.id)})"
         return repr(action)

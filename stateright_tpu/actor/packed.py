@@ -57,13 +57,25 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ..models.packed import PackedModel
-from .core import Envelope, Id
+from .core import Down, Envelope, Id, Out
 from .model import ActorModel, ActorModelState
 from .network import (Ordered, UnorderedDuplicating,
                       UnorderedNonDuplicating)
 
 _OCC = 1 << 16  # slot-occupied flag in the hdr word
 _EMPTY_SORT_KEY = 0xFFFFFFFF  # empties sort last
+
+_LOSSY_ORDERED_MESSAGE = (
+    "lossy ordered networks are not supported on the device engine (no "
+    "Drop lanes for FIFO channels yet). Check this model on the host "
+    "engines instead — checker().spawn_bfs() or .spawn_dfs() explore "
+    "the identical Drop interleavings and reach identical discoveries.")
+
+_CRASH_ORDERED_MESSAGE = (
+    "crash_restart() on an ordered network is not supported on the "
+    "device engine yet. Check this model on the host engines instead — "
+    "checker().spawn_bfs() or .spawn_dfs() explore the identical "
+    "Crash/Restart interleavings and reach identical discoveries.")
 
 
 class PackedActorModel(ActorModel, PackedModel):
@@ -137,28 +149,73 @@ class PackedActorModel(ActorModel, PackedModel):
             self._sw = 2 + self.msg_width  # hdr, count, msg words
             self._timer_off = self._net_off \
                 + self.net_capacity * self._sw
-        self._hist_off = self._timer_off + 1
+        # crash–restart: one extra word of per-actor nibbles right after
+        # the timer word — bits [0..2] = crash count, bit 3 = down. Only
+        # present when injection is configured, so the packed layout (and
+        # every fingerprint) of existing models is untouched.
+        a = len(self.actor_widths)
+        if self.max_crashes_:
+            if a > 8:
+                raise NotImplementedError(
+                    "crash_restart() on the device engine packs per-actor "
+                    "crash nibbles into one word: at most 8 actors")
+            if self.max_crashes_ > 7:
+                raise NotImplementedError(
+                    "crash_restart(max_crashes=k) supports k <= 7 on the "
+                    "device engine (3-bit crash counters)")
+            self._crash_off = self._timer_off + 1
+            self._crash_idx = np.asarray(self._crashable_indices(),
+                                         np.int32)
+            owner = np.zeros((self._aw,), np.int32)
+            durable = np.zeros((self._aw,), np.uint32)
+            for i in range(a):
+                lo, hi = self._actor_off[i], self._actor_off[i + 1]
+                owner[lo:hi] = i
+                mask = list(self.durable_word_mask(i))
+                if len(mask) != self.actor_widths[i]:
+                    raise ValueError(
+                        f"durable_word_mask({i}) returned {len(mask)} "
+                        f"entries; the declared actor width is "
+                        f"{self.actor_widths[i]}")
+                durable[lo:hi] = [1 if m else 0 for m in mask]
+            self._word_owner = owner
+            self._word_durable = durable
+            self._hist_off = self._crash_off + 1
+        else:
+            self._hist_off = self._timer_off + 1
         self.packed_width = self._hist_off + self.history_width
         if self.history_width:
             # host properties (e.g. consistency testers) read the history
             self.host_property_cols = (self._hist_off, self.history_width)
 
+    def crash_restart(self, max_crashes, actors=None):
+        """See :meth:`ActorModel.crash_restart`. Unlike ``lossy_network``
+        this changes the packed layout (the crash-nibble word), so the
+        layout is recomputed if already finalized."""
+        super().crash_restart(max_crashes, actors)
+        if hasattr(self, "_actor_off"):
+            self.finalize_layout()
+        return self
+
     @property
     def max_actions(self) -> int:
         # a lossy network doubles the axis: action E + e drops slot e;
-        # ``device_timers`` appends one Timeout lane per actor. Computed
-        # on demand because ``lossy_network(...)`` may be set after
-        # construction (the compiled-program caches key on it).
+        # ``device_timers`` appends one Timeout lane per actor and
+        # ``crash_restart`` one Crash + one Restart lane per crashable
+        # actor. Computed on demand because ``lossy_network(...)`` may be
+        # set after construction (the compiled-program caches key on it).
         if self._net_ordered:
             if self.lossy_network_:
-                raise NotImplementedError(
-                    "lossy ordered networks are host-only on the device "
-                    "engine (no Drop lanes for FIFO channels yet)")
+                raise NotImplementedError(_LOSSY_ORDERED_MESSAGE)
+            if self.max_crashes_:
+                raise NotImplementedError(_CRASH_ORDERED_MESSAGE)
             n = self._n_chan
         else:
             n = self.net_capacity * (2 if self.lossy_network_ else 1)
         if self.device_timers:
             n += len(self.actor_widths)
+        if self.max_crashes_:
+            n += 2 * len(self._crash_idx)
         return n
 
     # --- subclass interface ----------------------------------------------
@@ -210,6 +267,56 @@ class PackedActorModel(ActorModel, PackedModel):
         """
         raise NotImplementedError
 
+    # --- crash–restart (``crash_restart``) --------------------------------
+    def durable_word_mask(self, index: int) -> List[int]:
+        """Per-word 0/1 mask of actor ``index``'s crash-surviving words.
+
+        A device Crash wipes the non-durable words to zero with
+        ``jnp.where``; the host projection (:meth:`_crash_durable`)
+        applies the identical mask through the codec, so both engines
+        agree bit-for-bit. Default: all zeros — nothing survives, the
+        fail-stop model."""
+        return [0] * self.actor_widths[index]
+
+    def packed_on_restart(self, actors, aidx):
+        """JAX restart kernel (the device ``Actor.on_restart``).
+
+        Args:
+          actors: uint32[AW] concatenated actor states — actor ``aidx``'s
+            words already hold only its durable content (the crash wiped
+            the rest);
+          aidx: traced uint32 index of the restarting actor.
+        Returns:
+          (new_actors uint32[AW],
+           sends like :meth:`packed_deliver`,
+           set_timer bool — True to arm the restarted actor's timer).
+
+        Default: adopt the durable words as the new state, emit nothing —
+        the mirror of the host default :meth:`_restart_state`. Override
+        BOTH together for richer recovery (e.g. announce-rejoin sends).
+        """
+        import jax.numpy as jnp
+        zmsg = jnp.zeros((self.msg_width,), jnp.uint32)
+        sends = [(jnp.uint32(0), zmsg, jnp.bool_(False))
+                 for _ in range(self.max_sends)]
+        return actors, sends, jnp.bool_(False)
+
+    def _crash_durable(self, index: int, state: Any) -> Any:
+        """Host-side crash projection, bit-identical to the device wipe:
+        encode, zero the volatile words, decode. (The actor-level
+        ``durable()`` hook is bypassed — the word mask IS the durable
+        contract for packed models.)"""
+        words = self.encode_actor(index, state)
+        mask = self.durable_word_mask(index)
+        return self.decode_actor(
+            index, [int(w) if m else 0 for w, m in zip(words, mask)])
+
+    def _restart_state(self, index: int, durable: Any, out: Out) -> Any:
+        """Host-side restart, mirroring the default
+        :meth:`packed_on_restart`: adopt the durable projection, emit
+        nothing. Override together with ``packed_on_restart``."""
+        return durable
+
     def packed_record_out(self, history, src, dst, msg):
         """JAX analog of ``record_msg_out`` (applied per valid Send)."""
         return history
@@ -235,7 +342,12 @@ class PackedActorModel(ActorModel, PackedModel):
         out = np.zeros((self.packed_width,), dtype=np.uint32)
         for i, actor_state in enumerate(state.actor_states):
             off = self._actor_off[i]
-            words = self.encode_actor(i, actor_state)
+            if isinstance(actor_state, Down):
+                # a crashed actor's row holds exactly its durable words
+                # (the device wipe leaves the masked words in place)
+                words = self.encode_actor(i, actor_state.durable)
+            else:
+                words = self.encode_actor(i, actor_state)
             if len(words) != self.actor_widths[i]:
                 raise ValueError(
                     f"encode_actor({i}) returned {len(words)} words; the "
@@ -304,6 +416,15 @@ class PackedActorModel(ActorModel, PackedModel):
         for i, set_ in enumerate(state.is_timer_set):
             timer |= int(bool(set_)) << i
         out[self._timer_off] = timer
+        if self.max_crashes_:
+            crashes = state.crashes \
+                or (0,) * len(state.actor_states)
+            cw = 0
+            for i, actor_state in enumerate(state.actor_states):
+                cw |= (int(crashes[i]) & 7) << (4 * i)
+                if isinstance(actor_state, Down):
+                    cw |= 1 << (4 * i + 3)
+            out[self._crash_off] = cw
         if self.history_width:
             hwords = self.encode_history(state.history)
             assert len(hwords) == self.history_width
@@ -350,10 +471,19 @@ class PackedActorModel(ActorModel, PackedModel):
         timer = words[self._timer_off]
         is_timer_set = tuple(bool((timer >> i) & 1)
                              for i in range(len(self.actor_widths)))
+        crashes = None
+        if self.max_crashes_:
+            cw = words[self._crash_off]
+            crashes = tuple((cw >> (4 * i)) & 7
+                            for i in range(len(self.actor_widths)))
+            actor_states = tuple(
+                Down(st) if (cw >> (4 * i + 3)) & 1 else st
+                for i, st in enumerate(actor_states))
         history = self.decode_history(words[self._hist_off:]) \
             if self.history_width else self.init_history
         return ActorModelState(actor_states=actor_states, network=network,
-                               is_timer_set=is_timer_set, history=history)
+                               is_timer_set=is_timer_set, history=history,
+                               crashes=crashes)
 
     # --- device step -------------------------------------------------------
     def _net_consume(self, slots, e):
@@ -474,6 +604,7 @@ class PackedActorModel(ActorModel, PackedModel):
             .reshape(n_chan, d, mw)
         hist = words[self._hist_off:] if hw else None
         timer = words[self._timer_off:self._timer_off + 1]
+        crash = words[self._timer_off + 1:self._hist_off]
 
         chan_src = jnp.asarray(self._chan_src)
         chan_dst = jnp.asarray(self._chan_dst)
@@ -534,7 +665,8 @@ class PackedActorModel(ActorModel, PackedModel):
                 new_lens, new_msgs, new_hist, overflow = append_send(
                     new_lens, new_msgs, new_hist, overflow,
                     dst, sdst.astype(jnp.uint32), smsg, svalid)
-            parts = [new_actors, new_lens, new_msgs.reshape(-1), timer]
+            parts = [new_actors, new_lens, new_msgs.reshape(-1), timer,
+                     crash]
             if hw:
                 parts.append(new_hist)
             row_out = jnp.concatenate(parts).astype(jnp.uint32)
@@ -558,7 +690,7 @@ class PackedActorModel(ActorModel, PackedModel):
                 new_tw = (tw & ~(jnp.uint32(1) << aidx)) \
                     | (keep.astype(jnp.uint32) << aidx)
                 t_parts = [t_actors, t_lens, t_msgs.reshape(-1),
-                           new_tw[None]]
+                           new_tw[None], crash]
                 if hw:
                     t_parts.append(t_hist)
                 t_row = jnp.concatenate(t_parts).astype(jnp.uint32)
@@ -583,12 +715,23 @@ class PackedActorModel(ActorModel, PackedModel):
         lossy = self.lossy_network_
         dup = self._net_dup
         timers_on = self.device_timers
+        crashes_on = bool(self.max_crashes_)
         base = e_cap * (2 if lossy else 1)
         actors = words[:aw]
         slots = words[self._net_off:self._timer_off].reshape(e_cap, sw)
         hist = words[self._hist_off:] if hw else None
         n_actors = len(self.actor_widths)
         timer = words[self._timer_off:self._timer_off + 1]
+        # the crash-nibble word rides between timer and history; the
+        # slice is empty when injection is off, so appending it to every
+        # successor row is a no-op there
+        crash = words[self._timer_off + 1:self._hist_off]
+        if crashes_on:
+            n_cr = len(self._crash_idx)
+            cr_base = base + (n_actors if timers_on else 0)
+            crash_idx = jnp.asarray(self._crash_idx)
+            word_owner = jnp.asarray(self._word_owner)
+            word_durable = jnp.asarray(self._word_durable).astype(bool)
 
         def one_action(a):
             # the action axis is vmapped (not unrolled): one traced copy
@@ -614,6 +757,12 @@ class PackedActorModel(ActorModel, PackedModel):
                 any_send = any_send | svalid
             # no-op pruning (model.rs:259-260) + recipient existence
             valid = occupied & (dst < n_actors) & (changed | any_send)
+            if crashes_on:
+                # a down recipient takes no deliveries (its messages
+                # wait in the network until Restart)
+                dst_nib = jnp.minimum(dst, n_actors - 1) * 4
+                dst_down = ((crash[0] >> (dst_nib + 3)) & 1).astype(bool)
+                valid = valid & ~dst_down
 
             # a duplicating delivery leaves the envelope in flight
             # (redelivery stays possible, `network.rs:199-236`)
@@ -633,7 +782,7 @@ class PackedActorModel(ActorModel, PackedModel):
                     sdst.astype(jnp.uint32), smsg, svalid)
                 overflow = overflow | ovf
 
-            parts = [new_actors, new_slots.reshape(-1), timer]
+            parts = [new_actors, new_slots.reshape(-1), timer, crash]
             if hw:
                 parts.append(new_hist)
             row_out = jnp.concatenate(parts).astype(jnp.uint32)
@@ -645,7 +794,8 @@ class PackedActorModel(ActorModel, PackedModel):
                 # so validity is just occupancy
                 drop_slots = (self._net_remove(slots, e) if dup
                               else self._net_consume(slots, e))
-                drop_parts = [actors, drop_slots.reshape(-1), timer]
+                drop_parts = [actors, drop_slots.reshape(-1), timer,
+                              crash]
                 if hw:
                     drop_parts.append(hist)
                 drop_row = jnp.concatenate(drop_parts).astype(jnp.uint32)
@@ -662,10 +812,11 @@ class PackedActorModel(ActorModel, PackedModel):
                 # command, which is unsatisfiable — so a no-op handler
                 # that re-sets its timer yields a self-loop successor
                 # (harmless: dedup eats it), and validity here is just
-                # the timer bit
-                is_timeout = a >= base
-                aidx = jnp.minimum(a - base, n_actors - 1) \
-                    .astype(jnp.uint32)
+                # the timer bit (a crash clears it, so down actors never
+                # fire)
+                is_timeout = (a >= base) & (a < base + n_actors)
+                aidx = jnp.minimum(jnp.maximum(a - base, 0),
+                                   n_actors - 1).astype(jnp.uint32)
                 tw = timer[0]
                 tbit = ((tw >> aidx) & 1).astype(bool)
                 t_actors, t_changed, t_sends, keep = \
@@ -685,7 +836,8 @@ class PackedActorModel(ActorModel, PackedModel):
                     t_ovf = t_ovf | ovf2
                 new_tw = (tw & ~(jnp.uint32(1) << aidx)) \
                     | (keep.astype(jnp.uint32) << aidx)
-                t_parts = [t_actors, t_slots.reshape(-1), new_tw[None]]
+                t_parts = [t_actors, t_slots.reshape(-1), new_tw[None],
+                           crash]
                 if hw:
                     t_parts.append(t_hist)
                 t_row = jnp.concatenate(t_parts).astype(jnp.uint32)
@@ -693,6 +845,72 @@ class PackedActorModel(ActorModel, PackedModel):
                 row_out = jnp.where(is_timeout, t_row, row_out)
                 valid = jnp.where(is_timeout, t_valid, valid)
                 overflow = jnp.where(is_timeout, t_ovf, overflow)
+
+            if crashes_on:
+                # Crash/Restart lanes: lane cr_base + c crashes the c-th
+                # crashable actor, lane cr_base + n_cr + c restarts it.
+                # Crash wipes the actor's volatile words (jnp.where over
+                # the static durable mask), clears its timer bit, and
+                # bumps its crash nibble; Restart clears the down bit and
+                # runs the packed_on_restart kernel over the surviving
+                # durable words. Both always yield a successor (the
+                # nibble word changes), mirroring the host semantics.
+                is_crash = (a >= cr_base) & (a < cr_base + n_cr)
+                is_restart = a >= cr_base + n_cr
+                ci = jnp.clip(
+                    jnp.where(is_restart, a - cr_base - n_cr,
+                              a - cr_base), 0, n_cr - 1)
+                aidx = crash_idx[ci].astype(jnp.uint32)
+                nib = aidx * 4
+                cw = crash[0]
+                cnt = (cw >> nib) & 7
+                dbit = ((cw >> (nib + 3)) & 1).astype(bool)
+
+                wipe = (word_owner == aidx.astype(jnp.int32)) \
+                    & ~word_durable
+                c_actors = jnp.where(wipe, jnp.uint32(0), actors)
+                c_timer = timer[0] & ~(jnp.uint32(1) << aidx)
+                # cnt < max_crashes when valid, so +1 never carries into
+                # the down bit
+                c_cw = (cw + (jnp.uint32(1) << nib)) \
+                    | (jnp.uint32(1) << (nib + 3))
+                c_parts = [c_actors, slots.reshape(-1), c_timer[None],
+                           c_cw[None]]
+                if hw:
+                    c_parts.append(hist)
+                c_row = jnp.concatenate(c_parts).astype(jnp.uint32)
+                c_valid = ~dbit & (cnt < self.max_crashes_)
+
+                r_actors, r_sends, r_set_timer = \
+                    self.packed_on_restart(actors, aidx)
+                r_slots = slots
+                r_hist = hist
+                r_ovf = jnp.bool_(False)
+                for sdst, smsg, svalid in r_sends:
+                    smsg = smsg.astype(jnp.uint32)
+                    if hw:
+                        rec = self.packed_record_out(
+                            r_hist, aidx, sdst, smsg)
+                        r_hist = jnp.where(svalid, rec, r_hist)
+                    r_slots, ovf3 = self._net_send(
+                        r_slots, aidx, sdst.astype(jnp.uint32), smsg,
+                        svalid)
+                    r_ovf = r_ovf | ovf3
+                r_timer = timer[0] \
+                    | (r_set_timer.astype(jnp.uint32) << aidx)
+                r_cw = cw & ~(jnp.uint32(1) << (nib + 3))
+                r_parts = [r_actors, r_slots.reshape(-1), r_timer[None],
+                           r_cw[None]]
+                if hw:
+                    r_parts.append(r_hist)
+                r_row = jnp.concatenate(r_parts).astype(jnp.uint32)
+
+                row_out = jnp.where(is_crash, c_row, row_out)
+                valid = jnp.where(is_crash, c_valid, valid)
+                overflow = overflow & ~is_crash
+                row_out = jnp.where(is_restart, r_row, row_out)
+                valid = jnp.where(is_restart, dbit, valid)
+                overflow = jnp.where(is_restart, r_ovf, overflow)
 
             # an overflowing successor would silently drop a message and
             # under-explore the state graph: poison + invalidate the row

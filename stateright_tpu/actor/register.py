@@ -168,6 +168,16 @@ class RegisterServer(Actor):
         inner = self.server_actor.on_timeout(id, state.state, o)
         return None if inner is None else ServerState(inner)
 
+    # crash–restart hooks delegate to the wrapped server (unwrapping the
+    # ServerState tag, re-wrapping on the way back)
+    def durable(self, id, state):
+        if not isinstance(state, ServerState):
+            return None
+        return self.server_actor.durable(id, state.state)
+
+    def on_restart(self, id, durable, o):
+        return ServerState(self.server_actor.on_restart(id, durable, o))
+
 
 # --- wire serde for the spawn runtime (`register.rs` + serde_json shape) ----
 

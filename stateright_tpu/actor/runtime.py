@@ -31,25 +31,53 @@ def _practically_never() -> float:
 
 
 class SpawnHandle:
-    """Join handle for a spawned actor cluster."""
+    """Join handle for a spawned actor cluster.
+
+    Actor-thread startup failures (a socket bind error — port already
+    taken, privileged port, bad address) no longer die silently inside
+    the daemon thread: they are recorded per actor and re-raised from
+    :meth:`join`/:meth:`stop`, so a cluster that failed to come up reads
+    as a failure, not a hang.
+    """
 
     def __init__(self, threads: List[threading.Thread],
-                 stop_event: threading.Event):
+                 stop_event: threading.Event,
+                 failures: List[Tuple[Id, BaseException]]):
         self._threads = threads
         self._stop = stop_event
+        self._failures = failures
+
+    def failures(self) -> List[Tuple[Id, BaseException]]:
+        """(actor id, exception) pairs for threads that died on an
+        unhandled error (typically a socket bind failure at startup)."""
+        return list(self._failures)
+
+    def _raise_failures(self) -> None:
+        if not self._failures:
+            return
+        lines = ", ".join(
+            f"actor {int(id)} ({'.'.join(map(str, id.socket_addr()[0]))}"
+            f":{id.socket_addr()[1]}): {exc!r}"
+            for id, exc in self._failures)
+        raise RuntimeError(
+            f"{len(self._failures)} actor thread(s) failed: {lines}") \
+            from self._failures[0][1]
 
     def join(self, timeout: Optional[float] = None) -> None:
-        """Block until the actors exit (they normally never do)."""
+        """Block until the actors exit (they normally never do); raises
+        if any actor thread died on an unhandled error."""
         deadline = None if timeout is None else time.monotonic() + timeout
         for t in self._threads:
             remaining = None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
             t.join(remaining)
+        self._raise_failures()
 
     def stop(self) -> None:
         """Signal all actor threads to exit (test/teardown helper; the
         reference blocks forever, but a Python runtime needs clean
-        shutdown for in-process smoke tests)."""
+        shutdown for in-process smoke tests). Raises if any actor thread
+        died on an unhandled error."""
         self._stop.set()
         self.join(timeout=2.0)
 
@@ -57,7 +85,21 @@ class SpawnHandle:
 def _actor_thread(id: Id, actor: Actor,
                   serialize: Callable[[Any], bytes],
                   deserialize: Callable[[bytes], Any],
-                  stop: threading.Event) -> None:
+                  stop: threading.Event,
+                  failures: List[Tuple[Id, BaseException]]) -> None:
+    try:
+        _actor_loop(id, actor, serialize, deserialize, stop)
+    except Exception as e:
+        # surface the failure on the SpawnHandle (raised from
+        # join()/stop()) instead of dying silently in a daemon thread
+        log.error("Actor thread failed. id=%s, err=%r", int(id), e)
+        failures.append((id, e))
+
+
+def _actor_loop(id: Id, actor: Actor,
+                serialize: Callable[[Any], bytes],
+                deserialize: Callable[[bytes], Any],
+                stop: threading.Event) -> None:
     ip, port = id.socket_addr()
     addr = (".".join(map(str, ip)), port)
     sock = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
@@ -142,6 +184,7 @@ def spawn(serialize: Callable[[Any], bytes],
     """
     stop = threading.Event()
     threads: List[threading.Thread] = []
+    failures: List[Tuple[Id, BaseException]] = []
     for raw_id, actor in actors:
         if isinstance(raw_id, Id):
             id = raw_id
@@ -150,12 +193,12 @@ def spawn(serialize: Callable[[Any], bytes],
             id = Id.from_socket_addr(tuple(ip), port)
         t = threading.Thread(
             target=_actor_thread,
-            args=(id, actor, serialize, deserialize, stop),
+            args=(id, actor, serialize, deserialize, stop, failures),
             daemon=True,
             name=f"actor-{int(id)}")
         t.start()
         threads.append(t)
-    handle = SpawnHandle(threads, stop)
+    handle = SpawnHandle(threads, stop, failures)
     if not background:
         handle.join()
     return handle

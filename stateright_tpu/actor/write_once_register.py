@@ -235,3 +235,13 @@ class WORegisterServer(Actor):
             return None
         inner = self.server_actor.on_timeout(id, state.state, o)
         return None if inner is None else ServerState(inner)
+
+    # crash–restart hooks delegate to the wrapped server (unwrapping the
+    # ServerState tag, re-wrapping on the way back)
+    def durable(self, id, state):
+        if not isinstance(state, ServerState):
+            return None
+        return self.server_actor.durable(id, state.state)
+
+    def on_restart(self, id, durable, o):
+        return ServerState(self.server_actor.on_restart(id, durable, o))

@@ -203,7 +203,9 @@ def model_cache_key(model):
     mkey = model.cache_key()
     if mkey is None:
         return None
-    return (type(model), mkey, getattr(model, "lossy_network_", None))
+    return (type(model), mkey, getattr(model, "lossy_network_", None),
+            getattr(model, "max_crashes_", None),
+            getattr(model, "crashable_", None))
 
 
 def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,

@@ -1579,11 +1579,34 @@ class TpuChecker(HostChecker):
             "symmetry": bool(self._symmetry),
             "sound": bool(self._sound),
         })
-        np.savez_compressed(
-            path, child=child, parent=parent, rows=rows, ebits=ebits,
-            ffps=ffps, okeys=okeys, ovals=ovals,
-            state_count=np.int64(self._state_count),
-            meta=np.asarray(meta))
+        # crash-safe write: the .npz lands in a temp file in the target
+        # directory and is os.replace()d into place, so an interrupted
+        # checkpoint (SIGKILL, full disk, ...) can never leave a
+        # truncated file where a good one stood. The file object (not a
+        # path) keeps numpy from appending its own .npz suffix.
+        import os
+        import tempfile
+
+        path = os.fspath(path)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".",
+            prefix=os.path.basename(path) + ".", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez_compressed(
+                    f, child=child, parent=parent, rows=rows,
+                    ebits=ebits, ffps=ffps, okeys=okeys, ovals=ovals,
+                    state_count=np.int64(self._state_count),
+                    meta=np.asarray(meta))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def _model_tag(self) -> str:
         """Identity check for resume: a checkpoint only makes sense for
@@ -1606,8 +1629,24 @@ class TpuChecker(HostChecker):
         queue-cached state fingerprints (canonical under symmetry)."""
         import json
 
-        data = np.load(self._resume_path)
-        meta = json.loads(str(data["meta"]))
+        try:
+            data = np.load(self._resume_path)
+            meta = json.loads(str(data["meta"]))
+            for key in ("child", "parent", "rows", "ebits",
+                        "state_count"):
+                data[key]
+        except Exception as e:
+            # anything the load raises — zipfile.BadZipFile for a
+            # truncated archive, KeyError for missing entries, OSError,
+            # json decode errors — means the file is not a usable
+            # checkpoint; surface ONE actionable error instead of a
+            # numpy/zipfile traceback
+            raise RuntimeError(
+                f"cannot resume from {self._resume_path!r}: the "
+                "checkpoint file is corrupt, truncated, or not a "
+                f"Checker.save() file ({type(e).__name__}: {e}). "
+                "Re-create it with save() on a finished resumable "
+                "run.") from e
         if meta["model"] != self._model_tag():
             raise RuntimeError(
                 "checkpoint was written by a different model config: "

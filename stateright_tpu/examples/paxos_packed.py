@@ -110,6 +110,16 @@ class PackedPaxos(PackedRegisterModel):
         return ("paxos", self.client_count, self.server_count,
                 self.net_capacity)
 
+    def durable_word_mask(self, index: int) -> List[int]:
+        """Crash–restart support: a paxos server's entire state is on
+        stable storage (the protocol is *defined* against crash–recovery
+        with durable promises and accepted proposals), so a crash wipes
+        nothing — the fault injected is the downtime itself (deliveries
+        pause while down). Clients stay fail-stop (all-volatile)."""
+        if index < self.server_count:
+            return [1] * self.actor_widths[index]
+        return [0] * self.actor_widths[index]
+
     # ------------------------------------------------------------------
     # server state packing
     # ------------------------------------------------------------------

@@ -1,0 +1,181 @@
+"""Packed write-once register: the crash–restart demonstration pair.
+
+An unreplicated write-once value server ('\\0' = unwritten; the first
+``Put`` wins, a conflicting later ``Put`` gets ``PutFail``) checked by
+put-once register clients with a linearizability history — the workload
+proving ``crash_restart`` finds real bugs:
+
+* ``PackedWriteOnce(c, durable=True)`` models a server whose register
+  value is on stable storage (``durable_word_mask`` keeps the value
+  word). Under ``crash_restart(1)`` it stays linearizable on both the
+  host and the device engine.
+* ``PackedWriteOnce(c, durable=False)`` models the buggy variant: the
+  value lives only in volatile memory, so a crash silently loses an
+  acknowledged write. Both engines must produce a linearizability
+  counterexample whose path contains the ``Crash``/``Restart`` actions
+  (client writes, gets ``PutOk``, server crashes and forgets, client
+  reads '\\0').
+
+The host side IS the ``ActorModel`` semantics — host BFS and ``spawn_tpu``
+enumerate identical state counts and reach identical discoveries, the
+crash–restart parity oracle next to paxos (`tests/test_crash_restart.py`).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, List, Optional
+
+from ..actor.core import Actor, Id, Out
+from ..actor.packed_register import (PackedRegisterModel, T_GET, T_GETOK,
+                                     T_INTERNAL0, T_PUT, T_PUTOK,
+                                     val_char as _val_char,
+                                     val_code as _val_code)
+from ..actor.register import Get, GetOk, Put, PutOk
+
+# write-once failure reply; reuses the first protocol-internal tag slot
+# (this model has no internal messages)
+T_PUTFAIL = T_INTERNAL0
+
+from ..actor.write_once_register import PutFail
+
+
+class WriteOnceActor(Actor):
+    """Unreplicated write-once value server: first ``Put`` wins; a
+    conflicting later ``Put`` fails; re-putting the same value succeeds
+    (mirroring the ``WORegister`` spec semantics). '\\0' = unwritten."""
+
+    def on_start(self, id: Id, o: Out) -> str:
+        return '\0'
+
+    def on_msg(self, id: Id, state: str, src: Id, msg: Any,
+               o: Out) -> Optional[str]:
+        if isinstance(msg, Put):
+            if state == '\0':
+                o.send(src, PutOk(msg.request_id))
+                return msg.value
+            if state == msg.value:
+                o.send(src, PutOk(msg.request_id))
+                return None
+            o.send(src, PutFail(msg.request_id))
+            return None
+        if isinstance(msg, Get):
+            o.send(src, GetOk(msg.request_id, state))
+            return None
+        return None
+
+
+class PackedWriteOnce(PackedRegisterModel):
+    """Write-once value server(s) + C put-once register clients.
+
+    ``durable`` selects whether the server's register value survives a
+    crash (``durable_word_mask``); it is part of the model identity.
+    Enable fault injection with ``.crash_restart(k, actors=[0])``.
+    """
+
+    def __init__(self, client_count: int, server_count: int = 1,
+                 durable: bool = True, net_capacity: int = 8):
+        self.durable_server = bool(durable)
+        self._init_register(
+            client_count, server_count,
+            server_actor=lambda i: WriteOnceActor(),
+            server_width=1,
+            net_capacity=net_capacity,
+            max_sends=1)
+
+    def cache_key(self):
+        return ("write_once", self.client_count, self.server_count,
+                self.net_capacity, self.durable_server)
+
+    def durable_word_mask(self, index: int) -> List[int]:
+        if index < self.server_count and self.durable_server:
+            return [1] * self.actor_widths[index]
+        return [0] * self.actor_widths[index]
+
+    # --- server packing: one word, the stored value ----------------------
+    def encode_server(self, val: str) -> List[int]:
+        return [_val_code(val)]
+
+    def decode_server(self, words: List[int]) -> str:
+        return _val_char(words[0])
+
+    def encode_internal(self, msg: Any) -> List[int]:
+        raise AssertionError("write-once register has no internal msgs")
+
+    def decode_internal(self, words: List[int]) -> Any:
+        raise AssertionError("write-once register has no internal msgs")
+
+    # PutFail rides the register vocabulary (tag T_PUTFAIL)
+    def encode_msg(self, msg: Any) -> List[int]:
+        if isinstance(msg, PutFail):
+            return [(T_PUTFAIL << 24) | (msg.request_id << 12), 0]
+        return super().encode_msg(msg)
+
+    def decode_msg(self, words: List[int]) -> Any:
+        if (words[0] >> 24) == T_PUTFAIL:
+            return PutFail((words[0] >> 12) & 0xFFF)
+        return super().decode_msg(words)
+
+    # --- the masked server kernel ---------------------------------------
+    def _server_step(self, sid, w, src, msg):
+        import jax.numpy as jnp
+
+        val = w[0]
+        mtype = msg[0] >> 24
+        m_rid = (msg[0] >> 12) & 0xFFF
+        m_val = msg[0] & 0xF
+        is_put = mtype == T_PUT
+        is_get = mtype == T_GET
+        unwritten = val == 0
+
+        ok = is_put & (unwritten | (val == m_val))
+        fail = is_put & ~unwritten & (val != m_val)
+        new_val = jnp.where(is_put & unwritten, m_val, val)
+        putok = jnp.stack([(jnp.uint32(T_PUTOK) << 24) | (m_rid << 12),
+                           jnp.uint32(0)])
+        putfail = jnp.stack([(jnp.uint32(T_PUTFAIL) << 24)
+                             | (m_rid << 12), jnp.uint32(0)])
+        getok = jnp.stack([(jnp.uint32(T_GETOK) << 24) | (m_rid << 12)
+                           | val, jnp.uint32(0)])
+        zmsg = jnp.zeros((2,), jnp.uint32)
+        sends = [[jnp.uint32(0), zmsg, jnp.bool_(False)]
+                 for _ in range(self.max_sends)]
+        reply = is_put | is_get
+        sends[0][0] = jnp.where(reply, src.astype(jnp.uint32),
+                                sends[0][0])
+        sends[0][1] = jnp.where(is_get, getok,
+                                jnp.where(ok, putok,
+                                          jnp.where(fail, putfail, zmsg)))
+        sends[0][2] = reply
+        changed = is_put & unwritten
+        return new_val[None].astype(jnp.uint32), changed, sends
+
+
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    cmd = args[0] if args else None
+    client_count = int(args[1]) if len(args) > 1 else 1
+    volatile = "volatile" in args
+    crashes = 0 if "no-crash" in args else 1
+    if cmd in ("check", "check-tpu"):
+        kind = "volatile" if volatile else "durable"
+        print(f"Model checking a packed write-once register "
+              f"({kind} server, {client_count} clients, "
+              f"max_crashes={crashes}) on the "
+              f"{'TPU' if cmd == 'check-tpu' else 'host'} engine.")
+        model = PackedWriteOnce(client_count, durable=not volatile)
+        if crashes:
+            model.crash_restart(crashes, actors=[0])
+        checker = model.checker()
+        (checker.spawn_tpu() if cmd == "check-tpu"
+         else checker.spawn_bfs()).report(sys.stdout)
+    else:
+        print("USAGE:")
+        print("  python -m stateright_tpu.examples.write_once_packed "
+              "check [CLIENT_COUNT] [volatile] [no-crash]")
+        print("  python -m stateright_tpu.examples.write_once_packed "
+              "check-tpu [CLIENT_COUNT] [volatile] [no-crash]")
+
+
+if __name__ == "__main__":
+    main()

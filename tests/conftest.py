@@ -31,3 +31,7 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running oracle pins (deselect with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection coverage (crash–restart, lossy "
+        "networks, corrupt checkpoints); select with -m faults. Fast "
+        "configs run in tier-1 by default.")

@@ -309,3 +309,92 @@ def test_save_with_lasso_witness_roundtrips(tmp_path):
           .resume_from(str(p)).spawn_tpu().join())
     states = c2.assert_any_discovery("odd").into_states()
     assert not any(s % 2 == 1 for s in states)
+
+
+@pytest.mark.faults
+class TestCheckpointIdentityAndCorruption:
+    """A checkpoint must refuse to resume under ANY identity drift —
+    different model config, different packed width, different fingerprint
+    algorithm — and a damaged file must raise one actionable error, never
+    a numpy/zipfile traceback."""
+
+    def _saved(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(capacity=1 << 12, resumable=True)
+              .spawn_tpu().join())
+        ck.save(path)
+        return path
+
+    def test_different_packed_width_refused(self, tmp_path):
+        from stateright_tpu.examples.write_once_packed import PackedWriteOnce
+
+        path = tmp_path / "ckpt.npz"
+        ck = (PackedWriteOnce(1, net_capacity=8).checker()
+              .tpu_options(capacity=1 << 12, resumable=True, race=False)
+              .spawn_tpu().join())
+        ck.save(path)
+        # net_capacity=4 shrinks the packed row: the saved rows cannot
+        # be reinterpreted, so resume must refuse with the two tags
+        with pytest.raises(RuntimeError, match="different model config"):
+            (PackedWriteOnce(1, net_capacity=4).checker()
+             .tpu_options(capacity=1 << 12, race=False)
+             .resume_from(path).spawn_tpu().join())
+
+    def test_different_fp_version_refused(self, tmp_path, monkeypatch):
+        path = self._saved(tmp_path)
+        import importlib
+
+        fingerprint_mod = importlib.import_module(
+            "stateright_tpu.fingerprint")
+        monkeypatch.setattr(fingerprint_mod, "FP_VERSION", 999)
+        # old-scheme fingerprints would silently fail to dedup against
+        # newly computed ones; the tag embeds fpv and must refuse
+        with pytest.raises(RuntimeError, match="different model config"):
+            (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+             .resume_from(path).spawn_tpu().join())
+
+    def test_different_model_config_refused(self, tmp_path):
+        path = self._saved(tmp_path)
+        with pytest.raises(RuntimeError, match="different model config"):
+            (TwoPhaseSys(4).checker().tpu_options(capacity=1 << 12)
+             .resume_from(path).spawn_tpu().join())
+
+    def test_garbage_file_raises_clear_error(self, tmp_path):
+        path = tmp_path / "ckpt.npz"
+        path.write_bytes(b"this is not a checkpoint")
+        with pytest.raises(RuntimeError, match="corrupt, truncated"):
+            (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+             .resume_from(path).spawn_tpu().join())
+
+    def test_truncated_file_raises_clear_error(self, tmp_path):
+        path = self._saved(tmp_path)
+        blob = path.read_bytes()
+        path.write_bytes(blob[:len(blob) // 2])
+        with pytest.raises(RuntimeError, match="corrupt, truncated"):
+            (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+             .resume_from(path).spawn_tpu().join())
+
+    def test_interrupted_save_never_clobbers_good_checkpoint(
+            self, tmp_path, monkeypatch):
+        path = self._saved(tmp_path)
+        good = path.read_bytes()
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(capacity=1 << 12, resumable=True)
+              .spawn_tpu().join())
+
+        def boom(*args, **kwargs):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(np, "savez_compressed", boom)
+        with pytest.raises(OSError):
+            ck.save(path)
+        # the good checkpoint is intact and no temp litter remains
+        assert path.read_bytes() == good
+        assert [p.name for p in tmp_path.iterdir()] == ["ckpt.npz"]
+        monkeypatch.undo()
+        ck.save(path)  # and a healthy save still lands atomically
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12)
+                   .resume_from(path).spawn_tpu().join())
+        assert resumed.unique_state_count() == 288

@@ -1,0 +1,323 @@
+"""Crash–restart fault injection across the host and device engines.
+
+The fault class real consensus protocols are defined against: a ``Crash``
+wipes an actor's volatile state (only its durable projection survives)
+and cancels its timer; a ``Restart`` rejoins it. Coverage:
+
+* host ``ActorModel`` semantics (budget, down-actor delivery suppression,
+  timer cancellation, ``durable()``/``on_restart()`` hooks);
+* the packed device lanes agree with the host bit-for-bit
+  (:func:`validate_packed_model` — successor-multiset equality per state)
+  alone and composed with Timeout and lossy-Drop lanes;
+* engine parity on the acceptance workloads: the write-once register and
+  a small paxos config enumerate identical state counts and identical
+  discoveries on host BFS and ``spawn_tpu``; the volatile write-once
+  variant is *caught* losing an acknowledged write on both engines, with
+  a replayable counterexample path containing the Crash/Restart actions.
+"""
+
+import pytest
+
+from stateright_tpu.actor import ActorModel, Id, Out
+from stateright_tpu.actor.core import Actor, Down
+from stateright_tpu.actor.model import Crash, Deliver, Restart, Timeout
+from stateright_tpu.actor.network import Network
+from stateright_tpu.actor.write_once_register import (
+    Get, GetOk, Put, PutFail, PutOk, WORegisterClient, WORegisterServer,
+    record_invocations, record_returns)
+from stateright_tpu.core import Expectation
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.write_once_register import WORegister
+
+pytestmark = pytest.mark.faults
+
+
+class VolatileWOServer(Actor):
+    """Unreplicated write-once server keeping its value in volatile
+    memory only — the deliberately buggy variant."""
+
+    def on_start(self, id: Id, o: Out):
+        return None  # unwritten
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if isinstance(msg, Put):
+            if state is None or state == msg.value:
+                o.send(src, PutOk(msg.request_id))
+                return msg.value if state is None else None
+            o.send(src, PutFail(msg.request_id))
+            return None
+        if isinstance(msg, Get):
+            o.send(src, GetOk(msg.request_id, state))
+            return None
+        return None
+
+
+class DurableWOServer(VolatileWOServer):
+    """The fixed variant: the register value is on stable storage."""
+
+    def durable(self, id: Id, state):
+        return state
+
+    def on_restart(self, id: Id, durable, o: Out):
+        return durable
+
+
+def wo_model(server: Actor, client_count: int = 1) -> ActorModel:
+    model = ActorModel(cfg=None,
+                       init_history=LinearizabilityTester(WORegister()))
+    model.actor(WORegisterServer(server))
+    for _ in range(client_count):
+        model.actor(WORegisterClient(put_count=1, server_count=1))
+    return (model
+            .init_network(Network.new_unordered_nonduplicating())
+            .property(Expectation.ALWAYS, "linearizable",
+                      lambda _, state:
+                      state.history.serialized_history() is not None)
+            .record_msg_in(record_returns)
+            .record_msg_out(record_invocations))
+
+
+class TestHostSemantics:
+    def test_crash_wipes_volatile_state_and_timer(self):
+        class TimerHolder(Actor):
+            def on_start(self, id, o):
+                o.set_timer((0.0, 0.0))
+                return 7
+
+        model = ActorModel().actor(TimerHolder()).crash_restart(1)
+        init = model.init_states()[0]
+        assert init.is_timer_set == (True,) and init.crashes == (0,)
+        crashed = model.next_state(init, Crash(Id(0)))
+        assert crashed.actor_states == (Down(None),)
+        assert crashed.is_timer_set == (False,)
+        assert crashed.crashes == (1,)
+        # the crash budget is spent: no further Crash action is offered
+        actions = []
+        model.actions(crashed, actions)
+        assert actions == [Restart(Id(0))]
+
+    def test_restart_reruns_on_start_by_default(self):
+        class Sender(Actor):
+            def on_start(self, id, o):
+                o.send(Id(9), "hello")  # undeliverable: sits in network
+                return "up"
+
+        model = (ActorModel().actor(Sender())
+                 .init_network(Network.new_unordered_nonduplicating())
+                 .crash_restart(1))
+        init = model.init_states()[0]
+        crashed = model.next_state(init, Crash(Id(0)))
+        restarted = model.next_state(crashed, Restart(Id(0)))
+        assert restarted.actor_states == ("up",)
+        # on_start ran again: its send was re-emitted (the multiset
+        # network counts both copies)
+        assert len(restarted.network) == 2
+
+    def test_down_actor_takes_no_deliveries_or_timeouts(self):
+        model = wo_model(VolatileWOServer()).crash_restart(1, actors=[0])
+        init = model.init_states()[0]  # client's Put is in flight
+        crashed = model.next_state(init, Crash(Id(0)))
+        actions = []
+        model.actions(crashed, actions)
+        assert not any(isinstance(a, Deliver) and int(a.dst) == 0
+                       for a in actions)
+        assert not any(isinstance(a, Timeout) for a in actions)
+        # the Put waits in the network rather than being lost
+        assert len(crashed.network) == 1
+        # and defensive next_state agrees with the action filter
+        env = next(iter(crashed.network.iter_deliverable()))
+        assert model.next_state(
+            crashed, Deliver(src=env.src, dst=env.dst, msg=env.msg)) \
+            is None
+
+    def test_crashable_restricts_eligible_actors(self):
+        model = wo_model(VolatileWOServer()).crash_restart(1, actors=[0])
+        init = model.init_states()[0]
+        actions = []
+        model.actions(init, actions)
+        crashes = [a for a in actions if isinstance(a, Crash)]
+        assert crashes == [Crash(Id(0))]
+
+    def test_no_crash_config_is_bit_identical(self):
+        # states of an uninjected model keep crashes=None, so existing
+        # fingerprints (and checkpoint identity) are unchanged
+        model = wo_model(VolatileWOServer())
+        init = model.init_states()[0]
+        assert init.crashes is None
+        actions = []
+        model.actions(init, actions)
+        assert not any(isinstance(a, (Crash, Restart)) for a in actions)
+
+
+class TestHostWriteOnceRegister:
+    def test_volatile_server_caught_losing_write(self):
+        model = wo_model(VolatileWOServer()).crash_restart(1, actors=[0])
+        checker = model.checker().spawn_bfs().join()
+        path = checker.assert_any_discovery("linearizable")
+        actions = path.into_actions()
+        assert any(isinstance(a, Crash) for a in actions)
+        assert any(isinstance(a, Restart) for a in actions)
+        assert path.last_state().history.serialized_history() is None
+
+    def test_durable_server_safe_under_crashes(self):
+        model = wo_model(DurableWOServer()).crash_restart(1, actors=[0])
+        checker = model.checker().spawn_bfs().join()
+        checker.assert_properties()
+
+    def test_bfs_dfs_parity_under_crashes(self):
+        bfs = (wo_model(DurableWOServer()).crash_restart(1, actors=[0])
+               .checker().spawn_bfs().join())
+        dfs = (wo_model(DurableWOServer()).crash_restart(1, actors=[0])
+               .checker().spawn_dfs().join())
+        assert (bfs.generated_fingerprints()
+                == dfs.generated_fingerprints())
+
+
+class TestPackedContract:
+    """Device crash/restart lanes agree with the host model bit-for-bit
+    (successor multisets, fingerprints, properties) — alone and composed
+    with the Timeout and lossy-Drop lane families."""
+
+    def test_write_once_durable(self):
+        from stateright_tpu.examples.write_once_packed import \
+            PackedWriteOnce
+        from stateright_tpu.models.packed import validate_packed_model
+
+        m = PackedWriteOnce(1, durable=True).crash_restart(1, actors=[0])
+        assert validate_packed_model(m, max_states=100) == 15
+
+    def test_write_once_volatile(self):
+        from stateright_tpu.examples.write_once_packed import \
+            PackedWriteOnce
+        from stateright_tpu.models.packed import validate_packed_model
+
+        m = PackedWriteOnce(1, durable=False).crash_restart(1,
+                                                            actors=[0])
+        assert validate_packed_model(m, max_states=100) == 21
+
+    def test_crash_composes_with_timeout_lanes(self):
+        from stateright_tpu.actor.test_util import PackedTimerCount
+        from stateright_tpu.models.packed import validate_packed_model
+
+        m = PackedTimerCount(2, 2).crash_restart(2)
+        assert validate_packed_model(m, max_states=200) == 49
+
+    def test_crash_composes_with_lossy_drop_lanes(self):
+        from stateright_tpu.actor.test_util import PackedPingPong
+        from stateright_tpu.models.packed import validate_packed_model
+
+        m = PackedPingPong(2, duplicating=False)
+        m.lossy_network(True).crash_restart(1)
+        validate_packed_model(m, max_states=500)
+
+    def test_paxos_contract_prefix(self):
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+        from stateright_tpu.models.packed import validate_packed_model
+
+        m = PackedPaxos(1).crash_restart(1, actors=[0, 1, 2])
+        assert validate_packed_model(m, max_states=600) == 600
+
+
+class TestEngineParity:
+    """Acceptance: host BFS and the device engine enumerate identical
+    state counts and identical discoveries under crash_restart(1)."""
+
+    def test_write_once_durable_counts_and_discoveries(self):
+        from stateright_tpu.examples.write_once_packed import \
+            PackedWriteOnce
+
+        def mk():
+            return PackedWriteOnce(2, durable=True).crash_restart(
+                1, actors=[0])
+
+        host = mk().checker().spawn_bfs().join()
+        dev = (mk().checker().tpu_options(race=False, capacity=1 << 12)
+               .spawn_tpu().join())
+        assert host.unique_state_count() == dev.unique_state_count() == 51
+        assert (host.generated_fingerprints()
+                == dev.generated_fingerprints())
+        assert (set(host.discoveries()) == set(dev.discoveries())
+                == {"value chosen"})
+        host.assert_properties()
+        dev.assert_properties()
+
+    def test_write_once_volatile_caught_on_both_engines(self):
+        from stateright_tpu.examples.write_once_packed import \
+            PackedWriteOnce
+
+        def mk():
+            return PackedWriteOnce(2, durable=False).crash_restart(
+                1, actors=[0])
+
+        model = mk()
+        host = model.checker().spawn_bfs().join()
+        dev_model = mk()
+        dev = (dev_model.checker().tpu_options(race=False,
+                                               capacity=1 << 12)
+               .spawn_tpu().join())
+        for checker, m in ((host, model), (dev, dev_model)):
+            path = checker.assert_any_discovery("linearizable")
+            actions = path.into_actions()  # replay validates the trace
+            assert any(isinstance(a, Crash) for a in actions)
+            assert path.last_state().history.serialized_history() is None
+
+    def test_paxos_small_config_parity(self):
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+
+        def mk():
+            return PackedPaxos(1).crash_restart(1, actors=[0, 1, 2])
+
+        host = mk().checker().spawn_bfs().join()
+        dev = (mk().checker().tpu_options(race=False, capacity=1 << 15)
+               .spawn_tpu().join())
+        assert (host.unique_state_count() == dev.unique_state_count()
+                == 7155)
+        assert (host.generated_fingerprints()
+                == dev.generated_fingerprints())
+        assert (set(host.discoveries()) == set(dev.discoveries())
+                == {"value chosen"})
+        host.assert_properties()
+        dev.assert_properties()
+
+
+class TestDeviceGuards:
+    def test_ordered_network_crash_is_host_only(self):
+        from stateright_tpu.examples.abd_packed import PackedAbd
+
+        m = PackedAbd(1, ordered=True).crash_restart(1, actors=[0, 1])
+        with pytest.raises(NotImplementedError, match="spawn_bfs"):
+            m.max_actions
+
+    def test_too_many_crashes_rejected(self):
+        from stateright_tpu.examples.write_once_packed import \
+            PackedWriteOnce
+
+        with pytest.raises(NotImplementedError, match="k <= 7"):
+            PackedWriteOnce(1).crash_restart(8, actors=[0])
+
+
+class TestLossyOrderedFallback:
+    """The device engine's lossy-ordered dead end names the working host
+    fallback, and the host path really does check the same model."""
+
+    def test_error_names_host_fallback(self):
+        from stateright_tpu.examples.abd_packed import PackedAbd
+
+        m = PackedAbd(1, ordered=True).lossy_network(True)
+        with pytest.raises(NotImplementedError,
+                           match="spawn_bfs.*spawn_dfs"):
+            m.max_actions
+
+    def test_host_engines_check_it_with_identical_discoveries(self):
+        from stateright_tpu.examples.abd_packed import PackedAbd
+
+        def mk():
+            return (PackedAbd(1, ordered=True, channel_depth=2,
+                              net_capacity=8)
+                    .lossy_network(True))
+
+        bfs = mk().checker().spawn_bfs().join()
+        dfs = mk().checker().spawn_dfs().join()
+        assert (bfs.generated_fingerprints()
+                == dfs.generated_fingerprints())
+        assert set(bfs.discoveries()) == set(dfs.discoveries())

@@ -387,3 +387,52 @@ class TestRegisterSpawn:
             assert reply == GetOk(2, 'Z')
         finally:
             handle.stop()
+
+
+@pytest.mark.faults
+class TestSpawnFailures:
+    """Actor-thread startup failures surface on the SpawnHandle instead
+    of dying silently inside a daemon thread."""
+
+    def test_duplicate_port_fails_loudly(self):
+        import pickle
+
+        from stateright_tpu.actor.core import ScriptedActor
+        from stateright_tpu.actor.runtime import spawn
+
+        base = _free_udp_port()
+        loop = (127, 0, 0, 1)
+        same_id = Id.from_socket_addr(loop, base)
+        handle = spawn(
+            pickle.dumps, pickle.loads,
+            [(same_id, ScriptedActor([])),
+             (same_id, ScriptedActor([]))],  # second bind must fail
+            background=True)
+        try:
+            deadline = time.monotonic() + 5.0
+            while not handle.failures() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            failures = handle.failures()
+            assert len(failures) == 1
+            failed_id, exc = failures[0]
+            assert failed_id == same_id
+            assert isinstance(exc, OSError)
+        finally:
+            with pytest.raises(RuntimeError, match="actor thread"):
+                handle.stop()
+
+    def test_clean_cluster_reports_no_failures(self):
+        import pickle
+
+        from stateright_tpu.actor.core import ScriptedActor
+        from stateright_tpu.actor.runtime import spawn
+
+        base = _free_udp_port(span=2)
+        loop = (127, 0, 0, 1)
+        handle = spawn(
+            pickle.dumps, pickle.loads,
+            [(Id.from_socket_addr(loop, base), ScriptedActor([])),
+             (Id.from_socket_addr(loop, base + 1), ScriptedActor([]))],
+            background=True)
+        assert handle.failures() == []
+        handle.stop()  # must not raise
