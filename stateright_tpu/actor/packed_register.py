@@ -281,6 +281,17 @@ class PackedRegisterModel(PackedActorModel):
         import numpy as np
         return np.asarray(row[self._hist_off:], dtype=np.uint32).tobytes()
 
+    def host_property_key_block(self, rows) -> list:
+        """Vectorized ``host_property_key`` over a pulled block
+        (``TpuChecker._eval_host_props_block``): ONE contiguous
+        slice/copy of every row's history columns instead of a per-row
+        slice + buffer round trip — the per-row overhead dominated the
+        host's representative-consumption cost on memo-hit-heavy runs."""
+        import numpy as np
+        block = np.ascontiguousarray(
+            np.asarray(rows, dtype=np.uint32)[:, self._hist_off:])
+        return [block[j].tobytes() for j in range(block.shape[0])]
+
     def packed_properties(self, words):
         import jax.numpy as jnp
         # index 0 "linearizable" is host-evaluated: neutral True.

@@ -84,7 +84,15 @@ class CheckerBuilder:
         return self
 
     def tpu_options(self, **options) -> "CheckerBuilder":
-        """Tuning knobs for ``spawn_tpu`` (table capacity, batch caps, ...)."""
+        """Tuning knobs for ``spawn_tpu`` (table capacity, batch caps,
+        mesh selection, ...). Notable: ``pipeline`` (default ``True``)
+        double-buffers the chunk loop — chunk N+1 is dispatched while
+        the host consumes chunk N's stats, hiding stats decode and
+        host-property evaluation under the accelerator; set
+        ``pipeline=False`` to force the synchronous
+        dispatch-sync-process loop (debugging, latency A/B — observable
+        results are identical either way, see ``profile()``'s
+        ``dispatch``/``sync_stall``/``host_overlap`` timers)."""
         self.tpu_options_.update(options)
         return self
 

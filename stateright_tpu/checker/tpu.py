@@ -317,6 +317,14 @@ class TpuChecker(HostChecker):
         self._host_props = [
             (i, self._properties[i])
             for i in getattr(model, "host_property_indices", ())]
+        fns = getattr(model, "host_property_fns", None)
+        if fns is not None and len(fns) != len(self._host_props):
+            raise ValueError(
+                f"model declares {len(self._host_props)} host-evaluated "
+                f"properties (host_property_indices) but {len(fns)} "
+                "host_property_fns; a subclass that changes properties "
+                "must keep the packed fast-path evaluators in lockstep "
+                "(or drop host_property_fns to fall back to decode())")
         # host-evaluated EVENTUALLY properties run on the per-level
         # engine: the device never clears their ebits (the packed
         # placeholder bit must be False); the host evaluates each new
@@ -390,8 +398,24 @@ class TpuChecker(HostChecker):
                                 + time.perf_counter() - t0)
 
     def profile(self) -> Dict[str, float]:
-        """Wall-time spent per engine phase (seconds): seeding, chunk
-        dispatch+sync, growth, host mirror finalization."""
+        """Wall-time spent per engine phase (seconds) plus observed-size
+        counters. The chunk loop reports three timers that make the
+        host/device overlap observable:
+
+        * ``dispatch`` — host time spent launching chunk programs (async;
+          small unless tracing/compiling),
+        * ``sync_stall`` — time blocked materializing a chunk's stats
+          vector (the device round trip the pipeline hides host work
+          under; if this dominates, the device is the bottleneck — try a
+          larger ``fmax``/``chunk_steps``),
+        * ``host_overlap`` — host-side consumption of a chunk's outputs
+          (stats decode, batched host-property evaluation, discovery
+          bookkeeping) that runs while the NEXT chunk is already in
+          flight under ``tpu_options(pipeline=True)`` (the default).
+
+        Other keys: ``seed``, ``grow``/``hgrow``, ``posthoc``,
+        ``lasso``, ``mirror_pull``, ``visit``, the ``chunks`` counter,
+        and the observed branching maxima ``vmax``/``dmax``/``rmax``."""
         return dict(self._prof)
 
     # ------------------------------------------------------------------
@@ -566,9 +590,9 @@ class TpuChecker(HostChecker):
             # resumed frontier needs no pass: every pre-checkpoint state
             # was already evaluated and its discoveries ride the
             # checkpoint metadata.
-            for row, fp in zip(init_rows, seed_fps):
-                self._eval_host_props_row(np.asarray(row), fp,
-                                          discoveries)
+            self._eval_host_props_block(
+                [np.asarray(row) for row in init_rows], seed_fps,
+                discoveries)
         if prop_count == 0:
             # nothing to search for: mirror the reference's immediate stop
             # once discoveries (vacuously) cover all properties
@@ -637,9 +661,47 @@ class TpuChecker(HostChecker):
                                   hint_eff=hint_eff, ecap=ecap)
 
         chunk_fn = mk_chunk()
+        pipeline = bool(opts.get("pipeline", True))
 
         # --- chunk loop -------------------------------------------------
-        while True:
+        # Double-buffered pipeline (``tpu_options(pipeline=False)`` forces
+        # the synchronous path): chunk N+1 is launched on the carry — a
+        # device future, donated straight back in — BEFORE chunk N's stats
+        # are materialized, so the host work (stats decode, batched
+        # host-property evaluation, discovery bookkeeping) hides under
+        # the accelerator instead of serializing with it. Speculation is
+        # safe because every host-intervention condition (kovf / hovf /
+        # ovf / xovf, the growth limits, an empty queue, device-property
+        # completion) also gates the device loop's own cond
+        # (device_loop.make_cond), so a chunk launched past one of them
+        # runs zero iterations and replaying its stats is idempotent.
+        # The one sanctioned divergence: an exit only the HOST can see (a
+        # host-property discovery, a reached generation target) lands one
+        # chunk late, so generated/unique counts may include one extra
+        # chunk of real exploration — the same overshoot the chunk
+        # granularity already implies (module docstring); discoveries and
+        # witness paths are unaffected (sticky registers; the window
+        # evaluation order is anchored per chunk).
+        from .device_loop import HIST_WINDOW
+
+        inflight: deque = deque()
+        # latest unpacked per-chunk scalars, read by the post-loop phases
+        cur = {"q_size": 0, "q_tail": 0, "log_n": 0, "e_n": 0}
+        hgrow_pend = {"on": False, "hovf": False, "h_n": 0}
+        kovf_pend = [0, 0, 0]  # observed vmax/dmax/rmax of kovf chunks
+
+        def want_reps_now() -> bool:
+            return bool(self._host_props) and any(
+                p.name not in discoveries for _i, p in self._host_props)
+
+        def dispatch() -> None:
+            nonlocal carry, chunk_fn, hcap
+            if hcap and not want_reps_now():
+                # every host property has its discovery: the in-loop
+                # history dedup is dead work now (and, saturated, would
+                # stall the loop via hovf) — rebuild without it
+                hcap = 0
+                chunk_fn = mk_chunk()
             grow_limit = np.int32(min(
                 self._grow_at * self._capacity,
                 self._capacity - headroom))
@@ -649,21 +711,25 @@ class TpuChecker(HostChecker):
             carry = carry._replace(gen=jnp.int32(0),
                                    steps=jnp.int32(k_steps),
                                    vmax=jnp.int32(0))
-            want_reps = self._host_props and any(
-                p.name not in discoveries for _i, p in self._host_props)
-            if hcap and not want_reps:
-                # every host property has its discovery: the in-loop
-                # history dedup is dead work now (and, saturated, would
-                # stall the loop via hovf) — rebuild without it
-                hcap = 0
-                chunk_fn = mk_chunk()
-            with self._timed("chunk"):
+            with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit,
                                           np.int32(self._h_pulled))
+            inflight.append((stats_d, self._h_pulled, int(grow_limit),
+                             hcap))
+            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+
+        def process(stats_d, h_base: int, grow_limit: int,
+                    hcap_d: int) -> set:
+            """Consume one chunk's stats vector; returns the host
+            actions it demands (handled once the pipeline is drained)."""
+            nonlocal seed_ovf
+            with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # (scalars + the representative window when host props
                 # are on): each transfer costs ~100 ms of tunnel latency
                 stats = np.asarray(stats_d)
+            t0 = time.perf_counter()
+            acts: set = set()
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
              vmax, dmax, rmax, e_n) = (
                 int(stats[0]), int(stats[1]), int(stats[2]),
@@ -676,17 +742,11 @@ class TpuChecker(HostChecker):
             disc_lo = stats[13 + 2 * prop_count:13 + 3 * prop_count]
             tail0 = 13 + 3 * prop_count
             width3 = model.packed_width + 3
-            if int(q_tail) > 0:
+            if q_tail > 0:
                 # most recently enqueued state (live Explorer progress)
                 self._recent_row = stats[tail0:tail0 + width3].copy()
-            if want_reps and h_n > self._h_pulled:
-                from .device_loop import HIST_WINDOW
-                win = stats[tail0 + width3:].reshape(
-                    (HIST_WINDOW, -1))
-                hrows = win[:, :-2]
-                hwhi, hwlo = win[:, -2], win[:, -1]
-            q_size = int(q_tail) - int(q_head)
-            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+            cur.update(q_size=q_tail - q_head, q_tail=q_tail,
+                       log_n=log_n, e_n=e_n)
             # observed branching (raw / post-dedup), for tuning
             # model.branching_hint and the kraw/kmax buffer sizes
             self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
@@ -694,8 +754,8 @@ class TpuChecker(HostChecker):
             self._prof["rmax"] = max(self._prof.get("rmax", 0), rmax)
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
-            self._state_count += int(gen)
-            self._unique_state_count = base_unique + int(log_n)
+            self._state_count += gen
+            self._unique_state_count = base_unique + log_n
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:
@@ -708,120 +768,196 @@ class TpuChecker(HostChecker):
                         "device hash table overflow while seeding; raise "
                         "tpu_options(capacity=...)")
                 seed_ovf = None
-            if bool(xovf):
+            if xovf:
                 raise RuntimeError(_XOVF_MESSAGE)
-            if bool(ovf):
+            if ovf:
                 raise RuntimeError(
                     "device hash table probe overflow below the growth "
                     f"limit (capacity {self._capacity}); raise via "
                     "checker_builder.tpu_options(capacity=...)")
-            if want_reps:
+            if hcap_d and want_reps_now():
                 # host properties are evaluated on the distinct-history
                 # representatives the chunk loop logged (memoized per
                 # key), so a shallow host counterexample still exits
-                # early instead of waiting for full exhaustion. This runs
-                # BEFORE any retry `continue`: the chunk's window is
-                # anchored at its entry h_n, so every logged
-                # representative must be consumed before the next launch.
-                from .device_loop import HIST_WINDOW
-                fresh = int(h_n) - self._h_pulled
+                # early instead of waiting for full exhaustion. The
+                # inline window is anchored at the chunk's DISPATCH-time
+                # pulled count (h_base); under pipelining the rows
+                # consumed since then are skipped by offset, so each
+                # representative is evaluated exactly once and in the
+                # same order as the synchronous path.
+                fresh = h_n - self._h_pulled
                 if fresh > 0:
                     with self._timed("posthoc"):
-                        wfp = _combine64(hwhi, hwlo)
-                        for j in range(min(fresh, HIST_WINDOW)):
-                            if all(p.name in discoveries
-                                   for _i, p in self._host_props):
-                                break
-                            self._eval_host_props_row(
-                                hrows[j], int(wfp[j]), discoveries)
-                        self._h_pulled += min(fresh, HIST_WINDOW)
-                        if fresh > HIST_WINDOW:
+                        win = stats[tail0 + width3:].reshape(
+                            (HIST_WINDOW, -1))
+                        offset = self._h_pulled - h_base
+                        take = max(0, min(fresh, HIST_WINDOW - offset))
+                        if take:
+                            wfp = _combine64(
+                                win[offset:offset + take, -2],
+                                win[offset:offset + take, -1])
+                            self._eval_host_props_block(
+                                win[offset:offset + take, :-2], wfp,
+                                discoveries)
+                            self._h_pulled += take
+                        if fresh > take:
                             # more fresh keys than the inline window:
                             # pull the remainder standalone
-                            self._pull_host_reps(carry, int(h_n),
-                                                 n_init, discoveries)
-                if bool(hovf) or int(h_n) >= self._grow_at * hcap:
-                    # grow the history-key table: proactively at the same
-                    # occupancy threshold as the fingerprint table (a
-                    # near-full open table crawls through thousands of
-                    # probe rounds per insert), or reactively on hovf.
-                    # Re-seed from the logged representatives; after an
-                    # hovf the overflowing iteration still committed, so
-                    # rescan its queue span for the keys that went
-                    # unlogged (growing further if even the bigger table
-                    # overflows on that span).
-                    with self._timed("hgrow"):
-                        while True:
-                            new_hcap = self._posthoc_cap
-                            while new_hcap * self._grow_at <= int(h_n):
-                                new_hcap *= 4
-                            if new_hcap == self._posthoc_cap:
-                                new_hcap *= 4  # hovf w/o occupancy
-                            hcap = self._posthoc_cap = new_hcap
-                            carry = self._regrow_history_table(
-                                carry, int(h_n), hcap)
-                            if not bool(hovf):
-                                break
-                            carry, rescan_ovf = self._rescan_history(
-                                carry, self._hscan_tail, int(q_tail),
-                                qcap, n_init, discoveries)
-                            if not rescan_ovf:
-                                break
-                    chunk_fn = mk_chunk()
-                self._hscan_tail = int(q_tail)
-            if bool(kovf):
-                # a batch overflowed one of the candidate buffers;
-                # nothing was committed — resize the overflowed stage(s)
-                # to the observed branching (at least doubling) and
-                # resume. rmax = per-row max (sizes hint_eff), vmax =
-                # raw-valid max (sizes kraw), dmax = post-dedup max
-                # (sizes kmax).
-                grew = False
-                if hint_eff and rmax > hint_eff:
-                    hint_eff = max(hint_eff + 1, rmax + rmax // 4)
-                    if hint_eff >= model.max_actions:
-                        hint_eff = 0  # degenerate: fall back to global
-                    grew = True
-                if not hint_eff and vmax > kraw:
-                    kraw = min(max(kraw * 2,
-                                   -(-(vmax + vmax // 4) // 256) * 256),
-                               fa)
-                    grew = True
-                if dmax > kmax or not grew:
-                    kmax = min(max(kmax * 2,
-                                   -(-(dmax + dmax // 4) // 256) * 256),
-                               kraw if not hint_eff
-                               else fmax * hint_eff)
-                kmax = min(kmax, kraw if not hint_eff
-                           else fmax * hint_eff)
-                chunk_fn = mk_chunk()
-                carry = carry._replace(kovf=jnp.bool_(False))
-                continue
-            done = (q_size == 0
+                            self._pull_host_reps(carry, h_n, n_init,
+                                                 discoveries)
+                if hovf or h_n >= self._grow_at * hcap_d:
+                    acts.add("hgrow")
+                    hgrow_pend.update(
+                        on=True, hovf=hgrow_pend["hovf"] or hovf,
+                        h_n=max(hgrow_pend["h_n"], h_n))
+                else:
+                    self._hscan_tail = q_tail
+            self._prof["host_overlap"] = (
+                self._prof.get("host_overlap", 0.0)
+                + time.perf_counter() - t0)
+            if kovf:
+                # resize data for the drained handler; skip the exit
+                # checks exactly like the synchronous retry `continue`
+                kovf_pend[0] = max(kovf_pend[0], vmax)
+                kovf_pend[1] = max(kovf_pend[1], dmax)
+                kovf_pend[2] = max(kovf_pend[2], rmax)
+                acts.add("kovf")
+                return acts
+            if (q_tail - q_head == 0
                     or len(discoveries) == prop_count
                     or (target is not None
                         and self._state_count >= target)
-                    or self._cancel_event.is_set())
-            if done:
-                break
-            if ecap and e_n >= ecap - max(kmax, fmax):
-                # cross-edge log full: quadruple it and resume
-                with self._timed("grow"):
-                    new_elog = jnp.zeros((ecap * 4, 4), jnp.uint32)
-                    new_elog = jax.lax.dynamic_update_slice(
-                        new_elog, carry.elog, (0, 0))
-                    ecap *= 4
-                    carry = carry._replace(elog=new_elog)
-                chunk_fn = mk_chunk()
-                continue
-            need_grow = (int(log_n) >= int(grow_limit)
-                         or int(q_tail) > qcap - headroom)
-            if need_grow:
-                with self._timed("grow"):
-                    carry, qcap = self._grow_device(carry, qcap, n_init,
-                                                    headroom, insert_fn)
-                chunk_fn = mk_chunk()
+                    or self._cancel_event.is_set()):
+                acts.add("done")
+            elif ecap and e_n >= ecap - max(kmax, fmax):
+                acts.add("egrow")
+            elif log_n >= grow_limit or q_tail > qcap - headroom:
+                acts.add("grow")
+            return acts
 
+        def handle_hgrow() -> None:
+            # grow the history-key table: proactively at the same
+            # occupancy threshold as the fingerprint table (a near-full
+            # open table crawls through thousands of probe rounds per
+            # insert), or reactively on hovf. Re-seed from the logged
+            # representatives; after an hovf the overflowing iteration
+            # still committed, so rescan its queue span for the keys
+            # that went unlogged (growing further if even the bigger
+            # table overflows on that span). Runs only with the
+            # pipeline drained: the reseed is sized by h_n, and an
+            # in-flight chunk could log representatives past it.
+            nonlocal carry, hcap, chunk_fn
+            h_n = hgrow_pend["h_n"]
+            q_tail = cur["q_tail"]
+            with self._timed("hgrow"):
+                while True:
+                    new_hcap = self._posthoc_cap
+                    while new_hcap * self._grow_at <= h_n:
+                        new_hcap *= 4
+                    if new_hcap == self._posthoc_cap:
+                        new_hcap *= 4  # hovf w/o occupancy
+                    hcap = self._posthoc_cap = new_hcap
+                    carry = self._regrow_history_table(carry, h_n, hcap)
+                    if not hgrow_pend["hovf"]:
+                        break
+                    carry, rescan_ovf = self._rescan_history(
+                        carry, self._hscan_tail, q_tail, qcap, n_init,
+                        discoveries)
+                    if not rescan_ovf:
+                        break
+            self._hscan_tail = q_tail
+            hgrow_pend.update(on=False, hovf=False, h_n=0)
+            chunk_fn = mk_chunk()
+
+        def handle_kovf() -> None:
+            # a batch overflowed one of the candidate buffers; nothing
+            # was committed — resize the overflowed stage(s) to the
+            # observed branching (at least doubling) and resume. rmax =
+            # per-row max (sizes hint_eff), vmax = raw-valid max (sizes
+            # kraw), dmax = post-dedup max (sizes kmax).
+            nonlocal carry, chunk_fn, kraw, kmax, hint_eff
+            vmax, dmax, rmax = kovf_pend
+            grew = False
+            if hint_eff and rmax > hint_eff:
+                hint_eff = max(hint_eff + 1, rmax + rmax // 4)
+                if hint_eff >= model.max_actions:
+                    hint_eff = 0  # degenerate: fall back to global
+                grew = True
+            if not hint_eff and vmax > kraw:
+                kraw = min(max(kraw * 2,
+                               -(-(vmax + vmax // 4) // 256) * 256),
+                           fa)
+                grew = True
+            if dmax > kmax or not grew:
+                kmax = min(max(kmax * 2,
+                               -(-(dmax + dmax // 4) // 256) * 256),
+                           kraw if not hint_eff
+                           else fmax * hint_eff)
+            kmax = min(kmax, kraw if not hint_eff
+                       else fmax * hint_eff)
+            kovf_pend[:] = [0, 0, 0]
+            chunk_fn = mk_chunk()
+            carry = carry._replace(kovf=jnp.bool_(False))
+
+        def handle_egrow() -> None:
+            # cross-edge log full: quadruple it and resume
+            nonlocal carry, chunk_fn, ecap
+            with self._timed("grow"):
+                new_elog = jnp.zeros((ecap * 4, 4), jnp.uint32)
+                new_elog = jax.lax.dynamic_update_slice(
+                    new_elog, carry.elog, (0, 0))
+                ecap *= 4
+                carry = carry._replace(elog=new_elog)
+            chunk_fn = mk_chunk()
+
+        def handle_grow() -> None:
+            nonlocal carry, chunk_fn, qcap
+            with self._timed("grow"):
+                carry, qcap = self._grow_device(carry, qcap, n_init,
+                                                headroom, insert_fn)
+            chunk_fn = mk_chunk()
+
+        dispatch()
+        while True:
+            if pipeline and len(inflight) == 1:
+                dispatch()
+            acts = process(*inflight.popleft())
+            if not acts:
+                if not inflight:
+                    dispatch()
+                continue
+            # a host intervention (or an exit) is due: drain the one
+            # speculative chunk first — under any device-visible stop
+            # condition it ran zero iterations and its stats replay
+            # idempotently; past a host-only exit it is one extra chunk
+            # of real (merged) exploration
+            while inflight:
+                acts |= process(*inflight.popleft())
+            if hgrow_pend["on"]:
+                handle_hgrow()
+                acts.discard("hgrow")
+            if "kovf" in acts:
+                handle_kovf()
+            elif "done" in acts:
+                break
+            elif "egrow" in acts:
+                handle_egrow()
+            elif "grow" in acts:
+                handle_grow()
+            dispatch()
+        q_size = cur["q_size"]
+        q_tail, log_n, e_n = cur["q_tail"], cur["log_n"], cur["e_n"]
+
+        if self._sound and q_size == 0 and self._resume_path is not None:
+            import warnings
+            warnings.warn(
+                "resume_from() + sound_eventually(): the post-exhaustion "
+                "lasso sweep is SKIPPED on resumed runs (the "
+                "pre-checkpoint subgraph's edges are not in this run's "
+                "device logs), so liveness cycles entered through "
+                "pre-checkpoint states go unreported. Re-run without "
+                "resume_from() for a cycle-complete liveness verdict.",
+                RuntimeWarning, stacklevel=2)
         if (self._sound and q_size == 0 and self._resume_path is None
                 and not self._symmetry
                 and not self._cancel_event.is_set()):
@@ -891,74 +1027,68 @@ class TpuChecker(HostChecker):
                     node_mask, node_parent, node_fp)
 
     def _visit_reached(self) -> None:
-        """Drive the CheckerVisitor over every reached state in insertion
-        order — the device log IS the visitation record, so the visits
-        replay post-hoc from the host mirror. The previous design forced
-        visitors onto the per-level engine, which pays the ~0.15 s
-        standalone-dispatch floor PLUS a sync per BFS level.
+        """Drive the CheckerVisitor over every reached state — the device
+        log IS the visitation record, so the visits replay post-hoc from
+        the host mirror. The previous design forced visitors onto the
+        per-level engine, which pays the ~0.15 s standalone-dispatch
+        floor PLUS a sync per BFS level.
 
-        Replay is INCREMENTAL: parents always precede children in the
-        log, so each state's transition is matched ONCE against its
-        parent's state — O(states) model-replay steps and O(states)
-        resident bookkeeping (a (parent, state, action) triple per
-        state, no retained step lists), vs O(states * depth) replay for
-        a from-scratch reconstruction per visit (the per-level engine's
-        in-loop cost). Each visit still materializes its own O(depth)
-        Path by walking the triples — that is the visitor API."""
+        Replay walks the parent FOREST depth-first from a
+        children-by-parent index, with an explicit spine of
+        (state, action) steps. Each state's transition is matched ONCE
+        against its parent's decoded state — O(states) model-replay
+        steps — and a node's decoded state is DROPPED at backtrack, when
+        its last pending child has been matched (the per-parent
+        refcount is the exhausted child iterator), so resident decoded
+        states are bounded by the live path depth, not the reached-set
+        size. The children index also replaces the old wave-based
+        deferral for cross-shard mirrors (a child preceding its parent
+        in the concatenated per-shard logs simply waits in the index) —
+        the waves rescanned every still-pending key per round, O(states
+        squared) on adversarial orders. Each visit still materializes
+        its own O(depth) Path from the spine — that is the visitor API.
+        Visit order is the DFS order of the parent forest (parents
+        before children); the log's sibling interleaving is not
+        preserved, matching the reference's unordered multithreaded
+        visitors."""
         from .path import NondeterministicModelError, Path
 
         self._ensure_mirror()
         model = self._model
-        # key -> ("anchor", steps) for roots (init or resumed frontier:
-        # full reconstruction once), else (parent_key, state, action
-        # INTO the state)
-        built: Dict[int, tuple] = {}
-
-        def materialize(key) -> Path:
-            suffix = []
-            k = key
-            while True:
-                v = built[k]
-                if v[0] == "anchor":
-                    base = v[1]
-                    break
-                k, state, act = v
-                suffix.append((state, act))
-            steps = list(base[:-1])
-            cur = base[-1][0]
-            for state, act in reversed(suffix):
-                steps.append((cur, act))
-                cur = state
-            steps.append((cur, None))
-            return Path(steps)
-
-        # wave-based deferral: the sharded mirror concatenates per-shard
-        # logs, so a child can precede its cross-shard parent; deferred
-        # keys retry next wave (the parent relation is a forest, so each
-        # wave makes progress and replay work stays O(states))
-        pending = list(self._generated)
-        while pending:
-            if self._cancel_event.is_set():
-                return
-            deferred = []
-            for key in pending:
-                fp = self._orig_of.get(key, key) \
-                    if (self._symmetry or self._sound) else key
-                parent_key = self._generated[key]
-                if parent_key is not None and parent_key not in built \
-                        and parent_key in self._generated:
-                    deferred.append(key)
+        translate = self._symmetry or self._sound
+        children: Dict[int, list] = {}
+        roots: list = []
+        for key, parent_key in self._generated.items():
+            if parent_key is not None and parent_key in self._generated:
+                children.setdefault(parent_key, []).append(key)
+            else:
+                # an init state (or a resumed root whose chain is
+                # outside the mirror): full reconstruction, once
+                roots.append(key)
+        visited = 0
+        peak = 0
+        for root in roots:
+            base = self._reconstruct_path(root)._steps
+            # spine[i] = [state_i, action taken from state_i]; the last
+            # entry's action is None (the path ends there)
+            spine = [[base[-1][0], None]]
+            base = base[:-1]
+            self._visitor.visit(
+                model, Path(base + [(spine[0][0], None)]))
+            visited += 1
+            iters = [iter(children.get(root, ()))]
+            while iters:
+                if self._cancel_event.is_set():
+                    return
+                key = next(iters[-1], None)
+                if key is None:
+                    # refcount exhausted: this node's decoded state is
+                    # no longer needed by any pending child — drop it
+                    iters.pop()
+                    spine.pop()
                     continue
-                if parent_key is None or parent_key not in built:
-                    # an init state (or a resumed root whose chain is
-                    # outside the mirror): full reconstruction
-                    path = self._reconstruct_path(key)
-                    built[key] = ("anchor", path._steps)
-                    self._visitor.visit(model, path)
-                    continue
-                ppath = built[parent_key]
-                parent_state = ppath[1][-1][0] if ppath[0] == "anchor" \
-                    else ppath[1]
+                fp = self._orig_of.get(key, key) if translate else key
+                parent_state = spine[-1][0]
                 found = None
                 for action, state in model.next_steps(parent_state):
                     if model.fingerprint(state) == fp:
@@ -970,13 +1100,23 @@ class TpuChecker(HostChecker):
                         f"successor of the parent state has fingerprint "
                         f"{fp}. This usually means Model.actions or "
                         "Model.next_state vary across calls.")
-                built[key] = (parent_key, found[1], found[0])
-                self._visitor.visit(model, materialize(key))
-            if len(deferred) == len(pending):  # pragma: no cover
-                raise NondeterministicModelError(
-                    "visitation replay stalled: a parent chain in the "
-                    "mirror is cyclic or incomplete")
-            pending = deferred
+                spine[-1][1] = found[0]
+                spine.append([found[1], None])
+                iters.append(iter(children.get(key, ())))
+                peak = max(peak, len(base) + len(spine))
+                self._visitor.visit(
+                    model,
+                    Path(base + [(s, a) for s, a in spine]))
+                visited += 1
+        # observability for the refcounted drop: the maximum number of
+        # decoded states resident at once during the replay
+        self._prof["visit_peak_resident"] = max(
+            self._prof.get("visit_peak_resident", 0), peak)
+        if visited != len(self._generated):  # pragma: no cover
+            raise NondeterministicModelError(
+                "visitation replay stalled: a parent chain in the "
+                "mirror is cyclic or incomplete "
+                f"({len(self._generated) - visited} unreached keys)")
 
     def _device_qcap(self, n_init: int, headroom: int) -> int:
         """Queue rows needed between growths: every enqueued state is
@@ -1101,12 +1241,8 @@ class TpuChecker(HostChecker):
             carry.q, carry.hidx, carry.log,
             jnp.int32(start), jnp.int32(n_init), bucket)
         out_h = np.asarray(jax.device_get(out_d))
-        rows_h = out_h[:, :-2]
-        wfp = _combine64(out_h[:, -2], out_h[:, -1])
-        for j in range(count):
-            if all(p.name in discoveries for _i, p in self._host_props):
-                break
-            self._eval_host_props_row(rows_h[j], int(wfp[j]), discoveries)
+        wfp = _combine64(out_h[:count, -2], out_h[:count, -1])
+        self._eval_host_props_block(out_h[:count, :-2], wfp, discoveries)
         self._h_pulled = h_n
 
     def _regrow_history_table(self, carry, h_n: int, hcap: int):
@@ -1209,13 +1345,8 @@ class TpuChecker(HostChecker):
             n = min(_bucket(hcnt), rmax)
             rows_h, whi_h, wlo_h = jax.device_get(
                 (rows_d[:n], whi_d[:n], wlo_d[:n]))
-            wfp = _combine64(whi_h, wlo_h)
-            for j in range(hcnt):
-                if all(p.name in discoveries
-                       for _i, p in self._host_props):
-                    break
-                self._eval_host_props_row(rows_h[j], int(wfp[j]),
-                                          discoveries)
+            wfp = _combine64(whi_h[:hcnt], wlo_h[:hcnt])
+            self._eval_host_props_block(rows_h[:hcnt], wfp, discoveries)
         return carry._replace(hkey_hi=khi, hkey_lo=klo), False
 
     def _ensure_mirror(self) -> None:
@@ -1487,6 +1618,50 @@ class TpuChecker(HostChecker):
                 discoveries[prop.name] = fp
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
+
+    def _eval_host_props_block(self, rows, fps,
+                               discoveries: Dict[str, int]) -> None:
+        """Evaluate host properties over a whole pulled block of packed
+        states at once: one vectorized key pass
+        (``model.host_property_key_block`` when the model provides it),
+        then one in-order scan that decodes/evaluates only cache-missing
+        keys — the per-row slice+hash overhead of the old
+        ``_eval_host_props_row`` loop was the dominant host cost per
+        representative. Scan order is block order and stops at the first
+        point every host property has a discovery, so the witnessing
+        fingerprints are identical to the per-row path's."""
+        host_props = self._host_props
+        n = len(rows)
+        if not n or not host_props or all(
+                p.name in discoveries for _i, p in host_props):
+            return
+        model = self._model
+        block_fn = getattr(model, "host_property_key_block", None)
+        keys = (block_fn(rows) if block_fn is not None
+                else [model.host_property_key(row) for row in rows])
+        cache = self._host_prop_cache
+        fns = getattr(model, "host_property_fns", None)
+        for j in range(n):
+            results = cache.get(keys[j])
+            if results is None:
+                row = rows[j]
+                if fns is not None:
+                    results = [bool(fn(row)) for fn in fns]
+                else:
+                    state = model.decode(row)
+                    results = [bool(prop.condition(model, state))
+                               for _i, prop in host_props]
+                cache[keys[j]] = results
+            fp = int(fps[j])
+            for (i, prop), res in zip(host_props, results):
+                if prop.name in discoveries:
+                    continue
+                if prop.expectation == Expectation.ALWAYS and not res:
+                    discoveries[prop.name] = fp
+                elif prop.expectation == Expectation.SOMETIMES and res:
+                    discoveries[prop.name] = fp
+            if all(p.name in discoveries for _i, p in host_props):
+                return
 
     def _bulk_insert_async(self, insert_fn, key_hi, key_lo,
                            fps: List[int]):

@@ -48,6 +48,16 @@ class ShardedTpuChecker(TpuChecker):
             raise ValueError("mesh axis size must be a power of two")
         if self._capacity % d:
             raise ValueError("capacity must be divisible by the mesh axis")
+        if int(opts.get("hint", 0)):
+            # the per-row stage-one compaction is a single-chip knob
+            # (checker/device_loop.py); silently ignoring it here skewed
+            # single-chip vs sharded A/B comparisons — fail loudly
+            raise ValueError(
+                "tpu_options(hint=...) is not supported with mesh=...: "
+                "the sharded chunk loop has no per-row compaction stage, "
+                "so the hint would be silently ignored and skew A/B "
+                "comparisons against the single-chip engine. Drop "
+                "hint=... (or drop mesh=...)")
         if getattr(self, "_sound", False) and self._host_props:
             raise NotImplementedError(
                 "sound_eventually() with host-evaluated properties is "
@@ -193,11 +203,35 @@ class ShardedTpuChecker(TpuChecker):
                 exchange=exchange, kb=kb, ecap=ecap)
 
         chunk_fn = rebuild_chunk()
+        pipeline = bool(opts.get("pipeline", True))
+
+        import time
+        from collections import deque
 
         import jax.numpy as jnp
 
         host_prop_idx = {i for i, _p in self._host_props}
-        while True:
+
+        # --- chunk loop -------------------------------------------------
+        # Double-buffered dispatch, exactly like the single-chip engine
+        # (checker/tpu.py chunk loop): chunk N+1 launches on the donated
+        # carry future before chunk N's stats materialize, hiding the
+        # host work (stats decode, the post-hoc host-property pass)
+        # under the mesh. Every host-intervention condition also gates
+        # the SPMD loop's replicated cond (sharded.go_from), so a
+        # speculatively launched chunk past one of them runs zero
+        # iterations and its stats replay idempotently; host-only exits
+        # (host-property discoveries, the generation target) land one
+        # chunk late — the documented chunk-granularity overshoot.
+        inflight: deque = deque()
+        cur = {"q_head": np.zeros(D, np.int64),
+               "q_tail": np.zeros(D, np.int64),
+               "log_n": np.zeros(D, np.int64),
+               "e_n": np.zeros(D, np.int64)}
+        kovf_pend = [0, 0, 0]  # observed vmax/dmax/bmax of kovf chunks
+
+        def dispatch() -> None:
+            nonlocal carry
             closc = self._capacity // D
             grow_limit = np.int32(min(self._grow_at * closc,
                                       closc - headroom))
@@ -209,10 +243,17 @@ class ShardedTpuChecker(TpuChecker):
                                    vmax=jnp.int32(0),
                                    dmax=jnp.int32(0),
                                    bmax=jnp.int32(0))
-            with self._timed("chunk"):
+            with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit)
+            inflight.append((stats_d, int(grow_limit)))
+            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+
+        def process(stats_d, grow_limit: int) -> set:
+            with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 stats = np.asarray(jax.device_get(stats_d))
+            t0 = time.perf_counter()
+            acts: set = set()
             q_head = stats[:D].astype(np.int64)
             q_tail = stats[D:2 * D].astype(np.int64)
             log_n = stats[2 * D:3 * D].astype(np.int64)
@@ -229,7 +270,8 @@ class ShardedTpuChecker(TpuChecker):
             disc_lo = stats[base + 2 * prop_count:base + 3 * prop_count]
             e_n = stats[base + 3 * prop_count:
                         base + 3 * prop_count + D].astype(np.int64)
-            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+            cur.update(q_head=q_head, q_tail=q_tail, log_n=log_n,
+                       e_n=e_n)
             self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
             self._prof["dmax"] = max(self._prof.get("dmax", 0), dmax)
             if size_key is not None:
@@ -242,10 +284,10 @@ class ShardedTpuChecker(TpuChecker):
                     continue  # device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
-            if bool(xovf):
+            if xovf:
                 from ..checker.tpu import _XOVF_MESSAGE
                 raise RuntimeError(_XOVF_MESSAGE)
-            if bool(ovf):
+            if ovf:
                 raise RuntimeError(
                     "device hash table probe overflow below the growth "
                     f"limit (capacity {self._capacity}); raise via "
@@ -254,76 +296,138 @@ class ShardedTpuChecker(TpuChecker):
                     p.name not in discoveries
                     for _i, p in self._host_props):
                 with self._timed("posthoc"):
+                    # the reduction is pinned to THIS chunk's per-shard
+                    # queue tails: under pipelining the live carry
+                    # already holds the next chunk's appends, and
+                    # evaluating them early could report a different
+                    # (later) witness than the synchronous path
                     self._posthoc_sharded(carry, qcap, n_init_arr,
-                                          discoveries)
+                                          discoveries,
+                                          q_tail_h=q_tail)
+            self._prof["host_overlap"] = (
+                self._prof.get("host_overlap", 0.0)
+                + time.perf_counter() - t0)
             if kovf:
-                # a shard's batch outran one of the candidate buffers;
-                # nothing was committed — resize the overflowed stage(s)
-                # (vmax sizes kraw, dmax sizes kmax, bmax sizes the
-                # bucketed exchange's kb) and resume
-                grew = False
-                if vmax > kraw:
-                    kraw = min(max(kraw * 2,
-                                   -(-(vmax + vmax // 4) // 256) * 256),
-                               fa)
-                    grew = True
-                if exchange == "bucket":
-                    kb_now = effective_kb(kmax, D, kb)
-                    if bmax > kb_now:
-                        kb = min(kmax,
-                                 max(kb_now * 2,
-                                     -(-(bmax + bmax // 4) // 256)
-                                     * 256))
-                        grew = True
-                if dmax > kmax or not grew:
-                    kmax = min(max(kmax * 2,
-                                   -(-(dmax + dmax // 4) // 256) * 256),
-                               kraw)
-                kmax = min(kmax, kraw)
-                headroom = max(D * kmax, fmax)
-                chunk_fn = rebuild_chunk()
-                carry = carry._replace(kovf=jnp.bool_(False))
-                continue
-            done = (int((q_tail - q_head).sum()) == 0
+                kovf_pend[0] = max(kovf_pend[0], vmax)
+                kovf_pend[1] = max(kovf_pend[1], dmax)
+                kovf_pend[2] = max(kovf_pend[2], bmax)
+                acts.add("kovf")
+                return acts
+            if (int((q_tail - q_head).sum()) == 0
                     or len(discoveries) == prop_count
                     or (target is not None
-                        and self._state_count >= target))
-            if done:
-                break
-            need_grow = (int(log_n.max()) >= int(grow_limit)
+                        and self._state_count >= target)):
+                acts.add("done")
+                return acts
+            need_grow = (int(log_n.max()) >= grow_limit
                          or int(q_tail.max()) > qcap // D - headroom)
-            if (ecap and not need_grow
-                    and int(e_n.max()) >= ecap // D - headroom):
-                # cross-edge log full: grow JUST the shard-local elog
-                # (cross edges scale with transitions, not states — a
-                # full capacity/table/queue regrow would inflate every
-                # buffer toward O(edges))
-                with self._timed("grow"):
-                    from jax.sharding import (NamedSharding,
-                                              PartitionSpec as P)
-                    old_eloc = ecap // D
-                    ecap *= 4
-                    eloc = ecap // D
-                    elog_h, en_h = jax.device_get(
-                        (carry.elog, carry.e_n))
-                    new_elog = np.zeros((ecap, 4), np.uint32)
-                    for s in range(D):
-                        en = int(en_h[s])
-                        new_elog[s * eloc:s * eloc + en] = \
-                            elog_h[s * old_eloc:s * old_eloc + en]
-                    sh = NamedSharding(mesh, P(axis))
-                    carry = carry._replace(
-                        elog=jax.device_put(new_elog, sh))
-                chunk_fn = rebuild_chunk()
-                continue
             if need_grow:
-                self._prof["grows"] = self._prof.get("grows", 0) + 1
-                carry, qcap = self._grow_sharded(
-                    carry, qcap, n_init, headroom, table_fps, insert_fn)
-                if ecap:
-                    ecap = max(self._capacity, ecap)
-                chunk_fn = rebuild_chunk()
+                acts.add("grow")
+            elif ecap and int(e_n.max()) >= ecap // D - headroom:
+                acts.add("egrow")
+            return acts
 
+        def handle_kovf() -> None:
+            # a shard's batch outran one of the candidate buffers;
+            # nothing was committed — resize the overflowed stage(s)
+            # (vmax sizes kraw, dmax sizes kmax, bmax sizes the
+            # bucketed exchange's kb) and resume
+            nonlocal carry, chunk_fn, kraw, kmax, kb, headroom
+            vmax, dmax, bmax = kovf_pend
+            grew = False
+            if vmax > kraw:
+                kraw = min(max(kraw * 2,
+                               -(-(vmax + vmax // 4) // 256) * 256),
+                           fa)
+                grew = True
+            if exchange == "bucket":
+                kb_now = effective_kb(kmax, D, kb)
+                if bmax > kb_now:
+                    kb = min(kmax,
+                             max(kb_now * 2,
+                                 -(-(bmax + bmax // 4) // 256) * 256))
+                    grew = True
+            if dmax > kmax or not grew:
+                kmax = min(max(kmax * 2,
+                               -(-(dmax + dmax // 4) // 256) * 256),
+                           kraw)
+            kmax = min(kmax, kraw)
+            headroom = max(D * kmax, fmax)
+            kovf_pend[:] = [0, 0, 0]
+            chunk_fn = rebuild_chunk()
+            carry = carry._replace(kovf=jnp.bool_(False))
+
+        def handle_egrow() -> None:
+            # cross-edge log full: grow JUST the shard-local elog
+            # (cross edges scale with transitions, not states — a full
+            # capacity/table/queue regrow would inflate every buffer
+            # toward O(edges))
+            nonlocal carry, chunk_fn, ecap
+            with self._timed("grow"):
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as P)
+                old_eloc = ecap // D
+                ecap *= 4
+                eloc = ecap // D
+                elog_h, en_h = jax.device_get(
+                    (carry.elog, carry.e_n))
+                new_elog = np.zeros((ecap, 4), np.uint32)
+                for s in range(D):
+                    en = int(en_h[s])
+                    new_elog[s * eloc:s * eloc + en] = \
+                        elog_h[s * old_eloc:s * old_eloc + en]
+                sh = NamedSharding(mesh, P(axis))
+                carry = carry._replace(
+                    elog=jax.device_put(new_elog, sh))
+            chunk_fn = rebuild_chunk()
+
+        def handle_grow() -> None:
+            nonlocal carry, chunk_fn, qcap, ecap
+            self._prof["grows"] = self._prof.get("grows", 0) + 1
+            carry, qcap = self._grow_sharded(
+                carry, qcap, n_init, headroom, table_fps, insert_fn)
+            if ecap:
+                ecap = max(self._capacity, ecap)
+            chunk_fn = rebuild_chunk()
+
+        dispatch()
+        while True:
+            if pipeline and len(inflight) == 1:
+                dispatch()
+            acts = process(*inflight.popleft())
+            if not acts:
+                if not inflight:
+                    dispatch()
+                continue
+            # drain the speculative chunk before any host intervention:
+            # under a device-visible stop condition it ran zero
+            # iterations; past a host-only exit it is one extra chunk of
+            # real (merged) exploration
+            while inflight:
+                acts |= process(*inflight.popleft())
+            if "kovf" in acts:
+                handle_kovf()
+            elif "done" in acts:
+                break
+            elif "grow" in acts:
+                handle_grow()
+            elif "egrow" in acts:
+                handle_egrow()
+            dispatch()
+        q_head, q_tail = cur["q_head"], cur["q_tail"]
+        log_n, e_n = cur["log_n"], cur["e_n"]
+
+        if (self._sound and int((q_tail - q_head).sum()) == 0
+                and self._resume_path is not None):
+            import warnings
+            warnings.warn(
+                "resume_from() + sound_eventually(): the post-exhaustion "
+                "lasso sweep is SKIPPED on resumed runs (the "
+                "pre-checkpoint subgraph's edges are not in this run's "
+                "device logs), so liveness cycles entered through "
+                "pre-checkpoint states go unreported. Re-run without "
+                "resume_from() for a cycle-complete liveness verdict.",
+                RuntimeWarning, stacklevel=2)
         if (self._sound and int((q_tail - q_head).sum()) == 0
                 and self._resume_path is None and not self._symmetry):
             # (not under symmetry — cross-branch witnesses cannot replay
@@ -488,11 +592,15 @@ class ShardedTpuChecker(TpuChecker):
 
     # ------------------------------------------------------------------
     def _posthoc_sharded(self, carry: ShardedCarry, qcap: int,
-                         n_init_arr, discoveries: Dict[str, int]) -> None:
+                         n_init_arr, discoveries: Dict[str, int],
+                         q_tail_h=None) -> None:
         """Host-property evaluation over each shard's reached set: local
         device dedup by host-property key, host merge across shards by
         key bytes (memoized), witness fps from the per-shard queue/log
-        lockstep."""
+        lockstep. ``q_tail_h`` (per-shard tails from a chunk's stats)
+        pins the scanned queue prefixes to that chunk's appends — under
+        the pipelined loop the live carry may already hold the NEXT
+        chunk's rows, which must not be evaluated early."""
         import jax
 
         from .sharded import build_sharded_posthoc
@@ -501,14 +609,16 @@ class ShardedTpuChecker(TpuChecker):
         D = mesh.shape[axis]
         model = self._model
         hmax = int(self._tpu_options.get("hmax", 1 << 13))
-        n_init_d = jax.device_put(
-            n_init_arr, jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec(axis)))
+        shard_sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(axis))
+        n_init_d = jax.device_put(n_init_arr, shard_sharding)
+        q_tail_d = (carry.q_tail if q_tail_h is None else jax.device_put(
+            np.asarray(q_tail_h, np.int32), shard_sharding))
         while True:
             fn = build_sharded_posthoc(model, mesh, axis, qcap,
                                        self._capacity, hmax)
             (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf, over) = fn(
-                carry.q, carry.q_tail, carry.log, n_init_d)
+                carry.q, q_tail_d, carry.log, n_init_d)
             hcount, tovf, over = jax.device_get((hcount_d, tovf, over))
             if bool(tovf):
                 raise RuntimeError(
@@ -523,17 +633,15 @@ class ShardedTpuChecker(TpuChecker):
             hc = int(hcount[s])
             if not hc:
                 continue
+            if all(p.name in discoveries for _i, p in self._host_props):
+                return
             wfp = _combine64(whi_h[s][:hc], wlo_h[s][:hc])
             inits = self._init_by_shard[s]
-            for j in range(hc):
-                if all(p.name in discoveries
-                       for _i, p in self._host_props):
-                    return
-                src_j = int(src_h[s][j])
-                fp = (inits[src_j] if src_j < len(inits)
-                      else int(wfp[j]))
-                self._eval_host_props_row(rows_h[s * hmax + j], fp,
-                                          discoveries)
+            fps = [inits[int(src_h[s][j])]
+                   if int(src_h[s][j]) < len(inits) else int(wfp[j])
+                   for j in range(hc)]
+            self._eval_host_props_block(rows_h[s * hmax:s * hmax + hc],
+                                        fps, discoveries)
 
     # ------------------------------------------------------------------
     def _sharded_lasso_sweep(self, carry: ShardedCarry, qcap: int,

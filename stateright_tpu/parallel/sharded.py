@@ -69,6 +69,22 @@ from ..ops.hash_kernel import fp64_device, fp64_node_device
 from ..ops.hashtable import _BUCKET, table_insert
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across JAX versions: the public alias appeared
+    after 0.4.x, where the same primitive lives at
+    ``jax.experimental.shard_map`` with ``check_rep`` instead of
+    ``check_vma``. Both checks are skipped — the hash kernel's scan
+    carry starts axis-invariant and becomes varying; skipping the
+    varying-manual-axes check beats threading pcasts through shared
+    kernels."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
 class ShardedCarry(NamedTuple):
     """Search state, sharded over the mesh axis unless marked replicated.
 
@@ -573,13 +589,9 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         return out, stats
 
     specs = carry_specs(axis)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local_chunk, mesh=mesh,
-        in_specs=(specs, P(), P()), out_specs=(specs, P()),
-        # the hash kernel's scan carry starts axis-invariant and becomes
-        # varying; skip the varying-manual-axes check rather than thread
-        # pcasts through shared kernels
-        check_vma=False)
+        in_specs=(specs, P(), P()), out_specs=(specs, P()))
     return jax.jit(fn, donate_argnums=(0,))
 
 
@@ -596,9 +608,9 @@ def build_sharded_insert(mesh: Mesh, axis: str):
         return khi, klo, lax.psum(ovf.astype(jnp.int32), axis) > 0
 
     s = P(axis)
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map_compat(local, mesh=mesh,
                        in_specs=(s, s, s, s, s),
-                       out_specs=(s, s, P()), check_vma=False)
+                       out_specs=(s, s, P()))
     fn = jax.jit(fn)
     _SHARDED_CACHE[key] = fn
     return fn
@@ -620,9 +632,9 @@ def build_sharded_rebuild(mesh: Mesh, axis: str):
         return khi, klo, lax.psum(ovf.astype(jnp.int32), axis) > 0
 
     s = P(axis)
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map_compat(local, mesh=mesh,
                        in_specs=(s, s, s, s),
-                       out_specs=(s, s, P()), check_vma=False)
+                       out_specs=(s, s, P()))
     fn = jax.jit(fn)
     _SHARDED_CACHE[key] = fn
     return fn
@@ -678,10 +690,10 @@ def build_sharded_posthoc(model, mesh: Mesh, axis: str, qcap: int,
                 hcount[None], tovf, over)
 
     s = P(axis)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=(s, s, s, s),
-        out_specs=(s, s, s, s, s, P(), P()), check_vma=False)
+        out_specs=(s, s, s, s, s, P(), P()))
     fn = jax.jit(fn)
     if key is not None:
         _SHARDED_CACHE[key] = fn
@@ -795,9 +807,9 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                 steps=z, go=f, pavail=z)
 
         s = P(axis)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map_compat(
             local, mesh=mesh, in_specs=(s, s, s, s, s),
-            out_specs=carry_specs(axis), check_vma=False))
+            out_specs=carry_specs(axis)))
         _SHARDED_CACHE[key] = fn
     sh = NamedSharding(mesh, P(axis))
     return fn(jax.device_put(init_block, sh), jax.device_put(q_tail, sh),
