@@ -16,8 +16,10 @@ identical workload. The full n=3 space exceeds a bench budget, so both
 engines run under a generation cap — rates are per-state comparable; the
 cap is >10x the engine's per-chunk granularity so amortization is honest.
 
-Context lines (stderr, one JSON-ish line per workload) cover the FULL
-reference bench harness matrix (`/root/reference/bench.sh:27-34`): 2pc
+Context lines (stderr, one JSON-ish line per workload) carry a compact
+``metrics`` snapshot (chunks, stall fraction, dedup hit-rate — obs
+glossary keys) so BENCH_r*.json rounds can be EXPLAINED across rounds,
+not just ranked, and cover the FULL reference bench harness matrix (`/root/reference/bench.sh:27-34`): 2pc
 check 10, paxos check 6, single-copy-register check 4,
 linearizable-register check 2 + check 3 ordered — plus the BASELINE.json
 secondary metric (time-to-counterexample: single-copy-register and
@@ -39,6 +41,28 @@ N = 3  # samples per workload (best-of-N, all recorded)
 def _median(xs):
     s = sorted(xs)
     return s[len(s) // 2]
+
+
+def _compact_metrics(ck):
+    """Compact obs snapshot for a context line: enough to EXPLAIN a
+    round-over-round regression (growth storms, stall fraction, dedup
+    behavior), not just rank it. Keys: obs.GLOSSARY."""
+    prof = ck.profile()
+    m = {}
+    for k in ("chunks", "levels", "grows", "hgrows", "kovfs",
+              "compiles", "engine", "shard_balance"):
+        if prof.get(k):
+            m[k] = prof[k]
+    search = prof.get("search")
+    if search:
+        for k, label in (("sync_stall", "stall_frac"),
+                         ("host_overlap", "overlap_frac")):
+            if k in prof:
+                m[label] = round(prof[k] / search, 3)
+    uniq, gen = ck.unique_state_count(), ck.state_count()
+    if gen:
+        m["dedup_hit"] = round(1.0 - uniq / gen, 4)
+    return m
 
 
 def _sampled(name, mk, value=None, unit="uniq/s", warmups=2,
@@ -67,7 +91,10 @@ def _sampled(name, mk, value=None, unit="uniq/s", warmups=2,
            "unit": "s" if value == "seconds" else unit,
            "uniq": ck.unique_state_count(),
            "gen": ck.state_count(),
-           "samples": samples}
+           "samples": samples,
+           # last sample's metrics snapshot: explains the round
+           # (stalls, growth storms), not just ranks it
+           "metrics": _compact_metrics(ck)}
     if extra_fn is not None:
         row.update(extra_fn(ck))
     print(json.dumps(row), file=sys.stderr)

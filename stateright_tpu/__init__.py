@@ -29,6 +29,7 @@ from .checker import (
     rewrite_value,
 )
 from .fingerprint import fp64_words, stable_fingerprint, stable_words
+from .obs import GLOSSARY, Metrics, RunTrace
 from .util import DenseNatMap, VectorClock
 
 __version__ = "0.2.0"
@@ -39,6 +40,8 @@ __all__ = [
     "CheckerVisitor",
     "DenseNatMap",
     "Expectation",
+    "GLOSSARY",
+    "Metrics",
     "Model",
     "NondeterministicModelError",
     "Path",
@@ -46,6 +49,7 @@ __all__ = [
     "Property",
     "Representative",
     "RewritePlan",
+    "RunTrace",
     "StateRecorder",
     "VectorClock",
     "fingerprint",

@@ -57,11 +57,18 @@ class BfsChecker(HostChecker):
         visitor = self._visitor
         target = self._target_state_count
 
+        trace = self._trace
+        pops = 0
         cancelled = self._cancel_event.is_set
         while pending:
             if cancelled():
-                return
+                break
             state, state_fp, ebits = pending.popleft()
+            pops += 1
+            if trace and not pops % 4096:
+                trace.emit("progress", gen=self._state_count,
+                           unique=self._unique_state_count,
+                           pending=len(pending))
             # this node's dedup key uses the AT-ENQUEUE bits (dedup
             # happened at enqueue time, before this pop's clearing)
             state_key = self._node_key(state_fp, self._ebits_mask(ebits))
@@ -76,11 +83,13 @@ class BfsChecker(HostChecker):
                 if prop.expectation == Expectation.ALWAYS:
                     if not prop.condition(model, state):
                         discoveries[prop.name] = state_key
+                        self._note_discovery(prop.name, state_key)
                     else:
                         is_awaiting_discoveries = True
                 elif prop.expectation == Expectation.SOMETIMES:
                     if prop.condition(model, state):
                         discoveries[prop.name] = state_key
+                        self._note_discovery(prop.name, state_key)
                     else:
                         is_awaiting_discoveries = True
                 else:  # EVENTUALLY: discoveries only surface at terminals.
@@ -120,6 +129,7 @@ class BfsChecker(HostChecker):
                     # properties) overwrites the real witness
                     if i in ebits and prop.name not in discoveries:
                         discoveries[prop.name] = state_key
+                        self._note_discovery(prop.name, state_key)
             if target is not None and self._state_count >= target:
                 return
 

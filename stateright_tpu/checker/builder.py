@@ -85,14 +85,20 @@ class CheckerBuilder:
 
     def tpu_options(self, **options) -> "CheckerBuilder":
         """Tuning knobs for ``spawn_tpu`` (table capacity, batch caps,
-        mesh selection, ...). Notable: ``pipeline`` (default ``True``)
-        double-buffers the chunk loop — chunk N+1 is dispatched while
-        the host consumes chunk N's stats, hiding stats decode and
-        host-property evaluation under the accelerator; set
-        ``pipeline=False`` to force the synchronous
-        dispatch-sync-process loop (debugging, latency A/B — observable
-        results are identical either way, see ``profile()``'s
-        ``dispatch``/``sync_stall``/``host_overlap`` timers)."""
+        mesh selection, ...). Notable:
+
+        * ``pipeline`` (default ``True``) double-buffers the chunk
+          loop — chunk N+1 is dispatched while the host consumes chunk
+          N's stats; ``pipeline=False`` forces the synchronous loop
+          (debugging, latency A/B — observable results are identical
+          either way, measurable via ``profile()``'s overlap timers);
+        * ``trace=<path | file | callable | list>`` enables the
+          structured run-trace: every engine (host engines included)
+          emits timestamped JSONL events (chunk completed, growth and
+          resize interventions, compiles, discoveries, ...) to the
+          sink, at zero cost when unset. Format and the metrics key
+          glossary: README.md § Observability and
+          ``stateright_tpu.obs``."""
         self.tpu_options_.update(options)
         return self
 
@@ -180,6 +186,33 @@ class Checker:
         """The engine's failure, if any (overridden by engines)."""
         return None
 
+    def profile(self) -> Dict[str, float]:
+        """Snapshot of the run's metrics registry (phase timers,
+        counters, observed maxima). Key meanings are documented once,
+        in ``stateright_tpu.obs.GLOSSARY`` (rendered in README.md
+        § Observability). Engines without instrumentation report {}."""
+        return {}
+
+    def _metrics_summary(self, elapsed: float) -> str:
+        """One compact ``# key=value ...`` line from the metrics
+        registry (empty when there is nothing beyond the raw timer)."""
+        prof = self.profile()
+        parts: List[str] = []
+        if "engine" in prof:
+            parts.append(f"engine={prof['engine']}")
+        for key in ("chunks", "levels", "jobs", "grows", "hgrows",
+                    "kovfs", "compiles"):
+            if prof.get(key):
+                parts.append(f"{key}={int(prof[key])}")
+        if elapsed > 0 and "sync_stall" in prof:
+            parts.append(f"stall={prof['sync_stall'] / elapsed:.0%}")
+        if elapsed > 0 and "host_overlap" in prof:
+            parts.append(
+                f"overlap={prof['host_overlap'] / elapsed:.0%}")
+        if "shard_balance" in prof:
+            parts.append(f"shard_balance={prof['shard_balance']}")
+        return "# " + " ".join(parts) if parts else ""
+
     def discovery(self, name: str) -> Optional[Path]:
         return self.discoveries().get(name)
 
@@ -187,7 +220,10 @@ class Checker:
         """Periodic status lines + discovery summary (`src/checker.rs:217-242`).
 
         Emits ``Checking. states=N, unique=N`` once per second while running,
-        then ``Done. states=N, unique=N, sec=S`` and one block per discovery.
+        then ``Done. states=N, unique=N, sec=S[, rate=R/s]``, a compact
+        ``# chunks=... stall=...`` metrics line when the engine recorded
+        any (key glossary: ``stateright_tpu.obs.GLOSSARY``), and one
+        block per discovery.
         """
         start = time.monotonic()
         if not self.is_done():
@@ -211,6 +247,9 @@ class Checker:
         w.write(f"Done. states={self.state_count()}, "
                 f"unique={self.unique_state_count()}, "
                 f"sec={int(elapsed)}{rate}\n")
+        summary = self._metrics_summary(elapsed)
+        if summary:
+            w.write(summary + "\n")
         for name, path in self.discoveries().items():
             w.write(f'Discovered "{name}" '
                     f"{self.discovery_classification(name)} {path}")

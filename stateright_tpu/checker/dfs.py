@@ -84,8 +84,17 @@ class DfsChecker(HostChecker):
 
         lasso = self._lasso
 
+        trace = self._trace
+        pops = 0
         while pending:
+            if self._cancel_event.is_set():
+                return
             state, fingerprints, ebits, on_path, node_key = pending.pop()
+            pops += 1
+            if trace and not pops % 4096:
+                trace.emit("progress", gen=self._state_count,
+                           unique=self._unique_state_count,
+                           pending=len(pending))
             if visitor is not None:
                 visitor.visit(model,
                               Path.from_fingerprints(model, fingerprints))
@@ -98,11 +107,13 @@ class DfsChecker(HostChecker):
                 if prop.expectation == Expectation.ALWAYS:
                     if not prop.condition(model, state):
                         discoveries[prop.name] = list(fingerprints)
+                        self._note_discovery(prop.name, fingerprints)
                     else:
                         is_awaiting_discoveries = True
                 elif prop.expectation == Expectation.SOMETIMES:
                     if prop.condition(model, state):
                         discoveries[prop.name] = list(fingerprints)
+                        self._note_discovery(prop.name, fingerprints)
                     else:
                         is_awaiting_discoveries = True
                 else:  # EVENTUALLY
@@ -148,6 +159,8 @@ class DfsChecker(HostChecker):
                         if i in ebits and prop.name not in discoveries:
                             discoveries[prop.name] = \
                                 fingerprints + [next_fp]
+                            self._note_discovery(
+                                prop.name, fingerprints + [next_fp])
                 next_key = self._node_key(rep_fp, child_mask)
                 if lasso and child_mask:
                     # record EVERY edge between still-pending nodes
@@ -178,6 +191,7 @@ class DfsChecker(HostChecker):
                     # evaluated) must not overwrite the real witness
                     if i in ebits and prop.name not in discoveries:
                         discoveries[prop.name] = list(fingerprints)
+                        self._note_discovery(prop.name, fingerprints)
             if target is not None and self._state_count >= target:
                 return
 

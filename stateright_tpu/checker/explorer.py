@@ -10,6 +10,10 @@ server. The API is identical:
   discoveries (as encoded fingerprint paths), and a recently visited path
   sampled by a snapshot visitor re-armed every 4 seconds
   (`explorer.rs:76-84`);
+* ``GET /.metrics`` — our addition beyond the reference: the engine's
+  live metrics registry (per-chunk stats, phase timers, growth
+  counters; key glossary in ``stateright_tpu.obs.GLOSSARY``), served
+  mid-run for dashboards/polling;
 * ``GET /.states/{fp}/{fp}/...`` — a state is addressed by the fingerprint
   path from an init state (`explorer.rs:159-240`): the server replays the
   model to the addressed state on every request and returns one
@@ -105,14 +109,30 @@ def status_view(checker, snapshot: Optional[Snapshot]) -> Dict[str, Any]:
         "properties": properties,
         "recent_path": recent,
     }
-    profile = getattr(checker, "profile", None)
-    if profile is not None:
-        # live device-loop progress for engine='tpu': completed chunk
-        # dispatches (each chunk is up to chunk_steps frontier levels)
-        chunks = profile().get("chunks")
-        if chunks:
-            out["chunks"] = int(chunks)
+    # live device-loop progress for engine='tpu': completed chunk
+    # dispatches (each chunk is up to chunk_steps frontier levels).
+    # The full registry lives at GET /.metrics; this field stays for
+    # UI compatibility.
+    chunks = checker.profile().get("chunks")
+    if chunks:
+        out["chunks"] = int(chunks)
     return out
+
+
+def metrics_view(checker) -> Dict[str, Any]:
+    """The ``GET /.metrics`` payload: live per-chunk stats straight
+    from the engine's metrics registry (keys:
+    ``stateright_tpu.obs.GLOSSARY``), replacing the old pattern of
+    polling ``/.status`` for its single ``chunks`` field. Served
+    mid-run — counts may be partial until ``done``."""
+    prof = checker.profile()
+    return {
+        "done": checker.is_done(),
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "profile": {k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in prof.items()},
+    }
 
 
 def parse_fingerprints(fingerprints_str: str) -> List[int]:
@@ -203,6 +223,8 @@ def _make_handler(checker, snapshot: Optional[Snapshot]):
             try:
                 if path == "/.status":
                     self._send_json(200, status_view(checker, snapshot))
+                elif path == "/.metrics":
+                    self._send_json(200, metrics_view(checker))
                 elif path == "/.states" or path.startswith("/.states/"):
                     fps = parse_fingerprints(path[len("/.states"):])
                     self._send_json(200, state_views(model, fps))

@@ -12,10 +12,12 @@ the TPU engine is differentially tested against.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
 from ..core import Expectation, Model
 from ..fingerprint import fp64_node
+from ..obs import Metrics, fault_info, make_trace
 from .builder import Checker, CheckerBuilder
 
 
@@ -36,6 +38,40 @@ class HostChecker(Checker):
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
         self._cancel_event = threading.Event()
+        # unified observability (obs/): every engine records into ONE
+        # Metrics registry behind profile(), and emits structured
+        # run-trace events when tpu_options(trace=...) names a sink
+        self._metrics = Metrics()
+        self._trace = make_trace(builder.tpu_options_.get("trace"),
+                                 engine=type(self).__name__)
+
+    def _timed(self, name: str):
+        """Accumulate wall time under a glossary phase key."""
+        return self._metrics.timed(name)
+
+    def profile(self) -> Dict[str, float]:
+        """Snapshot of the run's metrics registry: phase timers
+        (wall-seconds), counters, and observed maxima. Key meanings are
+        pinned in ONE place — ``stateright_tpu.obs.GLOSSARY`` (also
+        rendered in README.md § Observability) — rather than restated
+        per engine; engines report only the phases they run."""
+        return self._metrics.snapshot()
+
+    def subscribe(self, fn) -> None:
+        """Register a live progress callback on the run trace (requires
+        an enabled trace, e.g. ``tpu_options(trace=[])``); ``fn`` is
+        invoked with every emitted event dict."""
+        self._trace.subscribe(fn)
+
+    def _note_discovery(self, name: str, fp) -> None:
+        """Emit the trace event for a just-recorded discovery
+        (fingerprints are stringified: uint64 exceeds JSON-safe ints)."""
+        trace = self._trace
+        if trace:
+            trace.emit("discovery", property=name,
+                       fp=([str(int(f)) for f in fp]
+                           if isinstance(fp, (list, tuple))
+                           else str(int(fp))))
 
     def cancel(self) -> None:
         """Cooperatively stop the run (checked at engine loop points);
@@ -99,12 +135,29 @@ class HostChecker(Checker):
                 self._thread.start()
 
     def _run_wrapper(self) -> None:
+        trace = self._trace
+        if trace:
+            trace.emit("run_start", model=type(self._model).__name__,
+                       wall=time.time(),
+                       properties=len(self._properties))
+            faults = fault_info(self._model)
+            if faults is not None:
+                trace.emit("fault_injection", **faults)
         try:
-            self._run()
+            with self._metrics.timed("search"):
+                self._run()
         except BaseException as exc:  # re-raised at join()
             self._error = exc
+            if trace:
+                trace.emit("error",
+                           error=f"{type(exc).__name__}: {exc}")
         finally:
             self._done = True
+            if trace:
+                trace.emit("done", gen=self._state_count,
+                           unique=self._unique_state_count,
+                           cancelled=self._cancel_event.is_set(),
+                           discoveries=sorted(self._discovery_fps))
 
     def _init_ebits(self) -> frozenset:
         """Bit per not-yet-satisfied ``eventually`` property

@@ -142,7 +142,9 @@ class ParallelBfsChecker(HostChecker):
             if not properties:
                 return
 
+            trace = self._trace
             while frontier:
+                flen = len(frontier)
                 n_blocks = min(len(frontier), self._workers * 4)
                 size = -(-len(frontier) // n_blocks)
                 blocks = [frontier[i:i + size]
@@ -152,13 +154,22 @@ class ParallelBfsChecker(HostChecker):
                 for gen_count, block_disc, children in results:
                     self._state_count += gen_count
                     for name, fp in block_disc.items():
-                        discoveries.setdefault(name, fp)
+                        if name not in discoveries:
+                            discoveries[name] = fp
+                            self._note_discovery(name, fp)
                     for fp, parent_fp, child, ebits in children:
                         if fp in generated:
                             continue
                         generated[fp] = parent_fp
                         frontier.append((child, fp, ebits))
                 self._unique_state_count = len(generated)
+                self._metrics.inc("levels")
+                if trace:
+                    trace.emit(
+                        "level",
+                        level=int(self._metrics.get("levels")),
+                        frontier=flen, gen=self._state_count,
+                        unique=self._unique_state_count)
                 if len(discoveries) == len(properties):
                     return
                 if target is not None and self._state_count >= target:

@@ -283,13 +283,20 @@ class ParallelDfsChecker(HostChecker):
                 if msg is not None:
                     kind = msg[0]
                     if kind == "disc":
-                        discoveries.setdefault(msg[1], msg[2])
+                        if msg[1] not in discoveries:
+                            discoveries[msg[1]] = msg[2]
+                            self._note_discovery(msg[1], msg[2])
                         if len(discoveries) == len(properties):
                             break
                     elif kind == "done":
                         self._state_count += msg[1]
                         self._unique_state_count = int(
                             np.count_nonzero(table))
+                        self._metrics.inc("jobs")
+                        if self._trace:
+                            self._trace.emit(
+                                "progress", gen=self._state_count,
+                                unique=self._unique_state_count)
                     else:  # error
                         raise RuntimeError(
                             f"DFS worker failed: {msg[1]}")
@@ -307,9 +314,12 @@ class ParallelDfsChecker(HostChecker):
                 except queue_mod.Empty:
                     break
                 if msg[0] == "disc":
-                    discoveries.setdefault(msg[1], msg[2])
+                    if msg[1] not in discoveries:
+                        discoveries[msg[1]] = msg[2]
+                        self._note_discovery(msg[1], msg[2])
                 elif msg[0] == "done":
                     self._state_count += msg[1]
+                    self._metrics.inc("jobs")
             # exact unique count: racing claims can store a fingerprint
             # in two slots, so the count dedups the table contents. The
             # deduplicated set also backs generated_fingerprints().

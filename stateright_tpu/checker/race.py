@@ -186,11 +186,19 @@ class RacingChecker(Checker):
         return tpu.unique_state_count() if tpu is not None else 0
 
     def profile(self):
-        """Wall-time per engine phase — the device checker's surface;
-        a host-won race has no device phases and reports {}."""
+        """The WINNING engine's metrics snapshot (keys documented in
+        ``stateright_tpu.obs.GLOSSARY``), tagged with which engine won:
+        ``engine`` is ``"host"`` for the budgeted host racer,
+        ``"device"`` for the device engine. A host win used to report
+        ``{}``; now both outcomes carry the winner's real phase
+        timers/counters."""
+        from .bfs import BfsChecker
+
         winner = self._decide()
-        prof = getattr(winner, "profile", None)
-        return prof() if prof is not None else {}
+        prof = winner.profile()
+        prof["engine"] = ("host" if isinstance(winner, BfsChecker)
+                          else "device")
+        return prof
 
     def discoveries(self):
         return self._decide().discoveries()

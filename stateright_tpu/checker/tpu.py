@@ -47,7 +47,6 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -362,8 +361,8 @@ class TpuChecker(HostChecker):
                 "(the open-addressing probe ring masks by bucket count)")
         self._h_pulled = 0  # representatives already host-evaluated
         self._hscan_tail = 0  # queue rows known fully history-deduped
-        # wall-time per engine phase (seconds), for report()/bench tuning
-        self._prof: Dict[str, float] = {}
+        # phase timers/counters ride the shared obs registry
+        # (HostChecker._metrics); keys are the obs.GLOSSARY canon
         # device-resident search record, pulled lazily by _ensure_mirror
         self._mirror_carry = None
         # most recently enqueued queue row (rides each chunk sync) —
@@ -388,35 +387,9 @@ class TpuChecker(HostChecker):
                     "model to implement packed_representative (the device "
                     "canonicalization); use spawn_dfs() otherwise")
 
-    @contextmanager
-    def _timed(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._prof[name] = (self._prof.get(name, 0.0)
-                                + time.perf_counter() - t0)
-
-    def profile(self) -> Dict[str, float]:
-        """Wall-time spent per engine phase (seconds) plus observed-size
-        counters. The chunk loop reports three timers that make the
-        host/device overlap observable:
-
-        * ``dispatch`` — host time spent launching chunk programs (async;
-          small unless tracing/compiling),
-        * ``sync_stall`` — time blocked materializing a chunk's stats
-          vector (the device round trip the pipeline hides host work
-          under; if this dominates, the device is the bottleneck — try a
-          larger ``fmax``/``chunk_steps``),
-        * ``host_overlap`` — host-side consumption of a chunk's outputs
-          (stats decode, batched host-property evaluation, discovery
-          bookkeeping) that runs while the NEXT chunk is already in
-          flight under ``tpu_options(pipeline=True)`` (the default).
-
-        Other keys: ``seed``, ``grow``/``hgrow``, ``posthoc``,
-        ``lasso``, ``mirror_pull``, ``visit``, the ``chunks`` counter,
-        and the observed branching maxima ``vmax``/``dmax``/``rmax``."""
-        return dict(self._prof)
+    # _timed/profile() come from HostChecker: ONE metrics registry per
+    # run, keys documented once in stateright_tpu.obs.GLOSSARY (the
+    # overlap timers dispatch/sync_stall/host_overlap included).
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
@@ -653,7 +626,12 @@ class TpuChecker(HostChecker):
             # in-flight seed slowed the loop ~2.5x no longer reproduces
             # with the consolidated carry (q/log matrices, 2-D table);
             # PJRT orders the dependent programs itself.
-        def mk_chunk():
+        def mk_chunk(reason: str = "initial"):
+            # every rebuild implies an XLA retrace (unless the shapes
+            # hit the compile cache) — count it and leave a trace event
+            self._metrics.inc("compiles")
+            if self._trace:
+                self._trace.emit("compile", reason=reason)
             return build_chunk_fn(model, qcap, self._capacity, fmax,
                                   kmax, symmetry=self._symmetry,
                                   sound=self._sound, hcap=hcap,
@@ -701,7 +679,7 @@ class TpuChecker(HostChecker):
                 # history dedup is dead work now (and, saturated, would
                 # stall the loop via hovf) — rebuild without it
                 hcap = 0
-                chunk_fn = mk_chunk()
+                chunk_fn = mk_chunk("hdrop")
             grow_limit = np.int32(min(
                 self._grow_at * self._capacity,
                 self._capacity - headroom))
@@ -716,7 +694,7 @@ class TpuChecker(HostChecker):
                                           np.int32(self._h_pulled))
             inflight.append((stats_d, self._h_pulled, int(grow_limit),
                              hcap))
-            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+            self._metrics.inc("chunks")
 
         def process(stats_d, h_base: int, grow_limit: int,
                     hcap_d: int) -> set:
@@ -745,23 +723,38 @@ class TpuChecker(HostChecker):
             if q_tail > 0:
                 # most recently enqueued state (live Explorer progress)
                 self._recent_row = stats[tail0:tail0 + width3].copy()
+            new = log_n - cur["log_n"]  # this chunk's fresh inserts
             cur.update(q_size=q_tail - q_head, q_tail=q_tail,
                        log_n=log_n, e_n=e_n)
             # observed branching (raw / post-dedup), for tuning
             # model.branching_hint and the kraw/kmax buffer sizes
-            self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
-            self._prof["dmax"] = max(self._prof.get("dmax", 0), dmax)
-            self._prof["rmax"] = max(self._prof.get("rmax", 0), rmax)
+            metrics = self._metrics
+            metrics.observe_max("vmax", vmax)
+            metrics.observe_max("dmax", dmax)
+            metrics.observe_max("rmax", rmax)
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen
             self._unique_state_count = base_unique + log_n
+            trace = self._trace
+            if trace:
+                trace.emit(
+                    "chunk", chunk=int(metrics.get("chunks", 0)),
+                    gen=gen, unique=self._unique_state_count,
+                    q_size=q_tail - q_head, new=new,
+                    # dedup hit-rate: generated children this chunk
+                    # that were already in the visited table
+                    dedup_hit=(round(1.0 - new / gen, 4) if gen else 0.0),
+                    # hash-table load factor (growth trips near grow_at)
+                    load=round(log_n / self._capacity, 4),
+                    vmax=vmax, dmax=dmax)
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:
                     continue  # host-evaluated: device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
+                    self._note_discovery(prop.name, int(disc_fps[i]))
             if seed_ovf is not None:
                 if bool(jax.device_get(seed_ovf)):
                     raise RuntimeError(
@@ -812,9 +805,8 @@ class TpuChecker(HostChecker):
                         h_n=max(hgrow_pend["h_n"], h_n))
                 else:
                     self._hscan_tail = q_tail
-            self._prof["host_overlap"] = (
-                self._prof.get("host_overlap", 0.0)
-                + time.perf_counter() - t0)
+            self._metrics.add_time("host_overlap",
+                                   time.perf_counter() - t0)
             if kovf:
                 # resize data for the drained handler; skip the exit
                 # checks exactly like the synchronous retry `continue`
@@ -866,8 +858,12 @@ class TpuChecker(HostChecker):
                     if not rescan_ovf:
                         break
             self._hscan_tail = q_tail
+            self._metrics.inc("hgrows")
+            if self._trace:
+                self._trace.emit("hgrow", hcap=hcap,
+                                 hovf=hgrow_pend["hovf"], h_n=h_n)
             hgrow_pend.update(on=False, hovf=False, h_n=0)
-            chunk_fn = mk_chunk()
+            chunk_fn = mk_chunk("hgrow")
 
         def handle_kovf() -> None:
             # a batch overflowed one of the candidate buffers; nothing
@@ -895,8 +891,13 @@ class TpuChecker(HostChecker):
                            else fmax * hint_eff)
             kmax = min(kmax, kraw if not hint_eff
                        else fmax * hint_eff)
+            self._metrics.inc("kovfs")
+            if self._trace:
+                self._trace.emit("kovf", kraw=kraw, kmax=kmax,
+                                 vmax=kovf_pend[0], dmax=kovf_pend[1],
+                                 rmax=kovf_pend[2])
             kovf_pend[:] = [0, 0, 0]
-            chunk_fn = mk_chunk()
+            chunk_fn = mk_chunk("kovf")
             carry = carry._replace(kovf=jnp.bool_(False))
 
         def handle_egrow() -> None:
@@ -908,14 +909,20 @@ class TpuChecker(HostChecker):
                     new_elog, carry.elog, (0, 0))
                 ecap *= 4
                 carry = carry._replace(elog=new_elog)
-            chunk_fn = mk_chunk()
+            if self._trace:
+                self._trace.emit("egrow", ecap=ecap)
+            chunk_fn = mk_chunk("egrow")
 
         def handle_grow() -> None:
             nonlocal carry, chunk_fn, qcap
             with self._timed("grow"):
                 carry, qcap = self._grow_device(carry, qcap, n_init,
                                                 headroom, insert_fn)
-            chunk_fn = mk_chunk()
+            self._metrics.inc("grows")
+            if self._trace:
+                self._trace.emit("grow", capacity=self._capacity,
+                                 qcap=qcap)
+            chunk_fn = mk_chunk("grow")
 
         dispatch()
         while True:
@@ -1025,6 +1032,10 @@ class TpuChecker(HostChecker):
                       log_h[:log_n], eb_h[:log_n], edges_h[:e_n])
         lasso_sweep(self._properties, discoveries, node_edges,
                     node_mask, node_parent, node_fp)
+        if self._trace:
+            self._trace.emit(
+                "lasso", nodes=len(node_mask),
+                edges=sum(len(v) for v in node_edges.values()))
 
     def _visit_reached(self) -> None:
         """Drive the CheckerVisitor over every reached state — the device
@@ -1110,8 +1121,10 @@ class TpuChecker(HostChecker):
                 visited += 1
         # observability for the refcounted drop: the maximum number of
         # decoded states resident at once during the replay
-        self._prof["visit_peak_resident"] = max(
-            self._prof.get("visit_peak_resident", 0), peak)
+        self._metrics.observe_max("visit_peak_resident", peak)
+        if self._trace:
+            self._trace.emit("visit", visited=visited,
+                             peak_resident=peak)
         if visited != len(self._generated):  # pragma: no cover
             raise NondeterministicModelError(
                 "visitation replay stalled: a parent chain in the "
@@ -1362,6 +1375,8 @@ class TpuChecker(HostChecker):
 
         with self._timed("mirror_pull"):
             log_n = int(jax.device_get(log_n_d))
+            if self._trace:
+                self._trace.emit("mirror_pull", n=log_n)
             if not log_n:
                 return
             # pull only the live prefix (pow2-padded slice jitted on
@@ -1490,6 +1505,7 @@ class TpuChecker(HostChecker):
                     continue  # host-evaluated: device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
+                    self._note_discovery(prop.name, int(disc_fps[i]))
 
             # mirror the newly inserted (fingerprint, parent) pairs:
             # 16 bytes per new state over the host link
@@ -1528,6 +1544,14 @@ class TpuChecker(HostChecker):
                         comp_eb = self._clear_ebits_jit(nb)(
                             comp_eb, jnp.asarray(ev_clear))
             self._unique_state_count = len(generated)
+            # one "level" event per frontier segment (a level splits
+            # into segments of at most max_segment rows)
+            self._metrics.inc("levels")
+            if self._trace:
+                self._trace.emit(
+                    "level", level=int(self._metrics.get("levels")),
+                    frontier=length, gen=self._state_count,
+                    unique=self._unique_state_count)
 
             if len(discoveries) == prop_count:
                 return
@@ -1555,8 +1579,10 @@ class TpuChecker(HostChecker):
             res = bool(prop.condition(self._model, state))
             if prop.expectation == Expectation.ALWAYS and not res:
                 discoveries[prop.name] = fp
+                self._note_discovery(prop.name, fp)
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
+                self._note_discovery(prop.name, fp)
 
     _CLEAR_JITS: dict = {}
 
@@ -1616,8 +1642,10 @@ class TpuChecker(HostChecker):
                 continue
             if prop.expectation == Expectation.ALWAYS and not res:
                 discoveries[prop.name] = fp
+                self._note_discovery(prop.name, fp)
             elif prop.expectation == Expectation.SOMETIMES and res:
                 discoveries[prop.name] = fp
+                self._note_discovery(prop.name, fp)
 
     def _eval_host_props_block(self, rows, fps,
                                discoveries: Dict[str, int]) -> None:
@@ -1658,8 +1686,10 @@ class TpuChecker(HostChecker):
                     continue
                 if prop.expectation == Expectation.ALWAYS and not res:
                     discoveries[prop.name] = fp
+                    self._note_discovery(prop.name, fp)
                 elif prop.expectation == Expectation.SOMETIMES and res:
                     discoveries[prop.name] = fp
+                    self._note_discovery(prop.name, fp)
             if all(p.name in discoveries for _i, p in host_props):
                 return
 

@@ -1,0 +1,31 @@
+"""Unified observability for the checking engines.
+
+* :class:`~stateright_tpu.obs.metrics.Metrics` — the per-run metrics
+  registry (counters, phase timers, observed maxima) behind every
+  engine's ``profile()``, with the canonical key glossary
+  :data:`~stateright_tpu.obs.metrics.GLOSSARY`.
+* :class:`~stateright_tpu.obs.trace.RunTrace` — the structured JSONL
+  run-trace event stream enabled via ``tpu_options(trace=...)``
+  (zero-cost :data:`~stateright_tpu.obs.trace.NULL_TRACE` when off),
+  with per-event requirements pinned by
+  :data:`~stateright_tpu.obs.trace.EVENT_SCHEMA`.
+
+See README.md § Observability for the trace format and how to read a
+stall; ``tools/trace_report.py`` renders a trace as a per-phase table.
+"""
+
+from .metrics import GLOSSARY, Metrics
+from .trace import (EVENT_SCHEMA, NULL_TRACE, NullTrace, RunTrace,
+                    fault_info, make_trace, validate_event)
+
+__all__ = [
+    "EVENT_SCHEMA",
+    "GLOSSARY",
+    "Metrics",
+    "NULL_TRACE",
+    "NullTrace",
+    "RunTrace",
+    "fault_info",
+    "make_trace",
+    "validate_event",
+]

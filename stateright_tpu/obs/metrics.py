@@ -1,0 +1,145 @@
+"""The metrics registry shared by every checking engine.
+
+One ``Metrics`` object per checker replaces the per-engine ad-hoc
+``self._prof`` dicts that had drifted apart (inconsistent keys, missing
+phases on some engines, ``{}`` from a host-won race). The registry is a
+flat ``key -> number`` map — cheap enough for per-chunk hot paths — with
+three access idioms (counters, phase timers, observed maxima) and ONE
+canonical key glossary, :data:`GLOSSARY`, that every ``profile()``
+docstring references instead of restating.
+
+Key-name conventions:
+
+* phase timers are wall-seconds and use bare phase names (``dispatch``,
+  ``sync_stall``, ``grow``);
+* counters are integral and plural where natural (``chunks``,
+  ``grows``);
+* observed maxima keep their engine names (``vmax``/``dmax``/``rmax``).
+
+Engines that historically used divergent keys now agree: the sharded
+engine's growth pass reports BOTH the ``grow`` timer and the ``grows``
+counter, exactly like the single-chip engine (which gained ``grows``);
+``hgrow`` remains a distinct key because it times a different structure
+(the host-property history table), not a naming drift.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+#: The canonical metrics glossary. ``Checker.profile()`` returns a
+#: snapshot whose keys are drawn from this table (engines only report
+#: the phases they run). Timers are wall-clock seconds; counters and
+#: maxima are integers.
+GLOSSARY: Dict[str, str] = {
+    # --- device chunk-loop phase timers (single-chip + sharded) -------
+    "seed": "building + inserting the initial frontier/table buffers",
+    "dispatch": "host time launching chunk programs (async; small "
+                "unless tracing/compiling)",
+    "sync_stall": "time blocked materializing a chunk's stats vector — "
+                  "the device round trip the pipeline hides host work "
+                  "under; if it dominates, the device is the "
+                  "bottleneck (try a larger fmax/chunk_steps)",
+    "host_overlap": "host-side consumption of a chunk's outputs (stats "
+                    "decode, batched host-property evaluation, "
+                    "discovery bookkeeping) that overlaps the NEXT "
+                    "in-flight chunk under tpu_options(pipeline=True)",
+    "grow": "hash-table/queue/log growth passes (rebuild + re-insert)",
+    "hgrow": "host-property history-table growth (re-seed + rescan)",
+    "posthoc": "host-property evaluation over pulled representatives",
+    "lasso": "post-exhaustion SCC sweep (sound_eventually)",
+    "mirror_pull": "pulling the device (child, parent) log into the "
+                   "host mirror",
+    "visit": "post-hoc CheckerVisitor replay over the reached set",
+    # --- counters ----------------------------------------------------
+    "chunks": "completed chunk dispatches (each up to chunk_steps "
+              "frontier levels)",
+    "grows": "table growth passes taken",
+    "hgrows": "history-table growth passes taken",
+    "kovfs": "candidate-buffer overflow retries (kraw/kmax resizes)",
+    "compiles": "chunk-program (re)builds — each implies an XLA "
+                "retrace unless the shapes hit the compile cache",
+    "levels": "BFS levels completed (host/per-level engines)",
+    "jobs": "DFS stack jobs completed (multi-process DFS)",
+    # --- observed maxima (buffer autotuning inputs) -------------------
+    "vmax": "max raw-valid candidate lanes in one iteration (sizes "
+            "kraw; compare against fmax*max_actions)",
+    "dmax": "max post-dedup survivors in one iteration (sizes kmax)",
+    "rmax": "max valid children of a single row (sizes "
+            "tpu_options(hint=...))",
+    "visit_peak_resident": "max decoded states resident during the "
+                           "visitor replay (bounded by path depth)",
+    # --- gauges --------------------------------------------------------
+    "shard_balance": "end-of-run min/max ratio of per-shard inserted "
+                     "states (1.0 = perfectly balanced routing)",
+    "engine": "race winner tag on a raced spawn_tpu profile: 'host' "
+              "or 'device'",
+    # --- host search timers -------------------------------------------
+    "search": "host-engine search loop wall time",
+}
+
+
+class Metrics:
+    """Counters, phase timers and observed maxima for one checker run.
+
+    The backing store is a plain dict so ``snapshot()`` is O(keys) and
+    hot-path updates are one dict op; the counter/timer/maximum
+    distinction lives in :data:`GLOSSARY`, not in per-key objects.
+    """
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: Optional[Dict[str, float]] = None):
+        self._data: Dict[str, float] = dict(data) if data else {}
+
+    # --- update idioms ------------------------------------------------
+    def inc(self, key: str, n: int = 1) -> None:
+        self._data[key] = self._data.get(key, 0) + n
+
+    def add_time(self, key: str, seconds: float) -> None:
+        self._data[key] = self._data.get(key, 0.0) + seconds
+
+    @contextmanager
+    def timed(self, key: str):
+        """Accumulate wall time under ``key`` (the phase-timer idiom)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_time(key, time.perf_counter() - t0)
+
+    def observe_max(self, key: str, value: float) -> None:
+        cur = self._data.get(key)
+        if cur is None or value > cur:
+            self._data[key] = value
+
+    def set(self, key: str, value: float) -> None:
+        self._data[key] = value
+
+    # --- read side ----------------------------------------------------
+    def get(self, key: str, default=None):
+        return self._data.get(key, default)
+
+    def snapshot(self) -> Dict[str, float]:
+        """A copy of every recorded metric (the ``profile()`` payload)."""
+        return dict(self._data)
+
+    def merge(self, other: "Metrics") -> None:
+        """Fold ``other`` in: timers/counters add, maxima take max.
+
+        Used by consumers that aggregate engines (e.g. the host-vs-
+        device race reporting the winner on top of its own bookkeeping).
+        """
+        for key, value in other._data.items():
+            if key in ("vmax", "dmax", "rmax", "visit_peak_resident"):
+                self.observe_max(key, value)
+            else:
+                self.add_time(key, value)
+
+    def __bool__(self) -> bool:
+        return bool(self._data)
+
+    def __repr__(self) -> str:  # debugging aid
+        return f"Metrics({self._data!r})"

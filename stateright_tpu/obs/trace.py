@@ -1,0 +1,214 @@
+"""Structured run-trace: timestamped JSONL events from every engine.
+
+The checker's legacy progress surface was one coarse ``Checking.
+states=N`` line (`src/checker.rs:217-242`) — nothing recorded *when*
+anything happened, so pipeline stalls, hash-table growth storms and
+shard imbalance were invisible after a run. ``RunTrace`` is the
+replacement: engines emit small dict events (chunk completed, growth,
+candidate-buffer resize, compile, discovery, mirror pull, ...) to a
+sink configured via ``tpu_options(trace=...)``:
+
+* a **path** (``str``/``os.PathLike``): JSONL appended line-per-event
+  (line-buffered, one ``write()`` per event, so a host-vs-device race
+  writing from two engines interleaves whole lines, each tagged with
+  its ``engine``);
+* a **file-like** object (has ``write``): same JSONL lines;
+* a **callable**: called with each event dict (in-process consumers —
+  the perf tools attach collectors this way);
+* a **list**: events appended as dicts.
+
+Tracing is **zero-cost when off**: with no sink and no subscribers the
+checker holds the module singleton :data:`NULL_TRACE`, whose truth
+value is ``False`` — engines guard event construction with
+``if trace:`` so no dict is ever built. Event timestamps (``t``) are
+seconds since the trace was created (monotonic); the ``run_start``
+event carries the wall-clock epoch for cross-run alignment.
+Fingerprints are emitted as **strings**: they are uint64 and JSON
+numbers lose integer precision past 2^53.
+
+Every event dict has ``t``, ``ev`` and ``engine``; per-event required
+fields are pinned by :data:`EVENT_SCHEMA` (validated by the obs tests
+and ``tools/trace_report.py --validate``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: event name -> field names required beyond the base (t, ev, engine).
+#: Optional fields (per-shard vectors, rates, reasons) may ride along;
+#: consumers must ignore fields they do not know.
+EVENT_SCHEMA: Dict[str, frozenset] = {
+    # one per run
+    "run_start": frozenset({"model", "wall"}),
+    "done": frozenset({"gen", "unique"}),
+    "error": frozenset({"error"}),
+    # chunk-loop progress (device engines); sharded runs add
+    # shard_log/shard_q per-shard vectors and exchange stats
+    "chunk": frozenset({"chunk", "gen", "unique", "q_size", "new",
+                        "dedup_hit", "load"}),
+    # per-level progress (host + per-level device engines)
+    "level": frozenset({"level", "frontier", "gen", "unique"}),
+    # periodic host-engine progress (every ``_PROGRESS_EVERY`` pops)
+    "progress": frozenset({"gen", "unique"}),
+    # growth / resize interventions
+    "grow": frozenset({"capacity"}),
+    "hgrow": frozenset({"hcap", "hovf"}),
+    "egrow": frozenset({"ecap"}),
+    "kovf": frozenset({"kraw", "kmax"}),
+    "compile": frozenset({"reason"}),
+    # search record + post-passes
+    "mirror_pull": frozenset({"n"}),
+    "lasso": frozenset({"nodes", "edges"}),
+    "visit": frozenset({"visited", "peak_resident"}),
+    # fault injection declared by the model (PR 1 crash–restart)
+    "fault_injection": frozenset({"max_crashes"}),
+    # a property discovery was recorded
+    "discovery": frozenset({"property", "fp"}),
+}
+
+_BASE_FIELDS = frozenset({"t", "ev", "engine"})
+
+
+def validate_event(event: Dict[str, Any]) -> None:
+    """Raise ``ValueError`` unless ``event`` matches the schema."""
+    missing = _BASE_FIELDS - event.keys()
+    if missing:
+        raise ValueError(f"trace event missing base fields {sorted(missing)}:"
+                         f" {event!r}")
+    ev = event["ev"]
+    required = EVENT_SCHEMA.get(ev)
+    if required is None:
+        raise ValueError(f"unknown trace event {ev!r}: {event!r}")
+    missing = required - event.keys()
+    if missing:
+        raise ValueError(
+            f"trace event {ev!r} missing fields {sorted(missing)}: "
+            f"{event!r}")
+
+
+class NullTrace:
+    """The off switch: falsy, and every emit is a no-op."""
+
+    __slots__ = ()
+    enabled = False
+
+    def __bool__(self) -> bool:
+        return False
+
+    def emit(self, ev: str, **fields) -> None:
+        pass
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        raise RuntimeError(
+            "cannot subscribe to a disabled trace; enable it with "
+            "tpu_options(trace=...) (any sink, e.g. trace=[]) first")
+
+    def close(self) -> None:
+        pass
+
+
+#: process-wide disabled trace, shared by every untraced checker
+NULL_TRACE = NullTrace()
+
+
+class RunTrace:
+    """A live JSONL event stream plus in-process subscribers."""
+
+    def __init__(self, sink: Any = None, engine: str = "?"):
+        self._engine = engine
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._subs: List[Callable[[Dict[str, Any]], None]] = []
+        self._write: Optional[Callable[[str], None]] = None
+        self._append: Optional[Callable[[Dict[str, Any]], None]] = None
+        self._fh = None
+        if sink is None:
+            pass
+        elif isinstance(sink, (str, os.PathLike)):
+            # line-buffered: one write() per event line
+            self._fh = open(os.fspath(sink), "a", buffering=1)
+            self._write = self._fh.write
+        elif callable(sink):
+            self._append = sink
+        elif hasattr(sink, "append") and not hasattr(sink, "write"):
+            self._append = sink.append
+        elif hasattr(sink, "write"):
+            self._write = sink.write
+        else:
+            raise TypeError(
+                "tpu_options(trace=...) accepts a path, a file-like "
+                "object, a callable, or a list; got "
+                f"{type(sink).__name__}")
+
+    def __bool__(self) -> bool:
+        return (self._write is not None or self._append is not None
+                or bool(self._subs))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self)
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Register a progress callback invoked with every event dict
+        (after the sink write). Callbacks run on the emitting engine's
+        thread and must be fast and exception-free."""
+        self._subs.append(fn)
+
+    def emit(self, ev: str, **fields) -> None:
+        if not self:
+            return
+        event: Dict[str, Any] = {
+            "t": round(time.monotonic() - self._t0, 6),
+            "ev": ev, "engine": self._engine}
+        event.update(fields)
+        with self._lock:
+            if self._write is not None:
+                self._write(json.dumps(event, separators=(",", ":"))
+                            + "\n")
+            if self._append is not None:
+                self._append(event)
+            for fn in self._subs:
+                fn(event)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+            self._write = None
+
+
+def make_trace(sink: Any, engine: str) -> "RunTrace | NullTrace":
+    """Build the engine's trace from a ``tpu_options(trace=...)`` value
+    (``None`` -> the shared :data:`NULL_TRACE`). An existing
+    ``RunTrace`` passes through re-tagged with this engine's name."""
+    if sink is None:
+        return NULL_TRACE
+    if isinstance(sink, NullTrace):
+        return sink
+    if isinstance(sink, RunTrace):
+        sink._engine = engine
+        return sink
+    return RunTrace(sink, engine=engine)
+
+
+def fault_info(model) -> Optional[Dict[str, Any]]:
+    """Crash–restart injection parameters declared by the model (the
+    host ``ActorModel.crash_restart`` surface or a packed model built
+    from one), or ``None`` when the model injects no faults."""
+    for attr in ("max_crashes_", "max_crashes"):
+        n = getattr(model, attr, 0)
+        if n:
+            crashable = getattr(model, "crashable_", None)
+            info: Dict[str, Any] = {"max_crashes": int(n)}
+            if crashable is not None:
+                info["actors"] = list(crashable)
+            return info
+    inner = getattr(model, "model", None)
+    if inner is not None and inner is not model:
+        return fault_info(inner)
+    return None

@@ -185,18 +185,24 @@ class ShardedTpuChecker(TpuChecker):
                 keys_by_shard[owner_of(fp, D)].append(fp)
             table_plan = ([plan_insert_host(b, self._capacity // D)
                            for b in keys_by_shard], keys_by_shard)
-        carry = seed_sharded_carry(model, mesh, axis, qcap, self._capacity,
-                                   init_rows, frontier_fps, seed_ebits,
-                                   prop_count, symmetry=self._symmetry,
-                                   sound=self._sound,
-                                   cache_fps=cache_fps,
-                                   table_plan=table_plan, ecap=ecap)
-        if table_plan is None:
-            key_hi, key_lo = self._sharded_bulk_insert(
-                insert_fn, carry.key_hi, carry.key_lo, table_fps, D)
-            carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
+        with self._timed("seed"):
+            carry = seed_sharded_carry(model, mesh, axis, qcap,
+                                       self._capacity, init_rows,
+                                       frontier_fps, seed_ebits,
+                                       prop_count,
+                                       symmetry=self._symmetry,
+                                       sound=self._sound,
+                                       cache_fps=cache_fps,
+                                       table_plan=table_plan, ecap=ecap)
+            if table_plan is None:
+                key_hi, key_lo = self._sharded_bulk_insert(
+                    insert_fn, carry.key_hi, carry.key_lo, table_fps, D)
+                carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
 
-        def rebuild_chunk():
+        def rebuild_chunk(reason: str = "initial"):
+            self._metrics.inc("compiles")
+            if self._trace:
+                self._trace.emit("compile", reason=reason)
             return build_sharded_chunk_fn(
                 model, mesh, axis, qcap, self._capacity, fmax, kmax,
                 symmetry=self._symmetry, sound=self._sound, kraw=kraw,
@@ -246,7 +252,7 @@ class ShardedTpuChecker(TpuChecker):
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit)
             inflight.append((stats_d, int(grow_limit)))
-            self._prof["chunks"] = self._prof.get("chunks", 0) + 1
+            self._metrics.inc("chunks")
 
         def process(stats_d, grow_limit: int) -> set:
             with self._timed("sync_stall"):
@@ -270,20 +276,40 @@ class ShardedTpuChecker(TpuChecker):
             disc_lo = stats[base + 2 * prop_count:base + 3 * prop_count]
             e_n = stats[base + 3 * prop_count:
                         base + 3 * prop_count + D].astype(np.int64)
+            shard_new = log_n - cur["log_n"]  # per-shard fresh inserts
             cur.update(q_head=q_head, q_tail=q_tail, log_n=log_n,
                        e_n=e_n)
-            self._prof["vmax"] = max(self._prof.get("vmax", 0), vmax)
-            self._prof["dmax"] = max(self._prof.get("dmax", 0), dmax)
+            metrics = self._metrics
+            metrics.observe_max("vmax", vmax)
+            metrics.observe_max("dmax", dmax)
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen
             self._unique_state_count = base_unique + int(log_n.sum())
+            trace = self._trace
+            if trace:
+                new = int(shard_new.sum())
+                trace.emit(
+                    "chunk", chunk=int(metrics.get("chunks", 0)),
+                    gen=gen, unique=self._unique_state_count,
+                    q_size=int((q_tail - q_head).sum()), new=new,
+                    dedup_hit=(round(1.0 - new / gen, 4)
+                               if gen else 0.0),
+                    load=round(int(log_n.max()) / (self._capacity // D),
+                               4),
+                    vmax=vmax, dmax=dmax, bmax=bmax,
+                    # per-shard balance/exchange volumes: states each
+                    # owner shard inserted this chunk, plus its live
+                    # queue depth
+                    shard_new=[int(x) for x in shard_new],
+                    shard_q=[int(x) for x in (q_tail - q_head)])
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:
                     continue  # device bits are placeholders
                 if disc_hit[i] and prop.name not in discoveries:
                     discoveries[prop.name] = int(disc_fps[i])
+                    self._note_discovery(prop.name, int(disc_fps[i]))
             if xovf:
                 from ..checker.tpu import _XOVF_MESSAGE
                 raise RuntimeError(_XOVF_MESSAGE)
@@ -304,9 +330,8 @@ class ShardedTpuChecker(TpuChecker):
                     self._posthoc_sharded(carry, qcap, n_init_arr,
                                           discoveries,
                                           q_tail_h=q_tail)
-            self._prof["host_overlap"] = (
-                self._prof.get("host_overlap", 0.0)
-                + time.perf_counter() - t0)
+            self._metrics.add_time("host_overlap",
+                                   time.perf_counter() - t0)
             if kovf:
                 kovf_pend[0] = max(kovf_pend[0], vmax)
                 kovf_pend[1] = max(kovf_pend[1], dmax)
@@ -353,8 +378,13 @@ class ShardedTpuChecker(TpuChecker):
                            kraw)
             kmax = min(kmax, kraw)
             headroom = max(D * kmax, fmax)
+            self._metrics.inc("kovfs")
+            if self._trace:
+                self._trace.emit("kovf", kraw=kraw, kmax=kmax, kb=kb,
+                                 vmax=kovf_pend[0], dmax=kovf_pend[1],
+                                 bmax=kovf_pend[2])
             kovf_pend[:] = [0, 0, 0]
-            chunk_fn = rebuild_chunk()
+            chunk_fn = rebuild_chunk("kovf")
             carry = carry._replace(kovf=jnp.bool_(False))
 
         def handle_egrow() -> None:
@@ -379,16 +409,22 @@ class ShardedTpuChecker(TpuChecker):
                 sh = NamedSharding(mesh, P(axis))
                 carry = carry._replace(
                     elog=jax.device_put(new_elog, sh))
-            chunk_fn = rebuild_chunk()
+            if self._trace:
+                self._trace.emit("egrow", ecap=ecap)
+            chunk_fn = rebuild_chunk("egrow")
 
         def handle_grow() -> None:
             nonlocal carry, chunk_fn, qcap, ecap
-            self._prof["grows"] = self._prof.get("grows", 0) + 1
-            carry, qcap = self._grow_sharded(
-                carry, qcap, n_init, headroom, table_fps, insert_fn)
+            self._metrics.inc("grows")
+            with self._timed("grow"):
+                carry, qcap = self._grow_sharded(
+                    carry, qcap, n_init, headroom, table_fps, insert_fn)
             if ecap:
                 ecap = max(self._capacity, ecap)
-            chunk_fn = rebuild_chunk()
+            if self._trace:
+                self._trace.emit("grow", capacity=self._capacity,
+                                 qcap=qcap)
+            chunk_fn = rebuild_chunk("grow")
 
         dispatch()
         while True:
@@ -416,6 +452,12 @@ class ShardedTpuChecker(TpuChecker):
             dispatch()
         q_head, q_tail = cur["q_head"], cur["q_tail"]
         log_n, e_n = cur["log_n"], cur["e_n"]
+        if int(log_n.max()):
+            # end-of-run shard balance: min/max per-shard inserted
+            # states (1.0 = perfectly balanced fingerprint routing)
+            self._metrics.set(
+                "shard_balance",
+                round(float(int(log_n.min()) / int(log_n.max())), 4))
 
         if (self._sound and int((q_tail - q_head).sum()) == 0
                 and self._resume_path is not None):
@@ -685,6 +727,10 @@ class ShardedTpuChecker(TpuChecker):
                 elog_h[s * eloc:s * eloc + en])
         lasso_sweep(self._properties, discoveries, node_edges,
                     node_mask, node_parent, node_fp)
+        if self._trace:
+            self._trace.emit(
+                "lasso", nodes=len(node_mask),
+                edges=sum(len(v) for v in node_edges.values()))
 
     # ------------------------------------------------------------------
     def _finalize_sharded(self, carry: ShardedCarry) -> None:
@@ -706,6 +752,12 @@ class ShardedTpuChecker(TpuChecker):
             D = self._mesh.shape[self._axis]
             closc = self._capacity // D
             log_n, log = jax.device_get((log_n_d, log_d))
+            if self._trace:
+                # per-shard pull volumes: the mirror transfer is the
+                # big host-link cost of a sharded run
+                self._trace.emit(
+                    "mirror_pull", n=int(np.asarray(log_n).sum()),
+                    shards=[int(x) for x in np.asarray(log_n)])
             for s in range(D):
                 ln = int(log_n[s])
                 if not ln:

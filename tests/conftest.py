@@ -35,3 +35,7 @@ def pytest_configure(config):
         "markers", "faults: fault-injection coverage (crash–restart, lossy "
         "networks, corrupt checkpoints); select with -m faults. Fast "
         "configs run in tier-1 by default.")
+    config.addinivalue_line(
+        "markers", "obs: observability coverage (run-trace schema, "
+        "trace-on/off parity, metrics registry, /.metrics); select "
+        "with -m obs. Fast configs run in tier-1 by default.")

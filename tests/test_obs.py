@@ -1,0 +1,331 @@
+"""Unified observability (stateright_tpu/obs/): metrics registry,
+run-trace schema, trace-on/off parity, and overhead smoke.
+
+The load-bearing guarantee is PARITY: enabling ``tpu_options(trace=...)``
+must not change a single observable result — state counts, unique
+counts, discoveries, reached fingerprints — on the single-chip device
+engine, the sharded engine, and the host engines. Everything else
+(schema, consumers) builds on that.
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from stateright_tpu.obs import (EVENT_SCHEMA, GLOSSARY, NULL_TRACE,
+                                Metrics, RunTrace, make_trace,
+                                validate_event)
+
+pytestmark = pytest.mark.obs
+
+
+# --- Metrics registry ------------------------------------------------------
+
+class TestMetrics:
+    def test_counters_timers_maxima(self):
+        m = Metrics()
+        m.inc("chunks")
+        m.inc("chunks", 2)
+        m.add_time("grow", 0.5)
+        m.add_time("grow", 0.25)
+        m.observe_max("vmax", 10)
+        m.observe_max("vmax", 7)  # lower: ignored
+        with m.timed("seed"):
+            pass
+        snap = m.snapshot()
+        assert snap["chunks"] == 3
+        assert snap["grow"] == 0.75
+        assert snap["vmax"] == 10
+        assert snap["seed"] >= 0.0
+        # snapshot is a copy
+        snap["chunks"] = 99
+        assert m.get("chunks") == 3
+
+    def test_merge_semantics(self):
+        a, b = Metrics(), Metrics()
+        a.inc("chunks", 2)
+        a.observe_max("vmax", 5)
+        b.inc("chunks", 3)
+        b.observe_max("vmax", 9)
+        a.merge(b)
+        assert a.get("chunks") == 5  # counters add
+        assert a.get("vmax") == 9  # maxima take max
+
+    def test_glossary_covers_engine_keys(self):
+        # the canonical keys every engine emits must stay documented
+        for key in ("dispatch", "sync_stall", "host_overlap", "grow",
+                    "hgrow", "chunks", "grows", "compiles", "vmax",
+                    "dmax", "rmax", "levels", "jobs", "search",
+                    "shard_balance"):
+            assert key in GLOSSARY, key
+
+
+# --- RunTrace sinks and schema ---------------------------------------------
+
+class TestRunTrace:
+    def test_disabled_is_falsy_noop(self):
+        assert not NULL_TRACE
+        NULL_TRACE.emit("chunk", anything=1)  # no-op, no error
+        assert make_trace(None, engine="X") is NULL_TRACE
+        with pytest.raises(RuntimeError, match="disabled trace"):
+            NULL_TRACE.subscribe(lambda e: None)
+
+    def test_list_sink_and_base_fields(self):
+        events = []
+        tr = RunTrace(events, engine="E")
+        assert tr
+        tr.emit("compile", reason="initial")
+        assert events == [{"t": events[0]["t"], "ev": "compile",
+                           "engine": "E", "reason": "initial"}]
+        validate_event(events[0])
+
+    def test_callable_and_file_sinks(self, tmp_path):
+        got = []
+        RunTrace(got.append, engine="E").emit("grow", capacity=4)
+        assert got[0]["capacity"] == 4
+
+        path = tmp_path / "t.jsonl"
+        tr = RunTrace(str(path), engine="E")
+        tr.emit("grow", capacity=8)
+        tr.close()
+        lines = path.read_text().splitlines()
+        assert json.loads(lines[0])["capacity"] == 8
+
+        buf = io.StringIO()
+        RunTrace(buf, engine="E").emit("egrow", ecap=2)
+        assert json.loads(buf.getvalue())["ev"] == "egrow"
+
+    def test_bad_sink_rejected(self):
+        with pytest.raises(TypeError, match="trace"):
+            RunTrace(42, engine="E")
+
+    def test_subscribers_receive_events(self):
+        events = []
+        tr = RunTrace(None, engine="E")
+        assert not tr  # no sink, no subscribers: still off
+        tr.subscribe(events.append)
+        assert tr  # a subscriber enables it
+        tr.emit("compile", reason="x")
+        assert events[0]["reason"] == "x"
+
+    def test_validate_rejects_bad_events(self):
+        with pytest.raises(ValueError, match="unknown trace event"):
+            validate_event({"t": 0, "ev": "nope", "engine": "E"})
+        with pytest.raises(ValueError, match="missing fields"):
+            validate_event({"t": 0, "ev": "chunk", "engine": "E"})
+        with pytest.raises(ValueError, match="base fields"):
+            validate_event({"ev": "compile", "reason": "x"})
+
+
+# --- emitted-stream schema validation --------------------------------------
+
+def _twopc(n=3, **opts):
+    from stateright_tpu.models.twopc import TwoPhaseSys
+    return TwoPhaseSys(n).checker().tpu_options(
+        capacity=1 << 12, race=False, **opts)
+
+
+class TestEmittedSchema:
+    def test_device_jsonl_schema(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        ck = _twopc(trace=str(path)).spawn_tpu().join()
+        events = [json.loads(line)
+                  for line in path.read_text().splitlines()]
+        assert events, "no events emitted"
+        for ev in events:
+            validate_event(ev)
+        kinds = {e["ev"] for e in events}
+        assert {"run_start", "chunk", "done"} <= kinds
+        # fingerprints must be JSON-safe strings (uint64 > 2^53)
+        for ev in events:
+            if ev["ev"] == "discovery":
+                fp = ev["fp"]
+                assert isinstance(fp, (str, list))
+        done = [e for e in events if e["ev"] == "done"][-1]
+        assert done["unique"] == ck.unique_state_count()
+        assert done["gen"] == ck.state_count()
+
+    def test_every_emitted_kind_is_in_schema(self):
+        events = []
+        _twopc(trace=events).spawn_tpu().join()
+        assert {e["ev"] for e in events} <= set(EVENT_SCHEMA)
+
+    def test_host_engines_emit(self):
+        from stateright_tpu.models.fixtures import LinearEquation
+        events = []
+        (LinearEquation(2, 10, 14).checker()
+         .tpu_options(trace=events).spawn_bfs().join())
+        kinds = [e["ev"] for e in events]
+        assert kinds[0] == "run_start"
+        assert "discovery" in kinds and kinds[-1] == "done"
+        for ev in events:
+            validate_event(ev)
+
+        events_dfs = []
+        (LinearEquation(2, 10, 14).checker()
+         .tpu_options(trace=events_dfs).spawn_dfs().join())
+        assert any(e["ev"] == "discovery" for e in events_dfs)
+        for ev in events_dfs:
+            validate_event(ev)
+
+    def test_fault_injection_event(self):
+        from stateright_tpu.examples.write_once_packed import (
+            PackedWriteOnce)
+        events = []
+        model = PackedWriteOnce(1, durable=True).crash_restart(
+            1, actors=[0])
+        (model.checker().tpu_options(capacity=1 << 12, race=False,
+                                     trace=events)
+         .spawn_tpu().join())
+        fi = [e for e in events if e["ev"] == "fault_injection"]
+        assert fi and fi[0]["max_crashes"] == 1
+        assert fi[0]["actors"] == [0]
+
+
+# --- parity: trace on/off must be bit-identical ----------------------------
+
+class TestTraceParity:
+    def _assert_parity(self, ck_off, ck_on):
+        assert ck_on.unique_state_count() == ck_off.unique_state_count()
+        assert ck_on.state_count() == ck_off.state_count()
+        assert (sorted(ck_on.discoveries()) ==
+                sorted(ck_off.discoveries()))
+        assert (ck_on.generated_fingerprints() ==
+                ck_off.generated_fingerprints())
+
+    def test_twopc_single_chip(self):
+        ck_off = _twopc().spawn_tpu().join()
+        ck_on = _twopc(trace=[]).spawn_tpu().join()
+        assert ck_on.unique_state_count() == 288
+        self._assert_parity(ck_off, ck_on)
+
+    def test_paxos_capped(self):
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+
+        def mk(**opts):
+            return (PackedPaxos(2).checker()
+                    .tpu_options(capacity=1 << 14, race=False, **opts)
+                    .target_state_count(2000).spawn_tpu().join())
+
+        mk()  # warm: pin the observed-size memo for both runs
+        self._assert_parity(mk(), mk(trace=[]))
+
+    def test_sharded(self):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        def mk(**opts):
+            mesh = Mesh(np.array(jax.devices()[:2]), ("shards",))
+            return _twopc(mesh=mesh, **opts).spawn_tpu().join()
+
+        events = []
+        ck_off, ck_on = mk(), mk(trace=events)
+        self._assert_parity(ck_off, ck_on)
+        chunk = [e for e in events if e["ev"] == "chunk"][-1]
+        assert len(chunk["shard_new"]) == 2  # per-shard volumes ride
+
+    def test_host_bfs_parity(self):
+        from stateright_tpu.models.twopc import TwoPhaseSys
+        ck_off = TwoPhaseSys(3).checker().spawn_bfs().join()
+        ck_on = (TwoPhaseSys(3).checker().tpu_options(trace=[])
+                 .spawn_bfs().join())
+        self._assert_parity(ck_off, ck_on)
+
+
+# --- overhead smoke --------------------------------------------------------
+
+class TestOverhead:
+    def test_disabled_emit_is_trivial(self):
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            if NULL_TRACE:
+                NULL_TRACE.emit("chunk", gen=1)
+        assert time.perf_counter() - t0 < 0.5
+
+    def test_traced_run_overhead_smoke(self):
+        """Loose CI bound (CPU timing is noisy); the <2% contract is
+        measured on the bench workload via bench.py's metrics lines."""
+        def mk(**opts):
+            return _twopc(4, **opts).spawn_tpu().join()
+
+        mk()  # warm compile
+        off = min(self._clock(mk), self._clock(mk))
+        on = min(self._clock(lambda: mk(trace=[])),
+                 self._clock(lambda: mk(trace=[])))
+        assert on < off * 1.5 + 0.25, (on, off)
+
+    @staticmethod
+    def _clock(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+
+# --- consumers -------------------------------------------------------------
+
+class TestConsumers:
+    def test_race_profile_tags_winner(self):
+        # satellite fix: a host-won race used to report {}
+        from stateright_tpu.examples.increment_lock import IncrementLock
+        ck = IncrementLock(2).checker().spawn_tpu().join()
+        prof = ck.profile()
+        assert prof["engine"] in ("host", "device")
+        assert "search" in prof  # the winner's real metrics rode along
+
+    def test_profile_keys_stay_in_glossary(self):
+        ck = _twopc().spawn_tpu().join()
+        unknown = set(ck.profile()) - set(GLOSSARY)
+        assert not unknown, f"undocumented profile keys: {unknown}"
+
+    def test_report_metrics_line(self):
+        w = io.StringIO()
+        _twopc().spawn_tpu().report(w)
+        out = w.getvalue()
+        assert "\n# " in out and "chunks=" in out, out
+
+    def test_subscribe_live_progress(self):
+        seen = []
+        ck = _twopc(trace=[]).spawn_tpu()
+        ck.subscribe(seen.append)
+        ck.join()
+        assert any(e["ev"] == "chunk" for e in seen)
+
+    def test_explorer_metrics_endpoint(self):
+        import urllib.request
+
+        from stateright_tpu.checker.explorer import serve
+        from stateright_tpu.models.twopc import TwoPhaseSys
+        checker, server = serve(TwoPhaseSys(2).checker(),
+                                ("127.0.0.1", 0), block=False)
+        host, port = server.server_address
+        try:
+            checker.join()
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/.metrics") as r:
+                payload = json.loads(r.read())
+            assert payload["done"] is True
+            assert payload["unique_state_count"] > 0
+            assert "search" in payload["profile"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_trace_report_tool(self, tmp_path, capsys):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        try:
+            import trace_report
+        finally:
+            sys.path.pop(0)
+        path = tmp_path / "run.jsonl"
+        _twopc(trace=str(path)).spawn_tpu().join()
+        assert trace_report.main([str(path), "--validate"]) == 0
+        out = capsys.readouterr().out
+        assert "=== engine: TpuChecker" in out
+        assert "done:" in out and "timeline:" in out

@@ -1,71 +1,91 @@
 """Quick A/B probe for engine perf work: paxos-capped + 2pc-full rates,
-best-of-N. Not part of the driver contract (bench.py is)."""
+best-of-N, each run followed by a run-trace summary (chunk count, mean
+dedup hit-rate, peak table load, interventions) — the trace, not ad-hoc
+prints, is the explanation channel. Not part of the driver contract
+(bench.py is)."""
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _trace_line(events, prof):
+    """One summary line from an in-memory run trace + profile()."""
+    chunks = [e for e in events if e["ev"] == "chunk"]
+    inters = [e["ev"] for e in events
+              if e["ev"] in ("grow", "hgrow", "egrow", "kovf")]
+    dh = [c["dedup_hit"] for c in chunks]
+    bits = [f"chunks={len(chunks)}"]
+    if dh:
+        bits.append(f"dedup_hit={sum(dh) / len(dh):.3f}")
+    if chunks:
+        bits.append(f"load={max(c['load'] for c in chunks):.4f}")
+    if inters:
+        bits.append(f"interventions={inters}")
+    search = prof.get("search")
+    if search and "sync_stall" in prof:
+        bits.append(f"stall={prof['sync_stall'] / search:.0%}")
+    return "  trace: " + " ".join(bits)
+
+
+def _probe(name, mk, n_runs, warm):
+    warm()
+    rates = []
+    events = []
+    ck = None
+    for _ in range(n_runs):
+        events.clear()
+        t0 = time.perf_counter()
+        ck = mk(events)
+        rates.append(ck.unique_state_count()
+                     / (time.perf_counter() - t0))
+    print(f"{name}: uniq={ck.unique_state_count()} "
+          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
+    print(_trace_line(events, ck.profile()))
+    return max(rates)
 
 
 def paxos(n_runs=3, cap=500_000):
     from stateright_tpu.examples.paxos_packed import PackedPaxos
 
-    def run(c):
-        t0 = time.perf_counter()
-        ck = (PackedPaxos(3).checker()
-              .tpu_options(capacity=1 << 21)
-              .target_state_count(c)
-              .spawn_tpu().join())
-        return time.perf_counter() - t0, ck
+    def mk(events, c=cap):
+        return (PackedPaxos(3).checker()
+                .tpu_options(capacity=1 << 21, race=False, trace=events)
+                .target_state_count(c)
+                .spawn_tpu().join())
 
-    run(50_000)  # warm
-    rates = []
-    for _ in range(n_runs):
-        dt, ck = run(cap)
-        rates.append(ck.unique_state_count() / dt)
-    print(f"paxos3 capped: uniq={ck.unique_state_count()} "
-          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
-    return max(rates)
+    return _probe("paxos3 capped", mk, n_runs,
+                  warm=lambda: mk([], 50_000))
 
 
 def twopc(n_runs=3):
     from stateright_tpu.models.twopc import TwoPhaseSys
 
-    def run():
-        t0 = time.perf_counter()
+    def mk(events):
         ck = (TwoPhaseSys(7).checker()
-              .tpu_options(capacity=1 << 22)
+              .tpu_options(capacity=1 << 22, race=False, trace=events)
               .spawn_tpu().join())
-        return time.perf_counter() - t0, ck.unique_state_count()
+        assert ck.unique_state_count() == 296448, ck.unique_state_count()
+        return ck
 
-    run()
-    rates = []
-    for _ in range(n_runs):
-        dt, uq = run()
-        assert uq == 296448, uq
-        rates.append(uq / dt)
-    print(f"2pc n=7 full: uniq={uq} "
-          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
-    return max(rates)
+    return _probe("2pc n=7 full", mk, n_runs, warm=lambda: mk([]))
 
 
 def abd(n_runs=3, cap=100_000):
     from stateright_tpu.examples.abd_packed import PackedAbd
 
-    def run(c):
-        t0 = time.perf_counter()
-        ck = (PackedAbd(2, server_count=3, ordered=True, channel_depth=8)
-              .checker()
-              .tpu_options(capacity=1 << 20)
-              .target_state_count(c)
-              .spawn_tpu().join())
-        return time.perf_counter() - t0, ck
+    def mk(events, c=cap):
+        return (PackedAbd(2, server_count=3, ordered=True,
+                          channel_depth=8)
+                .checker()
+                .tpu_options(capacity=1 << 20, race=False, trace=events)
+                .target_state_count(c)
+                .spawn_tpu().join())
 
-    run(5_000)
-    rates = []
-    for _ in range(n_runs):
-        dt, ck = run(cap)
-        rates.append(ck.unique_state_count() / dt)
-    print(f"abd2 ordered capped: uniq={ck.unique_state_count()} "
-          f"rates={[f'{r:,.0f}' for r in rates]} best={max(rates):,.0f}")
-    return max(rates)
+    return _probe("abd2 ordered capped", mk, n_runs,
+                  warm=lambda: mk([], 5_000))
 
 
 if __name__ == "__main__":

@@ -1,5 +1,8 @@
 """Profile the device chunk loop on paxos: trace one warm capped run and
-summarize op time by kernel name from the trace proto."""
+summarize (a) the engine's own run-trace (per-chunk timeline via
+tools/trace_report.py) and (b) op time by kernel name from the XLA
+trace proto — the run-trace explains WHAT the loop did (chunks, dedup,
+growth storms), the XLA trace WHERE the device time went."""
 import glob
 import gzip
 import json
@@ -12,14 +15,16 @@ import jax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+RUN_TRACE = "/tmp/jaxprof/run_trace.jsonl"
 
-def run(cap=500_000):
+
+def run(cap=500_000, trace=None):
     import os
     if os.environ.get("PROF_MODEL") == "2pc":
         from stateright_tpu.models.twopc import TwoPhaseSys
         t0 = time.perf_counter()
         ck = (TwoPhaseSys(7).checker()
-              .tpu_options(capacity=1 << 22)
+              .tpu_options(capacity=1 << 22, trace=trace)
               .spawn_tpu().join())
         dt = time.perf_counter() - t0
         print(f"run: {ck.unique_state_count()} uniq in {dt:.2f}s "
@@ -28,23 +33,32 @@ def run(cap=500_000):
     from stateright_tpu.examples.paxos_packed import PackedPaxos
     t0 = time.perf_counter()
     ck = (PackedPaxos(3).checker()
-          .tpu_options(capacity=1 << 21, race=False)
+          .tpu_options(capacity=1 << 21, race=False, trace=trace)
           .target_state_count(cap)
           .spawn_tpu().join())
     dt = time.perf_counter() - t0
     print(f"run: {ck.unique_state_count()} uniq in {dt:.2f}s "
           f"({ck.unique_state_count()/dt:,.0f}/s) "
-          f"profile={ {k: round(v, 3) for k, v in ck.profile().items()} }",
+          f"profile={ {k: round(v, 3) if isinstance(v, float) else v
+                       for k, v in ck.profile().items()} }",
           file=sys.stderr)
 
 
 outdir = "/tmp/jaxprof"
 shutil.rmtree(outdir, ignore_errors=True)
+os.makedirs(outdir, exist_ok=True)
 run()  # warm (compile-cache load)
 run()  # warm (observed-size-memo shape switch)
 with jax.profiler.trace(outdir):
-    run()
+    run(trace=RUN_TRACE)
 
+# --- the engine's own run-trace: per-chunk timeline ---------------------
+from trace_report import load_events, report  # noqa: E402
+
+print("\n=== run-trace summary ===", file=sys.stderr)
+report(load_events(RUN_TRACE), out=sys.stderr)
+
+# --- XLA kernel-time table ---------------------------------------------
 traces = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),
                    recursive=True)
 print("traces:", traces, file=sys.stderr)

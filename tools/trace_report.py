@@ -1,0 +1,178 @@
+"""Summarize a run-trace JSONL file into human-readable per-phase tables.
+
+Usage:
+    python tools/trace_report.py TRACE.jsonl [--validate]
+
+Consumes the event stream written by ``tpu_options(trace="...")``
+(schema: ``stateright_tpu.obs.EVENT_SCHEMA``) and prints, per engine
+found in the trace:
+
+  * the run header (model, wall start, properties, fault injection);
+  * a per-event-type table (count, first/last timestamp);
+  * a chunk/level timeline in ~12 buckets — unique-states rate, dedup
+    hit-rate, table load factor, queue depth — the view that makes a
+    pipeline stall or a growth storm visible after the fact;
+  * interventions (grow/hgrow/egrow/kovf/compile) with timestamps;
+  * discoveries and the final counts.
+
+``--validate`` additionally schema-checks every event and exits
+non-zero on the first violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_events(path):
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise SystemExit(
+                    f"{path}:{lineno}: not JSONL ({exc})")
+    return events
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).rjust(w) for c, w in zip(cols, widths))
+
+
+def _bucketize(rows, n_buckets=12):
+    """Group progress rows (dicts with 't') into ~n_buckets spans."""
+    if not rows:
+        return []
+    t0, t1 = rows[0]["t"], rows[-1]["t"]
+    span = max(t1 - t0, 1e-9)
+    step = span / n_buckets
+    buckets = []
+    for row in rows:
+        idx = min(int((row["t"] - t0) / step), n_buckets - 1)
+        if not buckets or buckets[-1][0] != idx:
+            buckets.append([idx, []])
+        buckets[-1][1].append(row)
+    return buckets
+
+
+def chunk_timeline(rows, out):
+    """The stall view: per time bucket, the unique-state rate plus the
+    mean dedup hit-rate / load factor / queue depth. A rate collapsing
+    while load climbs toward grow_at reads as a growth storm; a flat
+    rate with dedup_hit -> 1.0 means the frontier is re-generating
+    explored states (raise capacity or rethink the model bounds)."""
+    buckets = _bucketize(rows)
+    if not buckets:
+        return
+    widths = (9, 9, 10, 10, 9, 10)
+    out.write(_fmt_row(("t_start", "events", "uniq/s", "dedup_hit",
+                        "load", "q_size"), widths) + "\n")
+    prev_t = 0.0
+    prev_uniq = 0
+    for _idx, rs in buckets:
+        t_end = rs[-1]["t"]
+        uniq = rs[-1].get("unique")
+        dt = max(t_end - prev_t, 1e-9)
+        rate = ("-" if uniq is None
+                else f"{(uniq - prev_uniq) / dt:,.0f}")
+        dh = [r["dedup_hit"] for r in rs if "dedup_hit" in r]
+        ld = [r["load"] for r in rs if "load" in r]
+        qs = [r["q_size"] for r in rs if "q_size" in r]
+        out.write(_fmt_row((
+            f"{rs[0]['t']:.2f}", len(rs), rate,
+            f"{sum(dh) / len(dh):.3f}" if dh else "-",
+            f"{max(ld):.4f}" if ld else "-",
+            max(qs) if qs else "-"), widths) + "\n")
+        prev_t, prev_uniq = t_end, uniq if uniq is not None else prev_uniq
+
+
+def report(events, out=sys.stdout):
+    by_engine = {}
+    for ev in events:
+        by_engine.setdefault(ev.get("engine", "?"), []).append(ev)
+    for engine, evs in by_engine.items():
+        out.write(f"=== engine: {engine} ({len(evs)} events, "
+                  f"{evs[-1]['t'] - evs[0]['t']:.3f}s) ===\n")
+        for ev in evs:
+            if ev["ev"] == "run_start":
+                out.write(f"model={ev.get('model')} "
+                          f"properties={ev.get('properties')}\n")
+            elif ev["ev"] == "fault_injection":
+                out.write(f"fault injection: max_crashes="
+                          f"{ev.get('max_crashes')} "
+                          f"actors={ev.get('actors', 'all')}\n")
+
+        # per-event-type table
+        kinds = {}
+        for ev in evs:
+            kinds.setdefault(ev["ev"], []).append(ev["t"])
+        widths = (14, 7, 10, 10)
+        out.write("\n" + _fmt_row(("event", "count", "first_t",
+                                   "last_t"), widths) + "\n")
+        for kind in sorted(kinds, key=lambda k: kinds[k][0]):
+            ts = kinds[kind]
+            out.write(_fmt_row((kind, len(ts), f"{ts[0]:.3f}",
+                                f"{ts[-1]:.3f}"), widths) + "\n")
+
+        progress = [e for e in evs
+                    if e["ev"] in ("chunk", "level", "progress")]
+        if progress:
+            out.write("\ntimeline:\n")
+            chunk_timeline(progress, out)
+
+        inters = [e for e in evs if e["ev"] in
+                  ("grow", "hgrow", "egrow", "kovf", "compile")]
+        if inters:
+            out.write("\ninterventions:\n")
+            for ev in inters:
+                detail = {k: v for k, v in ev.items()
+                          if k not in ("t", "ev", "engine")}
+                out.write(f"  t={ev['t']:9.3f}  {ev['ev']:8} {detail}\n")
+
+        for ev in evs:
+            if ev["ev"] == "discovery":
+                out.write(f"\ndiscovered {ev.get('property')!r} at "
+                          f"t={ev['t']:.3f}\n")
+        for ev in evs:
+            if ev["ev"] == "done":
+                out.write(f"done: gen={ev.get('gen')} "
+                          f"unique={ev.get('unique')} "
+                          f"discoveries={ev.get('discoveries')}\n")
+            elif ev["ev"] == "error":
+                out.write(f"ERROR: {ev.get('error')}\n")
+        out.write("\n")
+
+
+def main(argv):
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    validate = "--validate" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    for path in paths:
+        events = load_events(path)
+        if validate:
+            from stateright_tpu.obs import validate_event
+            for i, ev in enumerate(events):
+                try:
+                    validate_event(ev)
+                except ValueError as exc:
+                    print(f"{path}: event {i}: {exc}", file=sys.stderr)
+                    return 1
+            print(f"{path}: {len(events)} events, schema OK",
+                  file=sys.stderr)
+        report(events)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
