@@ -108,11 +108,17 @@ class PackedRegisterModel(PackedActorModel):
         # packed fast path (TpuChecker._host_props_results): evaluate
         # linearizability from the history columns alone — the full
         # decode() rebuilt every actor/server and the network per
-        # representative, ~4x the cost of the history walk itself
-        self.host_property_fns = [
-            lambda row: self.decode_history(
-                [int(w) for w in row[self._hist_off:]]
-            ).serialized_history() is not None]
+        # representative, ~4x the cost of the history walk itself.
+        # Keyed by PROPERTY NAME (not position): a subclass that
+        # renames or reorders its host-evaluated properties binds the
+        # right evaluator or fails loudly at spawn, where the old
+        # positional list could silently bind the wrong lambda behind
+        # a matching length.
+        self.host_property_fns = {
+            "linearizable":
+                lambda row: self.decode_history(
+                    [int(w) for w in row[self._hist_off:]]
+                ).serialized_history() is not None}
         if ordered:
             # declare the flows the register protocol actually uses —
             # client<->server and server<->server; client<->client FIFOs

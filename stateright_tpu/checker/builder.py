@@ -98,7 +98,18 @@ class CheckerBuilder:
           resize interventions, compiles, discoveries, ...) to the
           sink, at zero cost when unset. Format and the metrics key
           glossary: README.md § Observability and
-          ``stateright_tpu.obs``."""
+          ``stateright_tpu.obs``;
+        * resilience (README § Resilience, ``checker/resilience.py``):
+          ``retries=N`` retries a transient backend fault (UNAVAILABLE,
+          DEADLINE_EXCEEDED, tunnel resets) up to N consecutive times,
+          re-seeding the device from the host-side shadow;
+          ``backoff=s`` is the first retry delay (exponential,
+          jittered); ``chunk_deadline=s`` converts a hung chunk sync
+          into a classified transient fault (watchdog);
+          ``autosave=path`` + ``autosave_interval=chunks`` checkpoint
+          progress periodically and on exhausted retries (resume via
+          ``resume_from``); ``failover=False`` opts a raced run out of
+          the device->host fallback."""
         self.tpu_options_.update(options)
         return self
 
@@ -201,7 +212,8 @@ class Checker:
         if "engine" in prof:
             parts.append(f"engine={prof['engine']}")
         for key in ("chunks", "levels", "jobs", "grows", "hgrows",
-                    "kovfs", "compiles"):
+                    "kovfs", "compiles", "retries", "failovers",
+                    "autosaves"):
             if prof.get(key):
                 parts.append(f"{key}={int(prof[key])}")
         if elapsed > 0 and "sync_stall" in prof:

@@ -54,6 +54,18 @@ def _drain_losers() -> None:
     for thread in _LOSER_THREADS:
         if thread is not None and thread.is_alive():
             thread.join(timeout=60.0)
+            if thread.is_alive():
+                # a silent give-up here left a wedged XLA teardown
+                # (e.g. a transfer blocked on a dead tunnel) completely
+                # invisible; name the thread so the hang is diagnosable
+                import sys
+                print(
+                    f"stateright_tpu: raced loser thread "
+                    f"{thread.name!r} is still alive after a 60s join "
+                    "at interpreter exit — XLA teardown appears wedged "
+                    "(often a device transfer blocked on a dead "
+                    "tunnel); the process may abort instead of exiting "
+                    "cleanly", file=sys.stderr)
 
 
 def _retire(checker) -> None:
@@ -87,6 +99,7 @@ class RacingChecker(Checker):
         from .tpu import TpuChecker
 
         self._model = builder.model
+        self._builder = builder  # kept for the engine-failover fallback
         budget = builder.tpu_options_.get("race_budget")
         if budget is not None:
             self.HOST_BUDGET_S = float(budget)
@@ -97,6 +110,7 @@ class RacingChecker(Checker):
             # a model that can't run on the host engine races nothing
             self._host = None
         self._winner = None
+        self._failover = False
         self._decided = threading.Event()
         self._decider: threading.Thread | None = None
         self._decider_lock = threading.Lock()
@@ -117,6 +131,7 @@ class RacingChecker(Checker):
     def _decide_loop(self) -> None:
         host, tpu = self._host, self._tpu
         tpu_failed = False
+        fallback = False  # host is the un-budgeted failover engine
         t0 = time.monotonic()
         while True:
             if host is not None and host._done:
@@ -137,9 +152,21 @@ class RacingChecker(Checker):
                 # if the host cannot (deterministic up to the budget)
                 tpu_failed = True
             if host is None and tpu._done:
-                self._winner = tpu  # surfaces the device error at join
-                break
-            if (host is not None
+                # engine failover: a TRANSIENT device failure (a dead
+                # tunnel, exhausted retries) on a raced run falls back
+                # to an UN-budgeted host BFS continuing the check
+                # rather than surfacing the backend's error — the
+                # check still gets answered, just at host speed.
+                # Capacity/programming errors surface as before: the
+                # host would either hit the same model bug or silently
+                # mask it.
+                host = None if fallback else self._spawn_fallback(tpu)
+                if host is None:
+                    self._winner = tpu  # surfaces the device error
+                    break
+                fallback = True
+                continue
+            if (host is not None and not fallback
                     and time.monotonic() - t0 > self.HOST_BUDGET_S):
                 _retire(host)
                 host = None
@@ -153,6 +180,30 @@ class RacingChecker(Checker):
             self._tpu = None
         if self._winner is not self._host:
             self._host = None
+
+    def _spawn_fallback(self, tpu):
+        """Start the un-budgeted host BFS after a transient device
+        failure (``tpu_options(failover=False)`` opts out); returns the
+        running checker, or ``None`` when failover does not apply."""
+        from .resilience import FaultKind, classify_error
+
+        err = tpu._error
+        if (err is None
+                or not self._builder.tpu_options_.get("failover", True)
+                or classify_error(err) is not FaultKind.TRANSIENT):
+            return None
+        from .bfs import BfsChecker
+
+        try:
+            host = BfsChecker(self._builder)
+        except Exception:
+            return None
+        self._failover = True
+        if tpu._trace:
+            tpu._trace.emit("failover", to="host-bfs",
+                            error=f"{type(err).__name__}: {err}")
+        host._start_background()
+        return host
 
     def _decide(self):
         if self._winner is None:
@@ -191,13 +242,17 @@ class RacingChecker(Checker):
         ``engine`` is ``"host"`` for the budgeted host racer,
         ``"device"`` for the device engine. A host win used to report
         ``{}``; now both outcomes carry the winner's real phase
-        timers/counters."""
+        timers/counters. An engine failover (transient device failure
+        adopted by the un-budgeted host fallback) adds
+        ``failovers=1``."""
         from .bfs import BfsChecker
 
         winner = self._decide()
         prof = winner.profile()
         prof["engine"] = ("host" if isinstance(winner, BfsChecker)
                           else "device")
+        if self._failover:
+            prof["failovers"] = prof.get("failovers", 0) + 1
         return prof
 
     def discoveries(self):

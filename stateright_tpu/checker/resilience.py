@@ -1,0 +1,422 @@
+"""Resilience layer for the device checking engines.
+
+Round 5's primary bench artifact was empty because the TPU backend died
+mid-run (``UNAVAILABLE: TPU backend setup/compile error``) *after* the
+initial probe succeeded, and nothing between the chunk loop and
+``bench.py`` could survive it. Long device runs on tunneled/preemptible
+chips fail in ways a single-process host search never does, and each
+way deserves a different response:
+
+* **transient backend faults** (``UNAVAILABLE``, ``DEADLINE_EXCEEDED``,
+  tunnel/connection resets, a watchdog-expired chunk sync) — the chip or
+  its tunnel hiccupped; the productive response is bounded
+  retry-with-backoff: re-seed the device buffers from the host-side
+  authoritative state (:class:`HostShadow`) and resume;
+* **capacity/model errors** (``RESOURCE_EXHAUSTED``, the engines' own
+  table/probe/packed-capacity overflows) — retrying reproduces them;
+  the user must raise a bound;
+* **programming errors** — everything else; surface immediately.
+
+The engines wire this module around chunk dispatch
+(``TpuChecker._run_device``, ``ShardedTpuChecker._run``):
+``tpu_options(retries=N, backoff=s)`` bounds the retry loop,
+``tpu_options(chunk_deadline=s)`` turns a hung device sync into a
+classified fault via :func:`call_with_deadline`, and
+``tpu_options(autosave=path, autosave_interval=chunks)`` checkpoints
+the shadow periodically (and on exhausted retries) through the same
+atomic tmp+``os.replace`` write as ``Checker.save``
+(:func:`atomic_savez`). Every retry/failover/autosave/watchdog event
+flows through the obs layer (``retries``/``failovers``/``autosaves``
+metric keys, matching trace events).
+
+:class:`HostShadow` is the piece that makes retry *possible*: with
+resilience enabled the host keeps an authoritative copy of everything
+needed to rebuild the device state — the (fingerprint -> parent)
+mirror, the pending frontier rows (with their at-enqueue ebits and
+cached fingerprints), and under ``sound_eventually`` the insert/cross
+edge records the post-exhaustion lasso sweep reads. Maintenance costs
+one small device gather per chunk (the ``shadow`` phase timer), which
+also forfeits most of the double-buffered pipeline's overlap — the
+documented price of a run that can outlive its backend.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import random
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _combine64(hi, lo) -> np.ndarray:
+    return (np.asarray(hi).astype(np.uint64) << np.uint64(32)) \
+        | np.asarray(lo).astype(np.uint64)
+
+
+# ----------------------------------------------------------------------
+# error taxonomy
+# ----------------------------------------------------------------------
+class FaultKind(enum.Enum):
+    """What a runtime error means for the run (README § Resilience)."""
+
+    TRANSIENT = "transient"      # backend/tunnel hiccup: retry
+    CAPACITY = "capacity"        # a bound is too small: raise it, rerun
+    PROGRAMMING = "programming"  # a bug: surface immediately
+
+
+#: lowercase substrings marking a transient backend/tunnel fault. The
+#: PJRT status codes (UNAVAILABLE/DEADLINE_EXCEEDED/ABORTED) cover the
+#: round-5 failure mode; the connection phrases cover a dropped tunnel
+#: surfacing as a raw socket error.
+TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted:",
+    "connection reset",
+    "connection refused",
+    "connection aborted",
+    "connection closed",
+    "broken pipe",
+    "socket closed",
+    "tunnel",
+    "heartbeat",
+)
+
+#: lowercase substrings marking a capacity/model error — retrying
+#: reproduces these; the fix is a bigger bound (tpu_options(capacity=),
+#: hcap=, net_capacity, ...).
+CAPACITY_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "hash table overflow",
+    "probe overflow",
+    "capacity overflow",
+    "table overflow",
+)
+
+
+class ChunkDeadlineError(RuntimeError):
+    """A chunk sync outran ``tpu_options(chunk_deadline=s)`` — a hung
+    dispatch reclassified as a transient fault instead of an eternal
+    hang (the watchdog; classified TRANSIENT by construction)."""
+
+
+def classify_error(exc: BaseException) -> FaultKind:
+    """Classify a runtime error, walking the ``__cause__`` chain so a
+    wrapped fault (e.g. the exhausted-retries RuntimeError raised
+    ``from`` the original backend error) keeps its classification."""
+    seen: set = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, (ChunkDeadlineError, ConnectionError,
+                          TimeoutError)):
+            return FaultKind.TRANSIENT
+        msg = f"{type(e).__name__}: {e}".lower()
+        if any(m in msg for m in TRANSIENT_MARKERS):
+            return FaultKind.TRANSIENT
+        if any(m in msg for m in CAPACITY_MARKERS):
+            return FaultKind.CAPACITY
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return FaultKind.PROGRAMMING
+
+
+# ----------------------------------------------------------------------
+# retry policy
+# ----------------------------------------------------------------------
+class RetryPolicy:
+    """Bounded retry with jittered exponential backoff.
+
+    ``retries`` is the number of recoveries allowed per consecutive
+    fault burst (the attempt counter resets after any successful chunk
+    sync, so a long run that hiccups every few minutes keeps going;
+    ``retries`` bounds how long the engine beats on a *dead* backend
+    before degrading). ``backoff`` is the first delay in seconds; each
+    further consecutive attempt doubles it (capped) with +/-25% jitter
+    so a fleet of runs sharing one recovering backend does not
+    stampede it.
+    """
+
+    __slots__ = ("retries", "backoff", "cap", "jitter")
+
+    def __init__(self, retries: int = 0, backoff: float = 1.0,
+                 cap: float = 30.0, jitter: float = 0.25):
+        if retries < 0:
+            raise ValueError("tpu_options(retries=...) must be >= 0")
+        if backoff < 0:
+            raise ValueError("tpu_options(backoff=...) must be >= 0")
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.cap = float(cap)
+        self.jitter = float(jitter)
+
+    @classmethod
+    def from_options(cls, opts: dict) -> "RetryPolicy":
+        return cls(retries=int(opts.get("retries", 0)),
+                   backoff=float(opts.get("backoff", 1.0)))
+
+    @property
+    def enabled(self) -> bool:
+        return self.retries > 0
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based), jittered."""
+        if self.backoff <= 0:
+            return 0.0
+        base = min(self.backoff * (2.0 ** (attempt - 1)), self.cap)
+        return base * (1.0 + self.jitter * (2.0 * random.random() - 1.0))
+
+
+# ----------------------------------------------------------------------
+# watchdog
+# ----------------------------------------------------------------------
+def call_with_deadline(fn, deadline: float, what: str = "device sync"):
+    """Run ``fn()`` on a watchdog thread; raise :class:`ChunkDeadlineError`
+    if it has not returned within ``deadline`` seconds.
+
+    The abandoned call cannot be cancelled (there is no portable way to
+    interrupt a blocked PJRT transfer) — the daemon thread is left to
+    finish or die with the process; the RUN, however, gets a classified
+    transient fault instead of hanging forever."""
+    if not deadline or deadline <= 0:
+        return fn()
+    box: list = []
+
+    def run():
+        try:
+            box.append(("ok", fn()))
+        except BaseException as exc:  # delivered to the caller below
+            box.append(("err", exc))
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="stateright-tpu-watchdog")
+    t.start()
+    t.join(deadline)
+    if not box:
+        raise ChunkDeadlineError(
+            f"{what} exceeded tpu_options(chunk_deadline={deadline}) — "
+            "treating the hung dispatch as a transient backend fault")
+    tag, val = box[0]
+    if tag == "err":
+        raise val
+    return val
+
+
+# ----------------------------------------------------------------------
+# host-side authoritative state
+# ----------------------------------------------------------------------
+def pack_qrows(rows, ebits, fps, width: int) -> np.ndarray:
+    """Host-side queue-row packing: ``[packed row | ebits | fp hi | fp
+    lo]`` — the exact layout ``seed_carry``/``seed_sharded_carry`` put
+    on device, so the shadow's seed rows match the device's bit for
+    bit."""
+    k = len(rows)
+    out = np.zeros((k, width + 3), np.uint32)
+    if not k:
+        return out
+    out[:, :width] = np.stack([np.asarray(r, np.uint32) for r in rows])
+    out[:, width] = np.broadcast_to(np.asarray(ebits, np.uint32), (k,))
+    fp_arr = np.asarray([int(f) for f in fps], np.uint64)
+    out[:, width + 1] = (fp_arr >> np.uint64(32)).astype(np.uint32)
+    out[:, width + 2] = fp_arr.astype(np.uint32)
+    return out
+
+
+_GATHER_JIT = None
+
+
+def gather_rows(mat, idx: np.ndarray) -> np.ndarray:
+    """Pull ``mat[idx]`` to the host through one process-wide jitted
+    gather (indices padded to power-of-two buckets so the shape set —
+    and thus the retrace count — stays logarithmic)."""
+    global _GATHER_JIT
+    n = len(idx)
+    if n == 0:
+        return np.zeros((0,) + tuple(mat.shape[1:]), np.uint32)
+    if _GATHER_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def g(m, i):
+            return m[jnp.minimum(i, m.shape[0] - 1)]
+
+        _GATHER_JIT = jax.jit(g)
+    bucket = max(16, 1 << (n - 1).bit_length())
+    padded = np.zeros((bucket,), np.int32)
+    padded[:n] = np.asarray(idx, np.int32)
+    return np.asarray(_GATHER_JIT(mat, padded))[:n]
+
+
+class HostShadow:
+    """The host-side authoritative copy of a device run's search state.
+
+    Maintained per chunk while resilience is enabled
+    (``tpu_options(retries=..., autosave=...)``); everything a recovery
+    or an autosave needs lives here, so a dead backend can never take
+    the run's progress with it:
+
+    * the (dedup key -> parent key) mirror is updated incrementally
+      (the engine's ``_generated``/``_orig_of`` dicts are shared by
+      reference, so path reconstruction and checkpointing see a
+      complete mirror without the end-of-run device log pull);
+    * the current epoch's queue rows (packed row + at-enqueue ebits +
+      cached fingerprint), from which :meth:`pending` rebuilds the
+      frontier after a fault — an *epoch* is one device incarnation;
+      re-seeding starts a new one from the pending rows;
+    * per-shard insert records (log rows + at-enqueue ebits) and cross
+      edges, from which the ``sound_eventually`` lasso sweep rebuilds
+      the node graph without touching the device.
+
+    Layout invariants leaned on: both engines' queues and logs are
+    append-only and append in lockstep (queue row ``n_init_s + i`` is
+    log row ``i`` of its shard), and growth passes preserve every
+    shard-relative position — so per-chunk gathers of the new suffixes
+    reconstruct the device state exactly.
+    """
+
+    def __init__(self, shards: int, width: int, generated: Dict,
+                 orig_of: Dict, translate: bool, sound: bool):
+        self.shards = shards
+        self.width = width
+        self._generated = generated
+        self._orig_of = orig_of
+        self._translate = translate
+        self._sound = sound
+        self._roots: List[int] = []   # first-epoch dedup keys (lasso)
+        self._first_epoch = True
+        # cumulative across epochs (the lasso sweep's inputs)
+        self._inserts: List[List[tuple]] = [[] for _ in range(shards)]
+        self._edges: List[List[np.ndarray]] = [[] for _ in range(shards)]
+        # current-epoch queue state
+        self._epoch_q: List[List[np.ndarray]] = [[] for _ in range(shards)]
+        self._heads = [0] * shards
+        self._tails = [0] * shards
+        self.log_n = [0] * shards  # epoch-local committed log counts
+        self.e_n = [0] * shards    # epoch-local committed edge counts
+
+    # ------------------------------------------------------------------
+    def seed_epoch(self, per_shard_rows: List[np.ndarray]) -> None:
+        """Start a device epoch: ``per_shard_rows[s]`` are shard ``s``'s
+        seed queue rows (``pack_qrows`` layout) in device queue order."""
+        assert len(per_shard_rows) == self.shards
+        self._epoch_q = [[np.asarray(r, np.uint32)] if len(r) else []
+                         for r in per_shard_rows]
+        self._heads = [0] * self.shards
+        self._tails = [len(r) for r in per_shard_rows]
+        self.log_n = [0] * self.shards
+        self.e_n = [0] * self.shards
+        if self._first_epoch:
+            self._first_epoch = False
+            if self._sound:
+                from ..fingerprint import fp64_node
+            for r in per_shard_rows:
+                for j in range(len(r)):
+                    fp = int(_combine64(r[j, self.width + 1],
+                                        r[j, self.width + 2]))
+                    self._roots.append(
+                        fp64_node(fp, int(r[j, self.width]))
+                        if self._sound else fp)
+
+    def note_chunk(self, s: int, q_new: np.ndarray, log_new: np.ndarray,
+                   elog_new: Optional[np.ndarray], q_head: int) -> None:
+        """Fold one chunk's per-shard appends in (queue rows and log
+        rows are the lockstep suffixes; counts must match)."""
+        n = len(log_new)
+        assert len(q_new) == n, (len(q_new), n)
+        if n:
+            q_new = np.asarray(q_new, np.uint32)
+            log_new = np.asarray(log_new, np.uint32)
+            self._epoch_q[s].append(q_new)
+            self._tails[s] += n
+            self.log_n[s] += n
+            self._inserts[s].append((log_new, q_new[:, self.width]))
+            child = _combine64(log_new[:, 0], log_new[:, 1])
+            parent = _combine64(log_new[:, 2], log_new[:, 3])
+            self._generated.update(zip(child.tolist(), parent.tolist()))
+            if self._translate:
+                orig = _combine64(log_new[:, 4], log_new[:, 5])
+                self._orig_of.update(zip(child.tolist(), orig.tolist()))
+        if elog_new is not None and len(elog_new):
+            self._edges[s].append(np.asarray(elog_new, np.uint32))
+            self.e_n[s] += len(elog_new)
+        self._heads[s] = int(q_head)
+
+    # ------------------------------------------------------------------
+    def _epoch_rows(self, s: int) -> np.ndarray:
+        parts = self._epoch_q[s]
+        if not parts:
+            return np.zeros((0, self.width + 3), np.uint32)
+        if len(parts) > 1:
+            self._epoch_q[s] = parts = [np.concatenate(parts)]
+        return parts[0]
+
+    def pending(self):
+        """The live frontier — ``(rows, ebits, fps)`` concatenated in
+        shard order — from which a recovery (or an autosave checkpoint)
+        re-seeds a fresh device incarnation."""
+        rows_l, eb_l, fp_l = [], [], []
+        for s in range(self.shards):
+            allq = self._epoch_rows(s)
+            live = allq[self._heads[s]:self._tails[s]]
+            rows_l.append(live[:, :self.width])
+            eb_l.append(live[:, self.width])
+            fp_l.append(_combine64(live[:, self.width + 1],
+                                   live[:, self.width + 2]))
+        return (np.concatenate(rows_l) if rows_l
+                else np.zeros((0, self.width), np.uint32),
+                np.concatenate(eb_l) if eb_l
+                else np.zeros((0,), np.uint32),
+                np.concatenate(fp_l) if fp_l
+                else np.zeros((0,), np.uint64))
+
+    def root_keys(self) -> List[int]:
+        """First-epoch seed dedup keys (the lasso sweep's roots)."""
+        return list(self._roots)
+
+    def insert_block(self, s: int):
+        """Shard ``s``'s cumulative insert records as ``(log_rows,
+        ebits)`` arrays (the lasso sweep's ``add_log_block`` inputs)."""
+        parts = self._inserts[s]
+        if not parts:
+            return None
+        return (np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]))
+
+    def edge_block(self, s: int) -> np.ndarray:
+        parts = self._edges[s]
+        if not parts:
+            return np.zeros((0, 4), np.uint32)
+        return np.concatenate(parts)
+
+
+# ----------------------------------------------------------------------
+# crash-safe checkpoint write (shared by save() and autosave)
+# ----------------------------------------------------------------------
+def atomic_savez(path, **arrays) -> None:
+    """``np.savez_compressed`` into a temp file in the target directory,
+    fsync, then ``os.replace`` into place — an interrupted write
+    (SIGKILL, full disk, a dying host) can never leave a truncated file
+    where a good checkpoint stood. The file object (not a path) keeps
+    numpy from appending its own ``.npz`` suffix."""
+    path = os.fspath(path)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -45,6 +45,7 @@ are not treated as terminal.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -316,14 +317,26 @@ class TpuChecker(HostChecker):
         self._host_props = [
             (i, self._properties[i])
             for i in getattr(model, "host_property_indices", ())]
-        fns = getattr(model, "host_property_fns", None)
-        if fns is not None and len(fns) != len(self._host_props):
+        # packed fast-path evaluators, resolved ONCE into _host_props
+        # order. The canonical form is a dict keyed by PROPERTY NAME —
+        # a renamed/reordered subclass property binds the right lambda
+        # or fails loudly, where the legacy positional list could
+        # silently bind the wrong one (it survives only with the
+        # length guard)
+        self._host_fns = self._resolve_host_fns(
+            getattr(model, "host_property_fns", None))
+        # --- resilience knobs (checker/resilience.py) ------------------
+        from .resilience import RetryPolicy
+        self._retry_policy = RetryPolicy.from_options(opts)
+        self._fault_hook = opts.get("fault_hook")
+        self._chunk_deadline = opts.get("chunk_deadline")
+        if self._chunk_deadline is not None \
+                and float(self._chunk_deadline) <= 0:
             raise ValueError(
-                f"model declares {len(self._host_props)} host-evaluated "
-                f"properties (host_property_indices) but {len(fns)} "
-                "host_property_fns; a subclass that changes properties "
-                "must keep the packed fast-path evaluators in lockstep "
-                "(or drop host_property_fns to fall back to decode())")
+                "tpu_options(chunk_deadline=...) must be positive "
+                "seconds (omit it to disable the watchdog)")
+        self._autosave_path = opts.get("autosave")
+        self._autosave_every = int(opts.get("autosave_interval", 32))
         # host-evaluated EVENTUALLY properties run on the per-level
         # engine: the device never clears their ebits (the packed
         # placeholder bit must be False); the host evaluates each new
@@ -390,6 +403,179 @@ class TpuChecker(HostChecker):
     # _timed/profile() come from HostChecker: ONE metrics registry per
     # run, keys documented once in stateright_tpu.obs.GLOSSARY (the
     # overlap timers dispatch/sync_stall/host_overlap included).
+
+    def _resolve_host_fns(self, fns) -> "Optional[list]":
+        """Normalize ``model.host_property_fns`` into ``_host_props``
+        order: a dict binds by property name (unknown/missing names
+        fail loudly); a legacy sequence binds positionally behind the
+        length guard."""
+        if fns is None:
+            return None
+        if isinstance(fns, dict):
+            names = [p.name for _i, p in self._host_props]
+            unknown = sorted(set(fns) - set(names))
+            missing = [n for n in names if n not in fns]
+            if unknown or missing:
+                raise ValueError(
+                    "host_property_fns keys must match the model's "
+                    "host-evaluated property names exactly "
+                    f"(host_property_indices -> {names}); "
+                    f"unknown={unknown}, missing={missing}. A subclass "
+                    "that renames or reorders properties must keep the "
+                    "packed fast-path evaluators in lockstep (or drop "
+                    "host_property_fns to fall back to decode())")
+            return [fns[n] for n in names]
+        if len(fns) != len(self._host_props):
+            raise ValueError(
+                f"model declares {len(self._host_props)} host-evaluated "
+                f"properties (host_property_indices) but {len(fns)} "
+                "host_property_fns; a subclass that changes properties "
+                "must keep the packed fast-path evaluators in lockstep "
+                "(or drop host_property_fns to fall back to decode())")
+        return list(fns)
+
+    # --- resilience plumbing (checker/resilience.py) -------------------
+    def _make_shadow(self, shards: int):
+        """The host-side authoritative state, maintained per chunk when
+        retry or autosave is on (``None`` otherwise — zero cost)."""
+        if not (self._retry_policy.enabled
+                or self._autosave_path is not None):
+            return None
+        from .resilience import HostShadow
+        return HostShadow(shards, self._model.packed_width,
+                          self._generated, self._orig_of,
+                          translate=self._symmetry or self._sound,
+                          sound=self._sound)
+
+    def _materialize_stats(self, stats_d, ordinal: int) -> np.ndarray:
+        """Pull one chunk's stats vector through the resilience hooks:
+        the injected fault hook fires first (the tests' transient-fault
+        injection point), then the optional watchdog deadline bounds
+        the device round trip (a hang becomes a classified fault)."""
+        import jax
+
+        hook = self._fault_hook
+
+        def pull():
+            if hook is not None:
+                hook(ordinal)
+            return np.asarray(jax.device_get(stats_d))
+
+        deadline = self._chunk_deadline
+        if not deadline:
+            return pull()
+        from .resilience import ChunkDeadlineError, call_with_deadline
+        try:
+            return call_with_deadline(pull, float(deadline),
+                                      what=f"chunk {ordinal} sync")
+        except ChunkDeadlineError:
+            if self._trace:
+                self._trace.emit("watchdog", deadline=float(deadline),
+                                 chunk=ordinal)
+            raise
+
+    def _checkpoint_save(self, path, rows, ebits, ffps,
+                         discoveries: Dict[str, object]) -> None:
+        """Write a ``resume_from``-loadable checkpoint (the complete
+        mirror + the given pending frontier) through the crash-safe
+        atomic write. Shared by ``save()`` and the autosave path."""
+        import json
+
+        from .resilience import atomic_savez
+
+        child = np.fromiter(self._generated.keys(), np.uint64,
+                            len(self._generated))
+        parent = np.fromiter(
+            (p if p is not None else 0
+             for p in self._generated.values()),
+            np.uint64, len(self._generated))
+        okeys = np.fromiter(self._orig_of.keys(), np.uint64,
+                            len(self._orig_of))
+        ovals = np.fromiter(self._orig_of.values(), np.uint64,
+                            len(self._orig_of))
+        meta = json.dumps({
+            "model": self._model_tag(),
+            "discoveries": {n: ([int(f) for f in fp]
+                                if isinstance(fp, (list, tuple))
+                                else int(fp))
+                            for n, fp in discoveries.items()},
+            "symmetry": bool(self._symmetry),
+            "sound": bool(self._sound),
+        })
+        atomic_savez(path, child=child, parent=parent,
+                     rows=np.asarray(rows, np.uint32),
+                     ebits=np.asarray(ebits, np.uint32),
+                     ffps=np.asarray(ffps, np.uint64),
+                     okeys=okeys, ovals=ovals,
+                     state_count=np.int64(self._state_count),
+                     meta=np.asarray(meta))
+
+    def _write_autosave(self, shadow,
+                        discoveries: Dict[str, object]) -> None:
+        """Checkpoint the shadow (periodic, and on exhausted retries):
+        purely host-side, so it works even with a dead backend."""
+        rows, ebits, fps = shadow.pending()
+        self._checkpoint_save(self._autosave_path, rows, ebits, fps,
+                              discoveries)
+        self._metrics.inc("autosaves")
+        if self._trace:
+            self._trace.emit("autosave",
+                             path=os.fspath(self._autosave_path),
+                             unique=len(self._generated))
+
+    def _resilience_degrade(self, exc: BaseException, shadow,
+                            discoveries: Dict[str, object]) -> None:
+        """Exhausted retries: land an artifact instead of just dying —
+        write the autosave checkpoint (when configured) and raise ONE
+        actionable error naming the resume command."""
+        if self._autosave_path is not None:
+            self._write_autosave(shadow, discoveries)
+            path = os.fspath(self._autosave_path)
+            raise RuntimeError(
+                "transient device fault persisted after "
+                f"{self._retry_policy.retries} retries "
+                f"({type(exc).__name__}: {exc}); progress checkpointed "
+                f"to {path!r} — resume with "
+                f"model.checker().resume_from({path!r}).spawn_tpu() "
+                "once the backend recovers") from exc
+        raise RuntimeError(
+            "transient device fault persisted after "
+            f"{self._retry_policy.retries} retries "
+            f"({type(exc).__name__}: {exc}); set "
+            "tpu_options(autosave=path) to checkpoint progress on "
+            "exhausted retries") from exc
+
+    def _shadow_lasso_sweep(self, shadow, full_mask: int,
+                            discoveries: Dict[str, object]) -> None:
+        """The sound-mode SCC sweep rebuilt from the shadow's insert and
+        cross-edge records instead of the device logs — after a
+        mid-run recovery the device logs only cover the last epoch,
+        while the shadow spans the whole run."""
+        from .lasso import add_log_block, add_seed_nodes, lasso_sweep
+
+        node_fp: Dict[int, int] = {}
+        node_parent: Dict[int, tuple] = {}
+        node_mask: Dict[int, int] = {}
+        node_edges: Dict[int, list] = {}
+        add_seed_nodes(node_fp, node_parent, node_mask,
+                       shadow.root_keys(), self._orig_of, full_mask)
+        empty_edges = np.zeros((0, 4), np.uint32)
+        for s in range(shadow.shards):
+            block = shadow.insert_block(s)
+            edges = shadow.edge_block(s)
+            if block is None and not len(edges):
+                continue
+            log_rows, eb_rows = block if block is not None else (
+                np.zeros((0, 6), np.uint32), np.zeros((0,), np.uint32))
+            add_log_block(node_fp, node_parent, node_mask, node_edges,
+                          log_rows, eb_rows,
+                          edges if len(edges) else empty_edges)
+        lasso_sweep(self._properties, discoveries, node_edges,
+                    node_mask, node_parent, node_fp)
+        if self._trace:
+            self._trace.emit(
+                "lasso", nodes=len(node_mask),
+                edges=sum(len(v) for v in node_edges.values()))
 
     # ------------------------------------------------------------------
     def _run(self) -> None:
@@ -574,9 +760,15 @@ class TpuChecker(HostChecker):
 
         # one while_loop iteration inserts at most kmax new states (and at
         # most fa once kmax has grown to its bound); capacity must keep
-        # that headroom below the growth exit
+        # that headroom below the growth exit. ``preload`` is the table
+        # occupancy seeded before the first chunk (just the inits on a
+        # fresh run, the WHOLE mirrored reached set on a resume or a
+        # post-fault re-seed) — the growth trigger compares the
+        # epoch-local device log count against the limit, so the limit
+        # must leave room for the preloaded keys
         headroom = fa
-        while self._grow_at * self._capacity <= headroom + n_init:
+        preload = len(generated)
+        while self._grow_at * self._capacity <= headroom + preload:
             self._capacity *= 4
 
         # append-only queue: must hold every state enqueued before the next
@@ -641,6 +833,21 @@ class TpuChecker(HostChecker):
         chunk_fn = mk_chunk()
         pipeline = bool(opts.get("pipeline", True))
 
+        # --- resilience (checker/resilience.py) -------------------------
+        # with retry or autosave on, the host keeps the authoritative
+        # shadow (mirror + pending frontier + sound-mode edge records),
+        # updated per chunk; a transient backend fault re-seeds a fresh
+        # device incarnation from it and resumes
+        from .resilience import (FaultKind, classify_error, gather_rows,
+                                 pack_qrows)
+
+        policy = self._retry_policy
+        shadow = self._make_shadow(1)
+        if shadow is not None:
+            shadow.seed_epoch([pack_qrows(init_rows, seed_ebits,
+                                          cache_fps,
+                                          model.packed_width)])
+
         # --- chunk loop -------------------------------------------------
         # Double-buffered pipeline (``tpu_options(pipeline=False)`` forces
         # the synchronous path): chunk N+1 is launched on the carry — a
@@ -680,9 +887,13 @@ class TpuChecker(HostChecker):
                 # stall the loop via hovf) — rebuild without it
                 hcap = 0
                 chunk_fn = mk_chunk("hdrop")
+            # the growth limit bounds the EPOCH-LOCAL device log; the
+            # preloaded table keys (inits / resumed mirror / post-fault
+            # re-seed) are subtracted so total occupancy still trips
+            # growth at ~grow_at
             grow_limit = np.int32(min(
                 self._grow_at * self._capacity,
-                self._capacity - headroom))
+                self._capacity - headroom) - preload)
             remaining = np.int32(
                 min(max(target - self._state_count, 0), 2**31 - 1)
                 if target is not None else 2**31 - 1)
@@ -692,20 +903,24 @@ class TpuChecker(HostChecker):
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit,
                                           np.int32(self._h_pulled))
-            inflight.append((stats_d, self._h_pulled, int(grow_limit),
-                             hcap))
             self._metrics.inc("chunks")
+            inflight.append((int(self._metrics.get("chunks")), stats_d,
+                             self._h_pulled, int(grow_limit), hcap))
 
-        def process(stats_d, h_base: int, grow_limit: int,
+        def process(ordinal: int, stats_d, h_base: int, grow_limit: int,
                     hcap_d: int) -> set:
             """Consume one chunk's stats vector; returns the host
             actions it demands (handled once the pipeline is drained)."""
-            nonlocal seed_ovf
+            nonlocal seed_ovf, fault_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # (scalars + the representative window when host props
                 # are on): each transfer costs ~100 ms of tunnel latency
-                stats = np.asarray(stats_d)
+                # — routed through the fault hook + watchdog deadline
+                stats = self._materialize_stats(stats_d, ordinal)
+            # a successful sync proves the backend is alive: the retry
+            # budget bounds CONSECUTIVE faults, not lifetime hiccups
+            fault_attempt = 0
             t0 = time.perf_counter()
             acts: set = set()
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
@@ -723,6 +938,26 @@ class TpuChecker(HostChecker):
             if q_tail > 0:
                 # most recently enqueued state (live Explorer progress)
                 self._recent_row = stats[tail0:tail0 + width3].copy()
+            if shadow is not None:
+                # fold this chunk's appends into the host shadow (the
+                # queue/log suffixes are append-only, so gathering them
+                # from the LIVE carry — possibly a later in-flight
+                # chunk's future — reads exactly the committed rows)
+                with self._timed("shadow"):
+                    prev = shadow.log_n[0]
+                    q_new = gather_rows(carry.q, np.arange(
+                        n_init + prev, n_init + log_n, dtype=np.int32))
+                    log_new = gather_rows(carry.log, np.arange(
+                        prev, log_n, dtype=np.int32))
+                    e_new = None
+                    if ecap:
+                        e_new = gather_rows(carry.elog, np.arange(
+                            shadow.e_n[0], e_n, dtype=np.int32))
+                    shadow.note_chunk(0, q_new, log_new, e_new, q_head)
+                if (self._autosave_path is not None
+                        and self._autosave_every > 0
+                        and ordinal % self._autosave_every == 0):
+                    self._write_autosave(shadow, discoveries)
             new = log_n - cur["log_n"]  # this chunk's fresh inserts
             cur.update(q_size=q_tail - q_head, q_tail=q_tail,
                        log_n=log_n, e_n=e_n)
@@ -739,7 +974,7 @@ class TpuChecker(HostChecker):
             trace = self._trace
             if trace:
                 trace.emit(
-                    "chunk", chunk=int(metrics.get("chunks", 0)),
+                    "chunk", chunk=ordinal,
                     gen=gen, unique=self._unique_state_count,
                     q_size=q_tail - q_head, new=new,
                     # dedup hit-rate: generated children this chunk
@@ -924,34 +1159,111 @@ class TpuChecker(HostChecker):
                                  qcap=qcap)
             chunk_fn = mk_chunk("grow")
 
-        dispatch()
+        def reseed() -> None:
+            # post-fault recovery: rebuild the device state from the
+            # shadow — a fresh carry seeded with the pending frontier,
+            # the visited table re-inserted from the complete host
+            # mirror, the chunk program recompiled for the new n_init.
+            # Dedup is set-semantics, so the rebuilt run explores
+            # exactly the remaining graph: discoveries and fingerprint
+            # sets match an uninterrupted run (tests/test_resilience.py)
+            nonlocal carry, chunk_fn, qcap, hcap, ecap, n_init, \
+                base_unique, seed_ovf, preload
+            rows, ebs, fps = shadow.pending()
+            init_rows2 = [rows[i] for i in range(rows.shape[0])]
+            n_init = len(init_rows2)
+            self._h_pulled = 0
+            self._hscan_tail = n_init
+            self._base_fps = list(generated.keys())
+            base_unique = len(generated)
+            preload = len(generated)
+            while self._grow_at * self._capacity <= headroom + preload:
+                self._capacity *= 4
+            qcap = self._device_qcap(n_init, headroom)
+            hcap = (self._posthoc_cap
+                    if self._host_props and want_reps_now() else 0)
+            if self._sound:
+                ecap = max(ecap, self._capacity)
+            with self._timed("seed"):
+                carry = seed_carry(
+                    model, qcap, self._capacity, init_rows2,
+                    np.asarray(ebs, np.uint32),
+                    symmetry=self._symmetry or self._sound, hcap=hcap,
+                    init_fps=[int(f) for f in fps], ecap=ecap)
+                key_hi, key_lo, seed_ovf = self._bulk_insert_async(
+                    insert_fn, carry.key_hi, carry.key_lo,
+                    list(generated.keys()))
+                carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
+            shadow.seed_epoch([pack_qrows(init_rows2, ebs, fps,
+                                          model.packed_width)])
+            cur.update(q_size=n_init, q_tail=n_init, log_n=0, e_n=0)
+            hgrow_pend.update(on=False, hovf=False, h_n=0)
+            kovf_pend[:] = [0, 0, 0]
+            chunk_fn = mk_chunk("retry")
+
+        fault_attempt = 0
+        recover_delay: "Optional[float]" = None
         while True:
-            if pipeline and len(inflight) == 1:
+            try:
+                if recover_delay is not None:
+                    # back off BEFORE touching the device again (give a
+                    # restarting backend/tunnel time to come up); the
+                    # reseed itself runs inside the retry envelope, so
+                    # a still-dead backend just burns another attempt
+                    if recover_delay > 0:
+                        time.sleep(recover_delay)
+                    recover_delay = None
+                    reseed()
                 dispatch()
-            acts = process(*inflight.popleft())
-            if not acts:
-                if not inflight:
+                while True:
+                    if pipeline and len(inflight) == 1:
+                        dispatch()
+                    acts = process(*inflight.popleft())
+                    if not acts:
+                        if not inflight:
+                            dispatch()
+                        continue
+                    # a host intervention (or an exit) is due: drain the
+                    # one speculative chunk first — under any
+                    # device-visible stop condition it ran zero
+                    # iterations and its stats replay idempotently; past
+                    # a host-only exit it is one extra chunk of real
+                    # (merged) exploration
+                    while inflight:
+                        acts |= process(*inflight.popleft())
+                    if hgrow_pend["on"]:
+                        handle_hgrow()
+                        acts.discard("hgrow")
+                    if "kovf" in acts:
+                        handle_kovf()
+                    elif "done" in acts:
+                        break
+                    elif "egrow" in acts:
+                        handle_egrow()
+                    elif "grow" in acts:
+                        handle_grow()
                     dispatch()
-                continue
-            # a host intervention (or an exit) is due: drain the one
-            # speculative chunk first — under any device-visible stop
-            # condition it ran zero iterations and its stats replay
-            # idempotently; past a host-only exit it is one extra chunk
-            # of real (merged) exploration
-            while inflight:
-                acts |= process(*inflight.popleft())
-            if hgrow_pend["on"]:
-                handle_hgrow()
-                acts.discard("hgrow")
-            if "kovf" in acts:
-                handle_kovf()
-            elif "done" in acts:
                 break
-            elif "egrow" in acts:
-                handle_egrow()
-            elif "grow" in acts:
-                handle_grow()
-            dispatch()
+            except BaseException as exc:
+                if (shadow is None
+                        or classify_error(exc) is not FaultKind.TRANSIENT):
+                    raise
+                # transient backend fault: the in-flight futures are
+                # poisoned (or superseded — their un-consumed work
+                # replays from the shadow); drop them, back off,
+                # re-seed, resume. Capacity and programming errors
+                # re-raise above: retrying reproduces them.
+                inflight.clear()
+                if fault_attempt >= policy.retries:
+                    self._resilience_degrade(exc, shadow, discoveries)
+                fault_attempt += 1
+                recover_delay = policy.delay(fault_attempt)
+                self._metrics.inc("retries")
+                if self._trace:
+                    self._trace.emit(
+                        "retry", attempt=fault_attempt,
+                        delay=round(recover_delay, 3),
+                        error=f"{type(exc).__name__}: {exc}")
         q_size = cur["q_size"]
         q_tail, log_n, e_n = cur["q_tail"], cur["log_n"], cur["e_n"]
 
@@ -980,9 +1292,16 @@ class TpuChecker(HostChecker):
             # the reference can see. Skipped on resume: the
             # pre-checkpoint subgraph's edges are not in this run's logs.
             with self._timed("lasso"):
-                self._device_lasso_sweep(carry, int(q_tail), int(log_n),
-                                         int(e_n), n_init,
-                                         int(full_ebits), discoveries)
+                if shadow is not None:
+                    # after a mid-run recovery the device logs cover
+                    # only the last epoch; the shadow spans the run
+                    self._shadow_lasso_sweep(shadow, int(full_ebits),
+                                             discoveries)
+                else:
+                    self._device_lasso_sweep(carry, int(q_tail),
+                                             int(log_n), int(e_n),
+                                             n_init, int(full_ebits),
+                                             discoveries)
 
         if self._tpu_options.get("resumable"):
             # pull the pending frontier eagerly so save() needs no pinned
@@ -998,8 +1317,10 @@ class TpuChecker(HostChecker):
         # the mirror (fp -> parent fp) stays device-resident until someone
         # needs it (path reconstruction, checkpointing): the log pull is
         # pure host-link cost, pointless for count-only runs. Keep only
-        # the log fields so the table/queue HBM is freed promptly.
-        self._mirror_carry = (carry.log, carry.log_n)
+        # the log fields so the table/queue HBM is freed promptly. With
+        # the shadow on, the host mirror is already complete — no pull.
+        self._mirror_carry = (None if shadow is not None
+                              else (carry.log, carry.log_n))
         self._discovery_fps.update(discoveries)
 
     def _device_lasso_sweep(self, carry, q_tail: int, log_n: int,
@@ -1616,7 +1937,7 @@ class TpuChecker(HostChecker):
         key = model.host_property_key(row)
         results = self._host_prop_cache.get(key)
         if results is None:
-            fns = getattr(model, "host_property_fns", None)
+            fns = self._host_fns
             if fns is not None:
                 # packed fast path: the model evaluates each host
                 # property straight off the packed row (e.g. ABD's
@@ -1668,7 +1989,7 @@ class TpuChecker(HostChecker):
         keys = (block_fn(rows) if block_fn is not None
                 else [model.host_property_key(row) for row in rows])
         cache = self._host_prop_cache
-        fns = getattr(model, "host_property_fns", None)
+        fns = self._host_fns
         for j in range(n):
             results = cache.get(keys[j])
             if results is None:
@@ -1758,60 +2079,14 @@ class TpuChecker(HostChecker):
                 "tpu_options(resumable=True) on the device engine")
         self._ensure_mirror()
         rows, ebits, ffps = self._resume_frontier
-        child = np.fromiter(self._generated.keys(), np.uint64,
-                            len(self._generated))
-        parent = np.fromiter(
-            (p if p is not None else 0 for p in self._generated.values()),
-            np.uint64, len(self._generated))
-        # under symmetry/sound the mirror keys are canonical/node keys;
-        # _orig_of translates each back to a concrete replayable state fp
-        okeys = np.fromiter(self._orig_of.keys(), np.uint64,
-                            len(self._orig_of))
-        ovals = np.fromiter(self._orig_of.values(), np.uint64,
-                            len(self._orig_of))
-        import json
-
-        meta = json.dumps({
-            "model": self._model_tag(),
-            # list-valued discoveries are explicit fingerprint paths
-            # (lasso witnesses) and round-trip as lists
-            "discoveries": {n: ([int(f) for f in fp]
-                                if isinstance(fp, (list, tuple))
-                                else int(fp))
-                            for n, fp in self._discovery_fps.items()},
-            # dedup-key semantics must match at resume: node keys under
-            # sound, canonical-orbit keys under symmetry
-            "symmetry": bool(self._symmetry),
-            "sound": bool(self._sound),
-        })
-        # crash-safe write: the .npz lands in a temp file in the target
-        # directory and is os.replace()d into place, so an interrupted
-        # checkpoint (SIGKILL, full disk, ...) can never leave a
-        # truncated file where a good one stood. The file object (not a
-        # path) keeps numpy from appending its own .npz suffix.
-        import os
-        import tempfile
-
-        path = os.fspath(path)
-        fd, tmp = tempfile.mkstemp(
-            dir=os.path.dirname(path) or ".",
-            prefix=os.path.basename(path) + ".", suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez_compressed(
-                    f, child=child, parent=parent, rows=rows,
-                    ebits=ebits, ffps=ffps, okeys=okeys, ovals=ovals,
-                    state_count=np.int64(self._state_count),
-                    meta=np.asarray(meta))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        # the shared crash-safe writer (resilience.atomic_savez under
+        # _checkpoint_save): mirror + pending frontier, with the
+        # canonical/node-key -> original-fp translation and the
+        # dedup-key semantics (symmetry/sound) in the metadata;
+        # list-valued discoveries are explicit fingerprint paths
+        # (lasso witnesses) and round-trip as lists
+        self._checkpoint_save(path, rows, ebits, ffps,
+                              self._discovery_fps)
 
     def _model_tag(self) -> str:
         """Identity check for resume: a checkpoint only makes sense for

@@ -53,6 +53,9 @@ GLOSSARY: Dict[str, str] = {
     "mirror_pull": "pulling the device (child, parent) log into the "
                    "host mirror",
     "visit": "post-hoc CheckerVisitor replay over the reached set",
+    "shadow": "maintaining the host-side authoritative state "
+              "(checker/resilience.py) — per-chunk queue/log suffix "
+              "gathers while retry/autosave is enabled",
     # --- counters ----------------------------------------------------
     "chunks": "completed chunk dispatches (each up to chunk_steps "
               "frontier levels)",
@@ -63,6 +66,14 @@ GLOSSARY: Dict[str, str] = {
                 "retrace unless the shapes hit the compile cache",
     "levels": "BFS levels completed (host/per-level engines)",
     "jobs": "DFS stack jobs completed (multi-process DFS)",
+    "retries": "transient-fault recoveries taken (re-seed + resume; "
+               "bounded per consecutive burst by "
+               "tpu_options(retries=N))",
+    "failovers": "raced runs adopted by the un-budgeted host BFS "
+                 "fallback after a transient device failure",
+    "autosaves": "resilience checkpoints written (periodic "
+                 "tpu_options(autosave=...) snapshots plus the "
+                 "exhausted-retries write)",
     # --- observed maxima (buffer autotuning inputs) -------------------
     "vmax": "max raw-valid candidate lanes in one iteration (sizes "
             "kraw; compare against fmax*max_actions)",

@@ -69,6 +69,14 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "fault_injection": frozenset({"max_crashes"}),
     # a property discovery was recorded
     "discovery": frozenset({"property", "fp"}),
+    # resilience layer (checker/resilience.py): a transient-fault
+    # recovery (re-seed + resume), a hung chunk sync converted to a
+    # classified fault by the watchdog, a checkpoint autosave, and a
+    # raced run falling over to the un-budgeted host BFS
+    "retry": frozenset({"attempt", "delay", "error"}),
+    "watchdog": frozenset({"deadline"}),
+    "autosave": frozenset({"path", "unique"}),
+    "failover": frozenset({"to", "error"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

@@ -150,9 +150,14 @@ class ShardedTpuChecker(TpuChecker):
         ecap = self._capacity if self._sound else 0
         headroom = max(D * kmax, fmax)
         # per-shard slice must keep one worst-case iteration of headroom
-        # below the growth limit (same invariant as the single-chip loop)
+        # below the growth limit (same invariant as the single-chip
+        # loop); ``preload`` — the table keys seeded before the first
+        # chunk — is subtracted from the per-shard growth limit so total
+        # occupancy still trips growth at ~grow_at on resumed and
+        # fault-recovered runs
+        preload = len(table_fps)
         while self._grow_at * (self._capacity // D) \
-                <= headroom + len(table_fps):
+                <= headroom + preload:
             self._capacity *= 4
         # per-shard init fps in queue order (post-hoc witness mapping);
         # the queue slices are sized from the per-shard split, not the
@@ -218,6 +223,38 @@ class ShardedTpuChecker(TpuChecker):
 
         host_prop_idx = {i for i, _p in self._host_props}
 
+        # --- resilience (checker/resilience.py) -------------------------
+        # identical contract to the single-chip engine: with retry or
+        # autosave on, the host shadow is maintained per chunk (per
+        # shard), and a transient fault re-seeds a fresh sharded carry
+        # from it, re-routing the pending frontier by owner exactly
+        # like a checkpoint resume
+        from ..checker.resilience import (FaultKind, classify_error,
+                                          gather_rows, pack_qrows)
+
+        policy = self._retry_policy
+        shadow = self._make_shadow(D)
+
+        def seed_shadow_epoch(rows_list, frontier_keys, ebs_arr,
+                              cache_list) -> None:
+            # per-shard rows in the DEVICE routing order (the stable
+            # order of appearance seed_sharded_carry uses)
+            per = [([], [], []) for _ in range(D)]
+            for i, key in enumerate(frontier_keys):
+                pr, pe, pf = per[owner_of(key, D)]
+                pr.append(rows_list[i])
+                pe.append(int(ebs_arr[i]))
+                pf.append(int(cache_list[i]))
+            shadow.seed_epoch([
+                pack_qrows(pr, np.asarray(pe, np.uint32), pf,
+                           model.packed_width)
+                for pr, pe, pf in per])
+
+        if shadow is not None:
+            ebs_b = np.broadcast_to(np.asarray(seed_ebits, np.uint32),
+                                    (len(init_rows),))
+            seed_shadow_epoch(init_rows, frontier_fps, ebs_b, cache_fps)
+
         # --- chunk loop -------------------------------------------------
         # Double-buffered dispatch, exactly like the single-chip engine
         # (checker/tpu.py chunk loop): chunk N+1 launches on the donated
@@ -239,8 +276,10 @@ class ShardedTpuChecker(TpuChecker):
         def dispatch() -> None:
             nonlocal carry
             closc = self._capacity // D
+            # epoch-local growth limit: the preloaded table keys are
+            # subtracted, as in the single-chip dispatch
             grow_limit = np.int32(min(self._grow_at * closc,
-                                      closc - headroom))
+                                      closc - headroom) - preload)
             remaining = np.int32(
                 min(max(target - self._state_count, 0), 2**31 - 1)
                 if target is not None else 2**31 - 1)
@@ -251,13 +290,19 @@ class ShardedTpuChecker(TpuChecker):
                                    bmax=jnp.int32(0))
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit)
-            inflight.append((stats_d, int(grow_limit)))
             self._metrics.inc("chunks")
+            inflight.append((int(self._metrics.get("chunks")), stats_d,
+                             int(grow_limit)))
 
-        def process(stats_d, grow_limit: int) -> set:
+        def process(ordinal: int, stats_d, grow_limit: int) -> set:
+            nonlocal fault_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
-                stats = np.asarray(jax.device_get(stats_d))
+                # — routed through the fault hook + watchdog deadline
+                stats = self._materialize_stats(stats_d, ordinal)
+            # a successful sync proves the backend is alive; the retry
+            # budget bounds CONSECUTIVE faults
+            fault_attempt = 0
             t0 = time.perf_counter()
             acts: set = set()
             q_head = stats[:D].astype(np.int64)
@@ -276,6 +321,60 @@ class ShardedTpuChecker(TpuChecker):
             disc_lo = stats[base + 2 * prop_count:base + 3 * prop_count]
             e_n = stats[base + 3 * prop_count:
                         base + 3 * prop_count + D].astype(np.int64)
+            if shadow is not None:
+                # fold each shard's appends into the host shadow: the
+                # per-shard queue/log slices are append-only and keep
+                # their shard-relative positions across growths, so the
+                # suffix gathers reconstruct the device state exactly
+                with self._timed("shadow"):
+                    qloc = qcap // D
+                    closc = self._capacity // D
+                    eloc = (ecap // D) if ecap else 0
+                    q_idx, l_idx, e_idx = [], [], []
+                    q_cnt, e_cnt = [0] * D, [0] * D
+                    for s in range(D):
+                        prev = shadow.log_n[s]
+                        nn = int(log_n[s]) - prev
+                        if nn > 0:
+                            n0 = int(n_init_arr[s])
+                            q_idx.append(np.arange(
+                                s * qloc + n0 + prev,
+                                s * qloc + n0 + prev + nn, dtype=np.int32))
+                            l_idx.append(np.arange(
+                                s * closc + prev, s * closc + prev + nn,
+                                dtype=np.int32))
+                            q_cnt[s] = nn
+                        if eloc:
+                            pe = shadow.e_n[s]
+                            ne = int(e_n[s]) - pe
+                            if ne > 0:
+                                e_idx.append(np.arange(
+                                    s * eloc + pe, s * eloc + pe + ne,
+                                    dtype=np.int32))
+                                e_cnt[s] = ne
+                    empty = np.zeros((0,), np.int32)
+                    q_new = gather_rows(
+                        carry.q, np.concatenate(q_idx) if q_idx else empty)
+                    l_new = gather_rows(
+                        carry.log,
+                        np.concatenate(l_idx) if l_idx else empty)
+                    e_new = (gather_rows(
+                        carry.elog,
+                        np.concatenate(e_idx) if e_idx else empty)
+                        if eloc else None)
+                    qo = eo = 0
+                    for s in range(D):
+                        nn, ne = q_cnt[s], e_cnt[s]
+                        shadow.note_chunk(
+                            s, q_new[qo:qo + nn], l_new[qo:qo + nn],
+                            (e_new[eo:eo + ne] if eloc else None),
+                            int(q_head[s]))
+                        qo += nn
+                        eo += ne
+                if (self._autosave_path is not None
+                        and self._autosave_every > 0
+                        and ordinal % self._autosave_every == 0):
+                    self._write_autosave(shadow, discoveries)
             shard_new = log_n - cur["log_n"]  # per-shard fresh inserts
             cur.update(q_head=q_head, q_tail=q_tail, log_n=log_n,
                        e_n=e_n)
@@ -290,7 +389,7 @@ class ShardedTpuChecker(TpuChecker):
             if trace:
                 new = int(shard_new.sum())
                 trace.emit(
-                    "chunk", chunk=int(metrics.get("chunks", 0)),
+                    "chunk", chunk=ordinal,
                     gen=gen, unique=self._unique_state_count,
                     q_size=int((q_tail - q_head).sum()), new=new,
                     dedup_hit=(round(1.0 - new / gen, 4)
@@ -426,30 +525,113 @@ class ShardedTpuChecker(TpuChecker):
                                  qcap=qcap)
             chunk_fn = rebuild_chunk("grow")
 
-        dispatch()
+        def reseed() -> None:
+            # post-fault recovery: rebuild the sharded device state
+            # from the shadow — the pending frontier re-routes by owner
+            # on this mesh exactly like a checkpoint resume, the table
+            # re-seeds from the complete host mirror, and the chunk
+            # program recompiles. Set-semantics dedup makes the rebuilt
+            # run explore exactly the remaining graph.
+            nonlocal carry, chunk_fn, qcap, ecap, n_init, n_init_arr, \
+                base_unique, table_fps, preload
+            rows, ebs, fps = shadow.pending()
+            init_rows2 = [rows[i] for i in range(rows.shape[0])]
+            cache2 = [int(f) for f in fps]
+            if self._sound:
+                from ..fingerprint import fp64_node
+                frontier2 = [fp64_node(int(f), int(e))
+                             for f, e in zip(fps, ebs)]
+            else:
+                frontier2 = cache2
+            n_init = len(init_rows2)
+            table_fps = list(generated.keys())
+            base_unique = len(generated)
+            preload = len(table_fps)
+            while self._grow_at * (self._capacity // D) \
+                    <= headroom + preload:
+                self._capacity *= 4
+            init_by_shard2: List[List[int]] = [[] for _ in range(D)]
+            for fp in frontier2:
+                init_by_shard2[owner_of(fp, D)].append(fp)
+            self._init_by_shard = init_by_shard2
+            n_init_arr = np.asarray([len(b) for b in init_by_shard2],
+                                    np.int32)
+            qcap = self._sharded_qcap(
+                max((len(b) for b in init_by_shard2), default=0),
+                headroom, D)
+            if self._sound:
+                ecap = max(ecap, self._capacity)
+            with self._timed("seed"):
+                carry2 = seed_sharded_carry(
+                    model, mesh, axis, qcap, self._capacity, init_rows2,
+                    frontier2, np.asarray(ebs, np.uint32), prop_count,
+                    symmetry=self._symmetry, sound=self._sound,
+                    cache_fps=cache2, ecap=ecap)
+                key_hi, key_lo = self._sharded_bulk_insert(
+                    insert_fn, carry2.key_hi, carry2.key_lo, table_fps,
+                    D)
+                carry = carry2._replace(key_hi=key_hi, key_lo=key_lo)
+            seed_shadow_epoch(init_rows2, frontier2, ebs, cache2)
+            cur.update(q_head=np.zeros(D, np.int64),
+                       q_tail=n_init_arr.astype(np.int64),
+                       log_n=np.zeros(D, np.int64),
+                       e_n=np.zeros(D, np.int64))
+            kovf_pend[:] = [0, 0, 0]
+            chunk_fn = rebuild_chunk("retry")
+
+        fault_attempt = 0
+        recover_delay = None
         while True:
-            if pipeline and len(inflight) == 1:
+            try:
+                if recover_delay is not None:
+                    # back off before touching the mesh again; the
+                    # reseed runs inside the retry envelope, so a
+                    # still-dead backend burns another attempt
+                    if recover_delay > 0:
+                        time.sleep(recover_delay)
+                    recover_delay = None
+                    reseed()
                 dispatch()
-            acts = process(*inflight.popleft())
-            if not acts:
-                if not inflight:
+                while True:
+                    if pipeline and len(inflight) == 1:
+                        dispatch()
+                    acts = process(*inflight.popleft())
+                    if not acts:
+                        if not inflight:
+                            dispatch()
+                        continue
+                    # drain the speculative chunk before any host
+                    # intervention: under a device-visible stop
+                    # condition it ran zero iterations; past a host-only
+                    # exit it is one extra chunk of real (merged)
+                    # exploration
+                    while inflight:
+                        acts |= process(*inflight.popleft())
+                    if "kovf" in acts:
+                        handle_kovf()
+                    elif "done" in acts:
+                        break
+                    elif "grow" in acts:
+                        handle_grow()
+                    elif "egrow" in acts:
+                        handle_egrow()
                     dispatch()
-                continue
-            # drain the speculative chunk before any host intervention:
-            # under a device-visible stop condition it ran zero
-            # iterations; past a host-only exit it is one extra chunk of
-            # real (merged) exploration
-            while inflight:
-                acts |= process(*inflight.popleft())
-            if "kovf" in acts:
-                handle_kovf()
-            elif "done" in acts:
                 break
-            elif "grow" in acts:
-                handle_grow()
-            elif "egrow" in acts:
-                handle_egrow()
-            dispatch()
+            except BaseException as exc:
+                if (shadow is None
+                        or classify_error(exc) is not FaultKind.TRANSIENT):
+                    raise
+                inflight.clear()
+                if fault_attempt >= policy.retries:
+                    self._resilience_degrade(exc, shadow, discoveries)
+                fault_attempt += 1
+                recover_delay = policy.delay(fault_attempt)
+                self._metrics.inc("retries")
+                if self._trace:
+                    self._trace.emit(
+                        "retry", attempt=fault_attempt,
+                        delay=round(recover_delay, 3),
+                        error=f"{type(exc).__name__}: {exc}")
         q_head, q_tail = cur["q_head"], cur["q_tail"]
         log_n, e_n = cur["log_n"], cur["e_n"]
         if int(log_n.max()):
@@ -479,9 +661,15 @@ class ShardedTpuChecker(TpuChecker):
             # logs, cross edges from the per-shard edge logs) — the
             # sharded twin of TpuChecker._device_lasso_sweep
             with self._timed("lasso"):
-                self._sharded_lasso_sweep(carry, qcap, q_tail, log_n,
-                                          e_n, discoveries,
-                                          int(full_ebits))
+                if shadow is not None:
+                    # after a mid-run recovery the device logs cover
+                    # only the last epoch; the shadow spans the run
+                    self._shadow_lasso_sweep(shadow, int(full_ebits),
+                                             discoveries)
+                else:
+                    self._sharded_lasso_sweep(carry, qcap, q_tail,
+                                              log_n, e_n, discoveries,
+                                              int(full_ebits))
 
         if self._tpu_options.get("resumable"):
             # pull the pending per-shard frontiers eagerly so save()
@@ -497,7 +685,11 @@ class ShardedTpuChecker(TpuChecker):
             self._resume_frontier = (
                 pend[:, :width].copy(), pend[:, width].copy(),
                 _combine64(pend[:, width + 1], pend[:, width + 2]))
-        self._finalize_sharded(carry)
+        if shadow is not None:
+            # the shadow-maintained host mirror is already complete
+            self._mirror_carry = None
+        else:
+            self._finalize_sharded(carry)
         self._discovery_fps.update(discoveries)
         if self._visitor is not None:
             # same post-hoc visitation as the single-chip engine; the

@@ -169,13 +169,34 @@ class TestHostPropFnsGuard:
     def test_mismatched_fns_fail_loudly(self):
         # satellite: a subclass changing properties without updating the
         # packed fast-path evaluators must not silently use stale
-        # lambdas
+        # lambdas. The canonical form is name-keyed: an unknown name
+        # (e.g. a renamed property whose evaluator key was not updated)
+        # fails at spawn
         from stateright_tpu.examples.paxos_packed import PackedPaxos
 
         model = PackedPaxos(1)
-        model.host_property_fns = model.host_property_fns + [
-            lambda row: True]
+        assert isinstance(model.host_property_fns, dict)
+        model.host_property_fns = {**model.host_property_fns,
+                                   "bogus": lambda row: True}
         with pytest.raises(ValueError, match="host_property_fns"):
+            model.checker().tpu_options(race=False).spawn_tpu()
+
+    def test_legacy_positional_list_length_guard(self):
+        # the legacy positional-list form keeps the PR 2 length guard
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+
+        model = PackedPaxos(1)
+        model.host_property_fns = [lambda row: True, lambda row: True]
+        with pytest.raises(ValueError, match="host_property_fns"):
+            model.checker().tpu_options(race=False).spawn_tpu()
+
+    def test_name_keyed_fns_bind_by_name(self):
+        # a dict missing a declared host property also fails loudly
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+
+        model = PackedPaxos(1)
+        model.host_property_fns = {"wrong name": lambda row: True}
+        with pytest.raises(ValueError, match="missing"):
             model.checker().tpu_options(race=False).spawn_tpu()
 
 

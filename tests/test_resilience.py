@@ -1,0 +1,386 @@
+"""Resilience layer (checker/resilience.py + README § Resilience).
+
+A transient backend fault injected mid-run must change NOTHING the
+checker reports: discoveries, unique counts, and reached fingerprint
+sets are pinned against an uninterrupted run across the single-chip and
+sharded engines, pipelined and synchronous. Exhausted retries degrade
+instead of dying — an ``autosave=`` checkpoint loads and completes via
+``resume_from``; a raced run fails over to an un-budgeted host BFS; a
+hung chunk sync is converted to a classified fault by the watchdog —
+and ``bench.py`` always lands a valid JSON contract line, even with
+every device workload forced to fail.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.checker.resilience import (  # noqa: E402
+    CAPACITY_MARKERS, ChunkDeadlineError, FaultKind, RetryPolicy,
+    classify_error)
+from stateright_tpu.examples.paxos_packed import PackedPaxos  # noqa: E402
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _unavailable(msg="UNAVAILABLE: fake tunnel drop (injected)"):
+    return RuntimeError(msg)
+
+
+def _hook_at(k):
+    """Raise a fake transient backend fault when chunk ``k`` syncs."""
+
+    def hook(chunk):
+        if chunk == k:
+            raise _unavailable()
+
+    return hook
+
+
+def _run(mk, **opts):
+    return (mk().checker().tpu_options(race=False, **opts)
+            .spawn_tpu().join())
+
+
+def _mesh(n):
+    devices = jax.devices()
+    if len(devices) < n:
+        pytest.skip(f"need {n} devices, have {len(devices)}")
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices[:n]), ("shards",))
+
+
+def _assert_parity(faulty, clean):
+    assert faulty.unique_state_count() == clean.unique_state_count()
+    assert (faulty.generated_fingerprints()
+            == clean.generated_fingerprints())
+    assert set(faulty.discoveries()) == set(clean.discoveries())
+
+
+class TestClassification:
+    def test_transient_markers(self):
+        for msg in ("UNAVAILABLE: TPU backend setup/compile error",
+                    "DEADLINE_EXCEEDED: slice op",
+                    "connection reset by peer",
+                    "the tunnel collapsed"):
+            assert classify_error(RuntimeError(msg)) \
+                is FaultKind.TRANSIENT, msg
+        assert classify_error(ChunkDeadlineError("hung")) \
+            is FaultKind.TRANSIENT
+        assert classify_error(ConnectionResetError()) \
+            is FaultKind.TRANSIENT
+
+    def test_capacity_markers(self):
+        for msg in ("RESOURCE_EXHAUSTED: out of memory while trying",
+                    "device hash table overflow while seeding",
+                    "packed-state capacity overflow: ..."):
+            assert classify_error(RuntimeError(msg)) \
+                is FaultKind.CAPACITY, msg
+        # the engines' real overflow messages stay capacity-classified
+        for marker in CAPACITY_MARKERS:
+            assert classify_error(RuntimeError(marker)) \
+                is FaultKind.CAPACITY
+
+    def test_programming_default_and_cause_chain(self):
+        assert classify_error(ValueError("a model bug")) \
+            is FaultKind.PROGRAMMING
+        # a wrapper raised `from` a transient error keeps the class
+        # (the degrade path's RuntimeError must stay failover-eligible)
+        try:
+            try:
+                raise _unavailable()
+            except RuntimeError as inner:
+                raise RuntimeError("run failed after retries") from inner
+        except RuntimeError as wrapped:
+            assert classify_error(wrapped) is FaultKind.TRANSIENT
+
+    def test_retry_policy_bounds(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(retries=-1)
+        p = RetryPolicy(retries=3, backoff=1.0)
+        assert p.enabled
+        for attempt in (1, 2, 3, 8):
+            d = p.delay(attempt)
+            assert 0.0 < d <= p.cap * (1 + p.jitter)
+        assert RetryPolicy(retries=0).enabled is False
+        assert RetryPolicy(retries=2, backoff=0.0).delay(1) == 0.0
+
+
+class TestRetryParity:
+    """Acceptance: an injected transient UNAVAILABLE on chunk k leaves
+    discoveries and unique/generated fingerprint sets identical to the
+    uninterrupted run, with profile()['retries'] == 1."""
+
+    def test_single_chip_pipelined(self):
+        clean = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                     chunk_steps=2)
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, retries=2, backoff=0.0,
+                      fault_hook=_hook_at(2))
+        _assert_parity(faulty, clean)
+        assert faulty.profile()["retries"] == 1
+
+    def test_single_chip_sync(self):
+        clean = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                     chunk_steps=2, pipeline=False)
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, pipeline=False, retries=2,
+                      backoff=0.0, fault_hook=_hook_at(2))
+        _assert_parity(faulty, clean)
+        assert faulty.profile()["retries"] == 1
+
+    def test_sharded(self):
+        mesh = _mesh(2)
+        clean = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                     chunk_steps=2, mesh=mesh)
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, mesh=mesh, retries=2, backoff=0.0,
+                      fault_hook=_hook_at(2))
+        _assert_parity(faulty, clean)
+        assert faulty.profile()["retries"] == 1
+
+    def test_host_props_and_witness_paths(self):
+        # paxos: 'linearizable' is host-evaluated — the recovery must
+        # re-arm the in-carry history dedup and keep memoized results
+        clean = _run(lambda: PackedPaxos(1), capacity=1 << 12, fmax=64,
+                     chunk_steps=2)
+        faulty = _run(lambda: PackedPaxos(1), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, retries=2, backoff=0.0,
+                      fault_hook=_hook_at(2))
+        _assert_parity(faulty, clean)
+        faulty.assert_properties()
+
+    def test_mid_growth_recovery(self):
+        # a fault landing after table growths: the re-seeded table must
+        # re-insert the whole (grown) mirror
+        clean = _run(lambda: TwoPhaseSys(4), capacity=1 << 8, fmax=16,
+                     chunk_steps=2)
+        faulty = _run(lambda: TwoPhaseSys(4), capacity=1 << 8, fmax=16,
+                      chunk_steps=2, retries=2, backoff=0.0,
+                      fault_hook=_hook_at(3))
+        _assert_parity(faulty, clean)
+        assert faulty.profile()["retries"] == 1
+        assert clean.profile().get("grows", 0) > 0
+
+    def test_retry_trace_events(self):
+        trace = []
+        _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+             chunk_steps=2, retries=2, backoff=0.0,
+             fault_hook=_hook_at(2), trace=trace)
+        retries = [e for e in trace if e["ev"] == "retry"]
+        assert len(retries) == 1
+        assert retries[0]["attempt"] == 1
+        assert "UNAVAILABLE" in retries[0]["error"]
+        from stateright_tpu.obs import validate_event
+        for ev in trace:
+            validate_event(ev)
+
+    def test_sound_eventually_retry(self):
+        # the lasso sweep must rebuild from the shadow's cross-run edge
+        # records, not the (epoch-only) device logs
+        from stateright_tpu.core import Property
+        from stateright_tpu.models.fixtures import PackedDGraph
+
+        def cyc():
+            return (PackedDGraph.with_property(
+                Property.eventually("odd", lambda _, s: s % 2 == 1))
+                .with_path([0, 2, 4, 2]))
+
+        clean = (cyc().checker().sound_eventually()
+                 .tpu_options(race=False, capacity=1 << 10,
+                              chunk_steps=1).spawn_tpu().join())
+        assert "odd" in clean.discoveries()
+        faulty = (cyc().checker().sound_eventually()
+                  .tpu_options(race=False, capacity=1 << 10,
+                               chunk_steps=1, retries=2, backoff=0.0,
+                               fault_hook=_hook_at(2))
+                  .spawn_tpu().join())
+        assert "odd" in faulty.discoveries()
+        assert (faulty.generated_fingerprints()
+                == clean.generated_fingerprints())
+
+    def test_non_transient_faults_not_retried(self):
+        def hook(chunk):
+            if chunk == 2:
+                raise ValueError("a genuine model bug")
+
+        with pytest.raises(ValueError, match="model bug"):
+            _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                 chunk_steps=2, retries=2, backoff=0.0, fault_hook=hook)
+
+
+class TestAutosave:
+    def test_exhausted_retries_write_loadable_checkpoint(self, tmp_path):
+        path = tmp_path / "auto.npz"
+
+        def hook(chunk):
+            if chunk >= 2:
+                raise _unavailable()
+
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, retries=1, backoff=0.0,
+                           autosave=os.fspath(path), fault_hook=hook)
+              .spawn_tpu())
+        with pytest.raises(RuntimeError, match="resume_from"):
+            ck.join()
+        assert path.exists()
+        assert ck.profile()["retries"] == 1
+        assert ck.profile()["autosaves"] >= 1
+
+        clean = _run(lambda: TwoPhaseSys(3), capacity=1 << 12)
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12)
+                   .resume_from(path).spawn_tpu().join())
+        assert resumed.unique_state_count() == 288
+        assert (resumed.generated_fingerprints()
+                == clean.generated_fingerprints())
+
+    def test_periodic_autosave(self, tmp_path):
+        path = tmp_path / "periodic.npz"
+        trace = []
+        ck = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                  chunk_steps=2, autosave=os.fspath(path),
+                  autosave_interval=1, trace=trace)
+        assert ck.profile()["autosaves"] >= 1
+        assert path.exists()
+        saves = [e for e in trace if e["ev"] == "autosave"]
+        assert saves and all("path" in e and "unique" in e
+                             for e in saves)
+        # the final autosave resumes to the full reached set
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12)
+                   .resume_from(path).spawn_tpu().join())
+        assert (resumed.generated_fingerprints()
+                == ck.generated_fingerprints())
+
+    def test_degrade_without_autosave_names_the_knob(self):
+        def hook(chunk):
+            raise _unavailable()
+
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, retries=1,
+                           backoff=0.0, fault_hook=hook)
+              .spawn_tpu())
+        with pytest.raises(RuntimeError, match="autosave"):
+            ck.join()
+
+
+class TestWatchdog:
+    def test_stalled_sync_becomes_classified_fault(self):
+        # the hook stalls one chunk's sync well past the deadline: the
+        # watchdog must convert the hang into a transient fault the
+        # retry loop recovers from
+        def hook(chunk):
+            if chunk == 2:
+                time.sleep(5.0)
+
+        trace = []
+        clean = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                     chunk_steps=2)
+        ck = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                  chunk_steps=2, retries=2, backoff=0.0,
+                  chunk_deadline=0.3, fault_hook=hook, trace=trace)
+        _assert_parity(ck, clean)
+        assert ck.profile()["retries"] >= 1
+        evs = {e["ev"] for e in trace}
+        assert "watchdog" in evs and "retry" in evs
+
+    def test_invalid_deadline_rejected(self):
+        with pytest.raises(ValueError, match="chunk_deadline"):
+            (TwoPhaseSys(3).checker()
+             .tpu_options(race=False, chunk_deadline=0).spawn_tpu())
+
+
+class TestFailover:
+    def test_raced_transient_failure_falls_over_to_host(self):
+        # race budget 0 retires the budgeted racer immediately; the
+        # device dies with a transient fault; the un-budgeted host BFS
+        # fallback must still answer the check
+        def hook(chunk):
+            raise _unavailable("UNAVAILABLE: permanent tunnel death")
+
+        ck = (TwoPhaseSys(4).checker()
+              .tpu_options(capacity=1 << 12, race_budget=0.0,
+                           fault_hook=hook)
+              .spawn_tpu().join())
+        host = TwoPhaseSys(4).checker().spawn_bfs().join()
+        assert ck.unique_state_count() == host.unique_state_count()
+        assert (ck.generated_fingerprints()
+                == host.generated_fingerprints())
+        prof = ck.profile()
+        assert prof["engine"] == "host"
+        assert prof["failovers"] == 1
+        ck.assert_properties()
+
+    def test_programming_error_still_surfaces(self):
+        # TwoPhaseSys(4): big enough that the budgeted racer cannot
+        # finish before the decider's first tick retires it — the
+        # device's programming error must surface, not fail over
+        def hook(chunk):
+            raise ValueError("a genuine model bug")
+
+        ck = (TwoPhaseSys(4).checker()
+              .tpu_options(capacity=1 << 12, race_budget=0.0,
+                           fault_hook=hook)
+              .spawn_tpu())
+        with pytest.raises(ValueError, match="model bug"):
+            ck.join()
+
+    def test_failover_opt_out(self):
+        def hook(chunk):
+            raise _unavailable()
+
+        ck = (TwoPhaseSys(4).checker()
+              .tpu_options(capacity=1 << 12, race_budget=0.0,
+                           failover=False, fault_hook=hook)
+              .spawn_tpu())
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            ck.join()
+
+
+def _run_bench(*flags):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         *flags],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+
+
+@pytest.mark.slow
+class TestBenchContract:
+    """bench.py must ALWAYS land a valid JSON contract line on stdout
+    and exit 0 — the round-5 failure mode (rc=1, parsed=null) is
+    pinned out in both the healthy and the all-device-workloads-dead
+    shapes."""
+
+    def test_smoke_contract_schema(self):
+        proc = _run_bench()
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        payload = json.loads(line)
+        for key in ("metric", "value", "unit", "vs_baseline", "backend",
+                    "pipeline"):
+            assert key in payload, key
+        assert set(payload["pipeline"]) == {"on", "off"}
+        assert payload["value"] is not None
+        assert "partial" not in payload
+
+    def test_forced_failure_still_lands_artifact(self):
+        proc = _run_bench("--inject-fault")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        payload = json.loads(line)
+        assert payload["partial"] is True
+        assert isinstance(payload["failed"], list) and payload["failed"]
+        assert "device-pipelined" in payload["failed"]

@@ -12,7 +12,10 @@ found in the trace:
   * a chunk/level timeline in ~12 buckets — unique-states rate, dedup
     hit-rate, table load factor, queue depth — the view that makes a
     pipeline stall or a growth storm visible after the fact;
-  * interventions (grow/hgrow/egrow/kovf/compile) with timestamps;
+  * interventions (grow/hgrow/egrow/kovf/compile, plus the resilience
+    layer's retry/watchdog/autosave/failover events) with timestamps —
+    on a flaky round this table says *where* the tunnel dropped, what
+    the engine did about it, and whether an autosave landed;
   * discoveries and the final counts.
 
 ``--validate`` additionally schema-checks every event and exits
@@ -130,7 +133,8 @@ def report(events, out=sys.stdout):
             chunk_timeline(progress, out)
 
         inters = [e for e in evs if e["ev"] in
-                  ("grow", "hgrow", "egrow", "kovf", "compile")]
+                  ("grow", "hgrow", "egrow", "kovf", "compile",
+                   "retry", "watchdog", "autosave", "failover")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
