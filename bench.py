@@ -19,7 +19,11 @@ guarantee an artifact lands no matter what the backend does:
   exception aborted the whole block);
 * the stdout contract line is emitted from a ``finally`` path: when
   anything failed it carries ``"partial": true`` and the non-empty
-  ``"failed"`` list, and the process still exits 0.
+  ``"failed"`` list, and the process still exits 0;
+* a primary-metric run that finished on a DEGRADED mesh (the
+  resilience ladder dropped chips mid-run — README § Resilience) tags
+  the line with ``"degraded": true`` and ``"final_shards"``, so the
+  perf trajectory can't silently mix rates measured on fewer chips.
 
 A host whose TPU backend cannot initialize falls back to
 ``JAX_PLATFORMS=cpu`` (smaller caps, context matrix skipped).
@@ -63,6 +67,13 @@ INJECT_FAULT = False
 #: workload names that failed this run (the contract line's "failed")
 FAILED: list = []
 
+#: degradation-ladder bookkeeping for the PRIMARY metric: a run that
+#: lost chips mid-flight and finished on a smaller mesh is tagged in
+#: the stdout contract line ("degraded": true + the final mesh size),
+#: so the perf trajectory can never be silently polluted by rates
+#: measured on fewer chips than the round claims
+DEGRADED: dict = {"any": False, "final_shards": None}
+
 
 def _median(xs):
     s = sorted(xs)
@@ -103,10 +114,12 @@ def _compact_metrics(ck):
     prof = ck.profile()
     m = {}
     for k in ("chunks", "levels", "grows", "hgrows", "kovfs",
-              "compiles", "retries", "failovers", "autosaves",
-              "engine", "shard_balance"):
+              "compiles", "retries", "failovers", "degrades",
+              "autosaves", "engine", "shard_balance", "mesh_shards"):
         if prof.get(k):
             m[k] = prof[k]
+    if prof.get("fault_device") is not None:  # device 0 is falsy
+        m["fault_device"] = prof["fault_device"]
     search = prof.get("search")
     if search:
         for k, label in (("sync_stall", "stall_frac"),
@@ -155,6 +168,17 @@ def _sampled(name, mk, value=None, unit="uniq/s", warmups=2,
         row.update(extra_fn(ck))
     print(json.dumps(row), file=sys.stderr)
     return best
+
+
+def _note_degraded(ck) -> dict:
+    """Primary-metric guard: record when a sample finished on a
+    degraded mesh (the ladder dropped chips mid-run), for the stdout
+    contract line."""
+    prof = ck.profile()
+    if prof.get("degrades"):
+        DEGRADED["any"] = True
+        DEGRADED["final_shards"] = int(prof.get("mesh_shards") or 1)
+    return {}
 
 
 def _ensure_backend() -> str:
@@ -206,6 +230,9 @@ def main() -> None:
         if FAILED:
             contract["partial"] = True
             contract["failed"] = FAILED
+        if DEGRADED["any"]:
+            contract["degraded"] = True
+            contract["final_shards"] = DEGRADED["final_shards"]
         print(json.dumps(contract))
 
 
@@ -250,11 +277,12 @@ def _run_workloads(contract: dict) -> None:
     tpu_rate = _guarded(
         "device-pipelined",
         lambda: _sampled(f"tpu paxos3 capped {cap} pipelined",
-                         device_run))
+                         device_run, extra_fn=_note_degraded))
     sync_rate = _guarded(
         "device-sync",
         lambda: _sampled(f"tpu paxos3 capped {cap} sync",
-                         lambda: device_run(pipeline=False)))
+                         lambda: device_run(pipeline=False),
+                         extra_fn=_note_degraded))
 
     if tpu_rate is not None:
         contract["value"] = round(tpu_rate, 1)

@@ -108,8 +108,15 @@ class CheckerBuilder:
           into a classified transient fault (watchdog);
           ``autosave=path`` + ``autosave_interval=chunks`` checkpoint
           progress periodically and on exhausted retries (resume via
-          ``resume_from``); ``failover=False`` opts a raced run out of
-          the device->host fallback."""
+          ``resume_from``); ``retry_seed=n`` pins the backoff jitter
+          to a private RNG stream (deterministic fault tests);
+          ``degrade=True`` (default) + ``min_mesh=1`` gate the mesh
+          degradation ladder — a sharded run that exhausts its retries
+          (or whose faults pin on one chip) re-routes the pending
+          frontier onto the surviving power-of-two device subset,
+          D -> D/2 -> ... -> single chip, before any host fallback;
+          ``failover=False`` opts a raced run out of the final
+          device->host rung."""
         self.tpu_options_.update(options)
         return self
 
@@ -148,16 +155,18 @@ class CheckerBuilder:
         With ``tpu_options(mesh=jax.sharding.Mesh(...))`` the search runs
         SPMD over the mesh: frontier, visited table and logs sharded by
         fingerprint prefix, children routed to owner shards over ICI."""
-        if "mesh" in self.tpu_options_:
-            from ..parallel.engine import ShardedTpuChecker
-            return ShardedTpuChecker(self)
         from .race import RacingChecker, race_eligible
         if race_eligible(self):
             # small-model latency: the device engine's fixed dispatch +
             # tunnel-sync costs dwarf tiny models, so a budgeted host BFS
             # races the device run and the first finisher wins (see
-            # checker/race.py); tpu_options(race=False) opts out
+            # checker/race.py); tpu_options(race=False) opts out. Mesh
+            # runs race only on explicit race=True (the device lane is
+            # then the sharded engine).
             return RacingChecker(self)
+        if "mesh" in self.tpu_options_:
+            from ..parallel.engine import ShardedTpuChecker
+            return ShardedTpuChecker(self)
         from .tpu import TpuChecker
         return TpuChecker(self)
 
@@ -213,9 +222,16 @@ class Checker:
             parts.append(f"engine={prof['engine']}")
         for key in ("chunks", "levels", "jobs", "grows", "hgrows",
                     "kovfs", "compiles", "retries", "failovers",
-                    "autosaves"):
+                    "degrades", "autosaves"):
             if prof.get(key):
                 parts.append(f"{key}={int(prof[key])}")
+        if prof.get("degrades"):
+            # a degraded run finished on fewer chips; name the final
+            # width and the blamed device so the line says WHY
+            if "mesh_shards" in prof:
+                parts.append(f"mesh={int(prof['mesh_shards'])}")
+            if "fault_device" in prof:
+                parts.append(f"fault_device={int(prof['fault_device'])}")
         if elapsed > 0 and "sync_stall" in prof:
             parts.append(f"stall={prof['sync_stall'] / elapsed:.0%}")
         if elapsed > 0 and "host_overlap" in prof:

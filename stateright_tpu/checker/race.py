@@ -27,10 +27,15 @@ first:
     multithreaded runs.
 
 Racing is skipped (pure device engine) whenever the run needs a
-device-only or engine-specific feature: a mesh, a visitor, symmetry
-reduction, `sound_eventually`, checkpoint resume/resumable, an explicit
+device-only or engine-specific feature: a visitor, symmetry reduction,
+`sound_eventually`, checkpoint resume/resumable, an explicit
 `tpu_options(mode=...)`, or `tpu_options(race=False)` (the Explorer
-disables it to introspect the device checker).
+disables it to introspect the device checker). A mesh run races only
+on explicit `race=True` — its device lane is the sharded engine, and
+the resilience order is ladder-first: a transient device death
+degrades the mesh (D -> D/2 -> single chip, `checker/resilience.py`)
+INSIDE the device engine; the un-budgeted host-BFS failover below only
+fires once the ladder itself is exhausted.
 """
 
 from __future__ import annotations
@@ -75,8 +80,12 @@ def _retire(checker) -> None:
 
 def race_eligible(builder: CheckerBuilder) -> bool:
     opts = builder.tpu_options_
+    # a mesh run only races on explicit race=True: small models never
+    # pick a mesh, so the default keeps sharded runs un-raced — but an
+    # opted-in raced mesh gets the full degradation ladder (the engine
+    # re-shards onto surviving chips) BEFORE the host-BFS failover rung
     return (opts.get("race", True)
-            and "mesh" not in opts
+            and ("mesh" not in opts or opts.get("race") is True)
             and "mode" not in opts
             and not opts.get("resumable")
             and builder.visitor_ is None
@@ -103,7 +112,14 @@ class RacingChecker(Checker):
         budget = builder.tpu_options_.get("race_budget")
         if budget is not None:
             self.HOST_BUDGET_S = float(budget)
-        self._tpu = TpuChecker(builder)
+        if "mesh" in builder.tpu_options_:
+            # explicit race=True on a mesh run (race_eligible): the
+            # device lane is the sharded engine, whose degradation
+            # ladder runs BEFORE the failover rung below ever applies
+            from ..parallel.engine import ShardedTpuChecker
+            self._tpu = ShardedTpuChecker(builder)
+        else:
+            self._tpu = TpuChecker(builder)
         try:
             self._host = BfsChecker(builder)
         except Exception:
@@ -184,8 +200,12 @@ class RacingChecker(Checker):
     def _spawn_fallback(self, tpu):
         """Start the un-budgeted host BFS after a transient device
         failure (``tpu_options(failover=False)`` opts out); returns the
-        running checker, or ``None`` when failover does not apply."""
-        from .resilience import FaultKind, classify_error
+        running checker, or ``None`` when failover does not apply.
+        This is the LAST resilience rung: the device engine's own
+        degradation ladder (retry -> re-shard onto surviving chips ->
+        single chip) has already run inside ``tpu`` by the time its
+        error surfaces here."""
+        from .resilience import FaultKind, blamed_device, classify_error
 
         err = tpu._error
         if (err is None
@@ -201,7 +221,8 @@ class RacingChecker(Checker):
         self._failover = True
         if tpu._trace:
             tpu._trace.emit("failover", to="host-bfs",
-                            error=f"{type(err).__name__}: {err}")
+                            error=f"{type(err).__name__}: {err}",
+                            device=blamed_device(err))
         host._start_background()
         return host
 

@@ -29,6 +29,16 @@ atomic tmp+``os.replace`` write as ``Checker.save``
 flows through the obs layer (``retries``/``failovers``/``autosaves``
 metric keys, matching trace events).
 
+Past the retry budget, a sharded run does not die: the **degradation
+ladder** (:class:`DegradePolicy`, ``tpu_options(degrade=True,
+min_mesh=1)``) halves the mesh onto the surviving power-of-two device
+subset — excluding the chip :func:`blamed_device` names, and jumping
+the rest of the budget when :class:`FaultAttributor` pins consecutive
+faults on one chip — re-routes the shadow's pending frontier by
+``owner_of(fp, D/2)``, and resumes; the final rung hands the shadow to
+the single-chip device loop. Host BFS (a raced run's failover) and the
+checkpoint-and-raise ending are only reached below ``min_mesh``.
+
 :class:`HostShadow` is the piece that makes retry *possible*: with
 resilience enabled the host keeps an authoritative copy of everything
 needed to rebuild the device state — the (fingerprint -> parent)
@@ -45,6 +55,7 @@ from __future__ import annotations
 import enum
 import os
 import random
+import re
 import tempfile
 import threading
 from typing import Dict, List, Optional
@@ -140,13 +151,17 @@ class RetryPolicy:
     before degrading). ``backoff`` is the first delay in seconds; each
     further consecutive attempt doubles it (capped) with +/-25% jitter
     so a fleet of runs sharing one recovering backend does not
-    stampede it.
+    stampede it. ``seed`` (``tpu_options(retry_seed=...)``) pins the
+    jitter to a private ``random.Random`` stream so fault-injection
+    tests are deterministic across ``PYTHONHASHSEED`` and reruns; the
+    default draws from the global RNG (fleet-level decorrelation).
     """
 
-    __slots__ = ("retries", "backoff", "cap", "jitter")
+    __slots__ = ("retries", "backoff", "cap", "jitter", "_rng")
 
     def __init__(self, retries: int = 0, backoff: float = 1.0,
-                 cap: float = 30.0, jitter: float = 0.25):
+                 cap: float = 30.0, jitter: float = 0.25,
+                 seed: Optional[int] = None):
         if retries < 0:
             raise ValueError("tpu_options(retries=...) must be >= 0")
         if backoff < 0:
@@ -155,11 +170,14 @@ class RetryPolicy:
         self.backoff = float(backoff)
         self.cap = float(cap)
         self.jitter = float(jitter)
+        self._rng = random if seed is None else random.Random(seed)
 
     @classmethod
     def from_options(cls, opts: dict) -> "RetryPolicy":
+        seed = opts.get("retry_seed")
         return cls(retries=int(opts.get("retries", 0)),
-                   backoff=float(opts.get("backoff", 1.0)))
+                   backoff=float(opts.get("backoff", 1.0)),
+                   seed=None if seed is None else int(seed))
 
     @property
     def enabled(self) -> bool:
@@ -170,7 +188,113 @@ class RetryPolicy:
         if self.backoff <= 0:
             return 0.0
         base = min(self.backoff * (2.0 ** (attempt - 1)), self.cap)
-        return base * (1.0 + self.jitter * (2.0 * random.random() - 1.0))
+        return base * (1.0 + self.jitter * (2.0 * self._rng.random() - 1.0))
+
+
+# ----------------------------------------------------------------------
+# per-device fault attribution + the mesh degradation ladder
+# ----------------------------------------------------------------------
+#: message patterns naming the chip a backend error came from. PJRT
+#: status strings usually carry one ("device 3", "TPU_2 heartbeat
+#: lost", ...); the injected test faults use the same phrasing.
+_DEVICE_PATTERNS = tuple(re.compile(p) for p in (
+    r"\bdevice[ _#:]+(\d+)",
+    r"\btpu[_ :](\d+)\b",
+    r"\bchip[ _#:]+(\d+)",
+    r"\bshard[ _#:]+(\d+)",
+))
+
+
+def blamed_device(exc: BaseException) -> Optional[int]:
+    """The device index a fault names, or ``None`` when the error is
+    not attributable to one chip. Walks the cause chain like
+    :func:`classify_error`; an explicit integer ``device_index``
+    attribute on any link wins over message parsing."""
+    seen: set = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        idx = getattr(e, "device_index", None)
+        if isinstance(idx, int) and idx >= 0:
+            return idx
+        msg = f"{type(e).__name__}: {e}".lower()
+        for pat in _DEVICE_PATTERNS:
+            m = pat.search(msg)
+            if m:
+                return int(m.group(1))
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return None
+
+
+class FaultAttributor:
+    """Consecutive per-device fault attribution across a run.
+
+    ``note(device)`` records one classified transient fault; it returns
+    ``True`` when the same chip has been blamed ``blame_after`` times
+    in a row — a repeat offender the ladder drops *without* burning the
+    rest of the retry budget on it (re-seeding a mesh whose one bad
+    chip raises every attempt is pure waste). A successful chunk sync
+    or a taken rung calls :meth:`clear` (the streak is consecutive,
+    like the retry budget); lifetime per-device totals survive for
+    postmortems."""
+
+    __slots__ = ("blame_after", "totals", "_last", "_streak")
+
+    def __init__(self, blame_after: int = 2):
+        self.blame_after = max(1, int(blame_after))
+        self.totals: Dict[int, int] = {}
+        self._last: Optional[int] = None
+        self._streak = 0
+
+    def note(self, device: Optional[int]) -> bool:
+        if device is None:
+            self._last, self._streak = None, 0
+            return False
+        self.totals[device] = self.totals.get(device, 0) + 1
+        if device == self._last:
+            self._streak += 1
+        else:
+            self._last, self._streak = device, 1
+        return self._streak >= self.blame_after
+
+    def clear(self) -> None:
+        self._last, self._streak = None, 0
+
+
+class DegradePolicy:
+    """The mesh degradation ladder (README § Resilience).
+
+    When the sharded engine exhausts its :class:`RetryPolicy` on a
+    transient fault — or :class:`FaultAttributor` pins repeated faults
+    on one chip — it re-routes the shadow's pending frontier by
+    ``owner_of(fp, D/2)`` onto the surviving power-of-two device
+    subset (excluding the blamed chip when known), rebuilds the
+    sharded carry, recompiles for the smaller mesh, and resumes:
+    D -> D/2 -> ... -> ``min_mesh``. The final single-chip rung runs
+    the plain device loop (``TpuChecker._run_device``) seeded from the
+    shadow handoff. Only below ``min_mesh`` does the run take the old
+    endings (checkpoint-and-raise, or a raced run's host-BFS
+    failover). ``tpu_options(degrade=False)`` opts out; ``min_mesh``
+    must be a power of two >= 1."""
+
+    __slots__ = ("enabled", "min_mesh", "blame_after")
+
+    def __init__(self, enabled: bool = True, min_mesh: int = 1,
+                 blame_after: int = 2):
+        min_mesh = int(min_mesh)
+        if min_mesh < 1 or (min_mesh & (min_mesh - 1)):
+            raise ValueError(
+                "tpu_options(min_mesh=...) must be a power of two >= 1 "
+                "(the mesh halves rung by rung)")
+        self.enabled = bool(enabled)
+        self.min_mesh = min_mesh
+        self.blame_after = max(1, int(blame_after))
+
+    @classmethod
+    def from_options(cls, opts: dict) -> "DegradePolicy":
+        return cls(enabled=bool(opts.get("degrade", True)),
+                   min_mesh=int(opts.get("min_mesh", 1)),
+                   blame_after=int(opts.get("blame_after", 2)))
 
 
 # ----------------------------------------------------------------------
@@ -346,6 +470,36 @@ class HostShadow:
             self._edges[s].append(np.asarray(elog_new, np.uint32))
             self.e_n[s] += len(elog_new)
         self._heads[s] = int(q_head)
+
+    def reshard(self, shards: int) -> None:
+        """Re-partition for a new mesh width (the degradation ladder).
+
+        The live pending frontier is preserved — concatenated into the
+        first slot so :meth:`pending` keeps answering until the caller
+        re-routes it and starts the next epoch with :meth:`seed_epoch`.
+        The cumulative insert/edge records just re-bucket (the lasso
+        sweep merges across shards anyway); roots and the shared
+        mirror dicts are untouched."""
+        live = [self._epoch_rows(s)[self._heads[s]:self._tails[s]]
+                for s in range(self.shards)]
+        live_rows = (np.concatenate(live) if live
+                     else np.zeros((0, self.width + 3), np.uint32))
+        old_inserts, old_edges = self._inserts, self._edges
+        self.shards = shards
+        self._inserts = [[] for _ in range(shards)]
+        self._edges = [[] for _ in range(shards)]
+        for s, parts in enumerate(old_inserts):
+            self._inserts[s % shards].extend(parts)
+        for s, parts in enumerate(old_edges):
+            self._edges[s % shards].extend(parts)
+        self._epoch_q = [[] for _ in range(shards)]
+        self._heads = [0] * shards
+        self._tails = [0] * shards
+        if len(live_rows):
+            self._epoch_q[0] = [live_rows]
+            self._tails[0] = len(live_rows)
+        self.log_n = [0] * shards
+        self.e_n = [0] * shards
 
     # ------------------------------------------------------------------
     def _epoch_rows(self, s: int) -> np.ndarray:

@@ -326,9 +326,30 @@ class TpuChecker(HostChecker):
         self._host_fns = self._resolve_host_fns(
             getattr(model, "host_property_fns", None))
         # --- resilience knobs (checker/resilience.py) ------------------
-        from .resilience import RetryPolicy
+        from .resilience import DegradePolicy, RetryPolicy
         self._retry_policy = RetryPolicy.from_options(opts)
+        self._degrade_policy = DegradePolicy.from_options(opts)
         self._fault_hook = opts.get("fault_hook")
+        # legacy hooks take (chunk); two-parameter hooks also receive
+        # the current mesh width, so an injected "permanent" device
+        # fault can stop firing once the ladder drops the dead chip
+        self._fault_hook_arity = 1
+        if self._fault_hook is not None:
+            import inspect
+            try:
+                self._fault_hook_arity = len(
+                    inspect.signature(self._fault_hook).parameters)
+            except (TypeError, ValueError):
+                pass
+        #: mesh width the fault hooks/watchdog report (the sharded
+        #: engine maintains it down the ladder; 1 on the plain loop)
+        self._fault_shards = 1
+        # degraded-mesh handoff (parallel/engine.py ladder -> the
+        # single-chip rung): pending frontier + discoveries, and the
+        # run-spanning shadow _make_shadow re-adopts
+        self._handoff = None
+        self._handoff_shadow = None
+        self._handoff_device = None
         self._chunk_deadline = opts.get("chunk_deadline")
         if self._chunk_deadline is not None \
                 and float(self._chunk_deadline) <= 0:
@@ -437,10 +458,18 @@ class TpuChecker(HostChecker):
     # --- resilience plumbing (checker/resilience.py) -------------------
     def _make_shadow(self, shards: int):
         """The host-side authoritative state, maintained per chunk when
-        retry or autosave is on (``None`` otherwise — zero cost)."""
+        retry or autosave is on (``None`` otherwise — zero cost). A
+        degraded-mesh handoff re-adopts the run-spanning shadow (its
+        cumulative insert/edge records feed the sound-mode lasso sweep
+        across every epoch and rung) instead of starting a fresh one."""
         if not (self._retry_policy.enabled
                 or self._autosave_path is not None):
             return None
+        adopted = self._handoff_shadow
+        if adopted is not None:
+            self._handoff_shadow = None
+            adopted.reshard(shards)
+            return adopted
         from .resilience import HostShadow
         return HostShadow(shards, self._model.packed_width,
                           self._generated, self._orig_of,
@@ -455,10 +484,14 @@ class TpuChecker(HostChecker):
         import jax
 
         hook = self._fault_hook
+        shards = int(self._fault_shards)
 
         def pull():
             if hook is not None:
-                hook(ordinal)
+                if self._fault_hook_arity >= 2:
+                    hook(ordinal, shards)
+                else:
+                    hook(ordinal)
             return np.asarray(jax.device_get(stats_d))
 
         deadline = self._chunk_deadline
@@ -470,8 +503,10 @@ class TpuChecker(HostChecker):
                                       what=f"chunk {ordinal} sync")
         except ChunkDeadlineError:
             if self._trace:
+                # the hung transfer cannot name its chip; the mesh
+                # width at least scopes the postmortem
                 self._trace.emit("watchdog", deadline=float(deadline),
-                                 chunk=ordinal)
+                                 chunk=ordinal, shards=shards)
             raise
 
     def _checkpoint_save(self, path, rows, ebits, ffps,
@@ -525,7 +560,8 @@ class TpuChecker(HostChecker):
 
     def _resilience_degrade(self, exc: BaseException, shadow,
                             discoveries: Dict[str, object]) -> None:
-        """Exhausted retries: land an artifact instead of just dying —
+        """Retries exhausted below the ladder's ``min_mesh`` (or with
+        ``degrade=False``): land an artifact instead of just dying —
         write the autosave checkpoint (when configured) and raise ONE
         actionable error naming the resume command."""
         if self._autosave_path is not None:
@@ -729,9 +765,19 @@ class TpuChecker(HostChecker):
         insert_fn = _insert_jit()
 
         # --- seed -------------------------------------------------------
+        self._fault_shards = 1
+        handoff = self._handoff
         if self._resume_path is not None:
             init_rows, seed_ebits, seed_fps = self._load_checkpoint(
                 discoveries)
+        elif handoff is not None:
+            # degraded-mesh handoff (the ladder's single-chip rung):
+            # the shadow's pending frontier becomes the seed; the
+            # mirrored reached set is already in self._generated, and
+            # the prior rungs' discoveries carry over
+            self._handoff = None
+            init_rows, seed_ebits, seed_fps, prior = handoff
+            discoveries.update(prior)
         else:
             init_rows = self._seed_inits()
             seed_ebits = full_ebits
@@ -785,9 +831,11 @@ class TpuChecker(HostChecker):
             # slow the whole chunk loop ~2.5x on the tunneled device
             # the queue's cached fingerprints are canonical STATE fps
             # (sound mode dedups on node keys but re-derives them from
-            # these); on resume the rows' own fps were recomputed
+            # these); on resume (and on a degraded-mesh handoff) the
+            # frontier rows carry their own recomputed fps
             cache_fps = (self._seed_cache_fps
-                         if self._resume_path is None else seed_fps)
+                         if self._resume_path is None and handoff is None
+                         else seed_fps)
             # the table is empty, so small seeds (the fresh-run case) are
             # placed by a host plan scattered INSIDE the seed program —
             # zero extra dispatches (a standalone table_insert dispatch,
@@ -838,8 +886,8 @@ class TpuChecker(HostChecker):
         # shadow (mirror + pending frontier + sound-mode edge records),
         # updated per chunk; a transient backend fault re-seeds a fresh
         # device incarnation from it and resumes
-        from .resilience import (FaultKind, classify_error, gather_rows,
-                                 pack_qrows)
+        from .resilience import (FaultKind, blamed_device, classify_error,
+                                 gather_rows, pack_qrows)
 
         policy = self._retry_policy
         shadow = self._make_shadow(1)
@@ -1254,6 +1302,9 @@ class TpuChecker(HostChecker):
                 # re-seed, resume. Capacity and programming errors
                 # re-raise above: retrying reproduces them.
                 inflight.clear()
+                blamed = blamed_device(exc)
+                if blamed is not None:
+                    self._metrics.set("fault_device", blamed)
                 if fault_attempt >= policy.retries:
                     self._resilience_degrade(exc, shadow, discoveries)
                 fault_attempt += 1
@@ -1263,7 +1314,8 @@ class TpuChecker(HostChecker):
                     self._trace.emit(
                         "retry", attempt=fault_attempt,
                         delay=round(recover_delay, 3),
-                        error=f"{type(exc).__name__}: {exc}")
+                        error=f"{type(exc).__name__}: {exc}",
+                        device=blamed)
         q_size = cur["q_size"]
         q_tail, log_n, e_n = cur["q_tail"], cur["log_n"], cur["e_n"]
 

@@ -70,7 +70,13 @@ GLOSSARY: Dict[str, str] = {
                "bounded per consecutive burst by "
                "tpu_options(retries=N))",
     "failovers": "raced runs adopted by the un-budgeted host BFS "
-                 "fallback after a transient device failure",
+                 "fallback after a transient device failure (the rung "
+                 "BELOW the degradation ladder)",
+    "degrades": "mesh degradation rungs taken: exhausted retries (or "
+                "per-device fault attribution) re-shard the run onto "
+                "the surviving power-of-two device subset, D -> D/2 "
+                "-> ... -> single chip "
+                "(tpu_options(degrade=, min_mesh=))",
     "autosaves": "resilience checkpoints written (periodic "
                  "tpu_options(autosave=...) snapshots plus the "
                  "exhausted-retries write)",
@@ -85,6 +91,13 @@ GLOSSARY: Dict[str, str] = {
     # --- gauges --------------------------------------------------------
     "shard_balance": "end-of-run min/max ratio of per-shard inserted "
                      "states (1.0 = perfectly balanced routing)",
+    "mesh_shards": "current mesh width of a sharded run (drops rung "
+                   "by rung under the degradation ladder; the final "
+                   "value is the width the run FINISHED on)",
+    "fault_device": "device index the most recent transient fault was "
+                    "attributed to (blamed_device: an explicit "
+                    "device_index attribute or the chip named in the "
+                    "error message)",
     "engine": "race winner tag on a raced spawn_tpu profile: 'host' "
               "or 'device'",
     # --- host search timers -------------------------------------------

@@ -72,11 +72,17 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # resilience layer (checker/resilience.py): a transient-fault
     # recovery (re-seed + resume), a hung chunk sync converted to a
     # classified fault by the watchdog, a checkpoint autosave, and a
-    # raced run falling over to the un-budgeted host BFS
+    # raced run falling over to the un-budgeted host BFS. retry and
+    # failover carry an optional `device` (the blamed chip index, None
+    # when unattributable) and retry/watchdog a `shards` mesh width,
+    # so postmortems name the chip, not just the attempt count
     "retry": frozenset({"attempt", "delay", "error"}),
     "watchdog": frozenset({"deadline"}),
     "autosave": frozenset({"path", "unique"}),
     "failover": frozenset({"to", "error"}),
+    # a degradation-ladder rung: the mesh halved onto the surviving
+    # device subset (optional fields: the blamed device, the error)
+    "degrade": frozenset({"from_shards", "to_shards"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

@@ -228,12 +228,19 @@ class ShardedTpuChecker(TpuChecker):
         # autosave on, the host shadow is maintained per chunk (per
         # shard), and a transient fault re-seeds a fresh sharded carry
         # from it, re-routing the pending frontier by owner exactly
-        # like a checkpoint resume
-        from ..checker.resilience import (FaultKind, classify_error,
+        # like a checkpoint resume. Past the retry budget the
+        # DEGRADATION LADDER takes over (degrade_step below): the mesh
+        # halves onto the surviving device subset instead of dying.
+        from ..checker.resilience import (FaultAttributor, FaultKind,
+                                          blamed_device, classify_error,
                                           gather_rows, pack_qrows)
 
         policy = self._retry_policy
+        ladder = self._degrade_policy
+        attributor = FaultAttributor(ladder.blame_after)
         shadow = self._make_shadow(D)
+        self._fault_shards = D
+        self._metrics.set("mesh_shards", D)
 
         def seed_shadow_epoch(rows_list, frontier_keys, ebs_arr,
                               cache_list) -> None:
@@ -301,8 +308,10 @@ class ShardedTpuChecker(TpuChecker):
                 # — routed through the fault hook + watchdog deadline
                 stats = self._materialize_stats(stats_d, ordinal)
             # a successful sync proves the backend is alive; the retry
-            # budget bounds CONSECUTIVE faults
+            # budget (and the per-device blame streak) bounds
+            # CONSECUTIVE faults
             fault_attempt = 0
+            attributor.clear()
             t0 = time.perf_counter()
             acts: set = set()
             q_head = stats[:D].astype(np.int64)
@@ -440,7 +449,8 @@ class ShardedTpuChecker(TpuChecker):
             if (int((q_tail - q_head).sum()) == 0
                     or len(discoveries) == prop_count
                     or (target is not None
-                        and self._state_count >= target)):
+                        and self._state_count >= target)
+                    or self._cancel_event.is_set()):
                 acts.add("done")
                 return acts
             need_grow = (int(log_n.max()) >= grow_limit
@@ -577,10 +587,67 @@ class ShardedTpuChecker(TpuChecker):
                        log_n=np.zeros(D, np.int64),
                        e_n=np.zeros(D, np.int64))
             kovf_pend[:] = [0, 0, 0]
-            chunk_fn = rebuild_chunk("retry")
+            chunk_fn = rebuild_chunk(recover_reason)
+
+        def degrade_step(blamed, exc) -> bool:
+            # one ladder rung (checker/resilience.py DegradePolicy):
+            # halve the mesh onto the surviving power-of-two device
+            # subset — dropping the blamed chip when the fault names
+            # one — and resume from the shadow; the reseed that follows
+            # re-routes the pending frontier by owner_of(fp, D/2) and
+            # recomputes the preload-aware growth limits at the new D,
+            # exactly like a cross-mesh checkpoint resume. Returns True
+            # when the next rung is the single-chip device loop
+            # (checker/tpu.py shadow handoff).
+            nonlocal mesh, D, insert_fn, headroom, size_key
+            new_d = D // 2
+            devs = list(mesh.devices.flat)
+            if blamed is not None:
+                # a real PJRT fault names the GLOBAL device id; an
+                # injected one may name the mesh position — match id
+                # first, fall back to position
+                ids = [getattr(d, "id", None) for d in devs]
+                if blamed in ids:
+                    devs.pop(ids.index(blamed))
+                elif 0 <= blamed < len(devs):
+                    devs.pop(blamed)
+            keep = devs[:new_d]
+            self._metrics.inc("degrades")
+            self._metrics.set("mesh_shards", new_d)
+            if self._trace:
+                self._trace.emit(
+                    "degrade", from_shards=D, to_shards=new_d,
+                    device=blamed,
+                    error=f"{type(exc).__name__}: {exc}")
+            attributor.clear()
+            if new_d == 1:
+                # final rung: the plain single-chip loop adopts the
+                # shadow (pending frontier + run-spanning records)
+                rows, ebs, fps = shadow.pending()
+                self._handoff = (
+                    [rows[i] for i in range(rows.shape[0])],
+                    np.asarray(ebs, np.uint32),
+                    [int(f) for f in fps],
+                    dict(discoveries))
+                self._handoff_shadow = shadow
+                self._handoff_device = keep[0] if keep else None
+                return True
+            from jax.sharding import Mesh
+            mesh = self._mesh = Mesh(np.asarray(keep), (axis,))
+            D = new_d
+            self._fault_shards = D
+            insert_fn = build_sharded_insert(mesh, axis)
+            headroom = max(D * kmax, fmax)
+            mk = model_cache_key(model)
+            size_key = ((mk, fmax, self._sound, self._symmetry, D)
+                        if mk is not None else None)
+            shadow.reshard(D)
+            return False
 
         fault_attempt = 0
         recover_delay = None
+        recover_reason = "retry"
+        handoff_rung = False
         while True:
             try:
                 if recover_delay is not None:
@@ -622,16 +689,59 @@ class ShardedTpuChecker(TpuChecker):
                         or classify_error(exc) is not FaultKind.TRANSIENT):
                     raise
                 inflight.clear()
-                if fault_attempt >= policy.retries:
+                blamed = blamed_device(exc)
+                if blamed is not None:
+                    ids = [getattr(d, "id", None)
+                           for d in mesh.devices.flat]
+                    if blamed not in ids and not 0 <= blamed < D:
+                        blamed = None  # names no chip on this mesh
+                if blamed is not None:
+                    self._metrics.set("fault_device", blamed)
+                # the ladder drops a rung when the retry budget is
+                # spent on this mesh, or sooner when the blame streak
+                # pins the faults on one chip (beating the rest of the
+                # budget on a dead device is pure waste)
+                exhausted = fault_attempt >= policy.retries
+                offender = attributor.note(blamed)
+                if (ladder.enabled and D > ladder.min_mesh
+                        and (exhausted or offender)):
+                    if degrade_step(blamed, exc):
+                        handoff_rung = True
+                        break
+                    fault_attempt = 0
+                    recover_delay = 0.0
+                    recover_reason = "degrade"
+                    continue
+                if exhausted:
                     self._resilience_degrade(exc, shadow, discoveries)
                 fault_attempt += 1
                 recover_delay = policy.delay(fault_attempt)
+                recover_reason = "retry"
                 self._metrics.inc("retries")
                 if self._trace:
                     self._trace.emit(
                         "retry", attempt=fault_attempt,
                         delay=round(recover_delay, 3),
-                        error=f"{type(exc).__name__}: {exc}")
+                        error=f"{type(exc).__name__}: {exc}",
+                        device=blamed, shards=D)
+        if handoff_rung:
+            # the ladder's last rung: run the plain single-chip device
+            # loop (checker/tpu.py) on the surviving chip, seeded from
+            # the shadow handoff. Its own retry envelope (and the
+            # shadow-spanning lasso sweep / resumable-frontier /
+            # mirror post-passes) take over from here.
+            import contextlib
+            self._fault_shards = 1
+            dev = self._handoff_device
+            ctx = (jax.default_device(dev) if dev is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                self._run_device()
+            if self._visitor is not None:
+                with self._timed("visit"):
+                    self._visit_reached()
+            return
+
         q_head, q_tail = cur["q_head"], cur["q_tail"]
         log_n, e_n = cur["log_n"], cur["e_n"]
         if int(log_n.max()):

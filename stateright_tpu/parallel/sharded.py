@@ -641,7 +641,14 @@ def build_sharded_rebuild(mesh: Mesh, axis: str):
 
 
 def owner_of(fp: int, d: int) -> int:
-    """The shard owning a 64-bit fingerprint (top log2(d) bits)."""
+    """The shard owning a 64-bit fingerprint (top log2(d) bits).
+
+    Prefix ownership gives the halving invariant the degradation
+    ladder (checker/resilience.py) leans on: ``owner_of(fp, d // 2)
+    == owner_of(fp, d) // 2`` — halving the mesh merges ADJACENT shard
+    pairs, so a re-route onto ``d // 2`` devices moves every state to
+    the shard that already owns its prefix, never scattering one old
+    shard's keys across the new mesh."""
     kbits = _owner_bits(d)
     return (fp >> (64 - kbits)) if kbits else 0
 

@@ -60,6 +60,36 @@ def _mesh(n):
     return Mesh(np.array(devices[:n]), ("shards",))
 
 
+def _dead_above(shards_alive, device=None):
+    """A chip that is gone FOR GOOD: every chunk sync faults while the
+    mesh is wider than ``shards_alive`` (two-parameter hooks receive
+    the current mesh width, so the fault disappears once the ladder
+    has dropped the dead chip). ``device`` names the blamed chip in
+    the error message, like a real PJRT status string."""
+    msg = ("UNAVAILABLE: fake permanent chip death (injected)"
+           if device is None else
+           f"UNAVAILABLE: device {device} fell off the mesh (injected)")
+
+    def hook(chunk, shards):
+        if shards > shards_alive:
+            raise RuntimeError(msg)
+
+    return hook
+
+
+def _dead_above_after(shards_alive, k):
+    """Like :func:`_dead_above`, but the chip only dies at chunk ``k``
+    (chunk ordinals are cumulative across recoveries) — lets the run
+    make real progress, e.g. through growth passes, first."""
+
+    def hook(chunk, shards):
+        if chunk >= k and shards > shards_alive:
+            raise RuntimeError(
+                "UNAVAILABLE: fake permanent chip death (injected)")
+
+    return hook
+
+
 def _assert_parity(faulty, clean):
     assert faulty.unique_state_count() == clean.unique_state_count()
     assert (faulty.generated_fingerprints()
@@ -115,6 +145,79 @@ class TestClassification:
         assert RetryPolicy(retries=0).enabled is False
         assert RetryPolicy(retries=2, backoff=0.0).delay(1) == 0.0
 
+    def test_retry_policy_seeded_jitter_deterministic(self):
+        # tpu_options(retry_seed=...) pins the jitter to a private RNG
+        # stream: same seed -> same delay sequence, independent of the
+        # global RNG state, PYTHONHASHSEED, and reruns
+        def seq(p):
+            return [p.delay(i) for i in (1, 2, 3, 4)]
+
+        assert seq(RetryPolicy(retries=3, backoff=1.0, seed=42)) \
+            == seq(RetryPolicy(retries=3, backoff=1.0, seed=42))
+        assert seq(RetryPolicy(retries=3, backoff=1.0, seed=42)) \
+            != seq(RetryPolicy(retries=3, backoff=1.0, seed=7))
+        opts = {"retries": 2, "backoff": 1.0, "retry_seed": 5}
+        assert seq(RetryPolicy.from_options(opts)) \
+            == seq(RetryPolicy.from_options(dict(opts)))
+        import random
+        random.seed(0)
+        a = seq(RetryPolicy(retries=3, backoff=1.0, seed=9))
+        random.seed(12345)
+        assert a == seq(RetryPolicy(retries=3, backoff=1.0, seed=9))
+
+    def test_blamed_device_attribution(self):
+        from stateright_tpu.checker.resilience import blamed_device
+        assert blamed_device(RuntimeError(
+            "UNAVAILABLE: device 3 heartbeat lost")) == 3
+        assert blamed_device(RuntimeError(
+            "UNAVAILABLE: TPU_2 tunnel reset")) == 2
+        assert blamed_device(RuntimeError(
+            "ABORTED: chip 1 power fault")) == 1
+        assert blamed_device(RuntimeError(
+            "UNAVAILABLE: backend gone")) is None
+        err = RuntimeError("UNAVAILABLE: gone")
+        err.device_index = 5
+        assert blamed_device(err) == 5
+        # attribution walks the cause chain like classify_error
+        try:
+            try:
+                raise RuntimeError("UNAVAILABLE: device 4 dead")
+            except RuntimeError as inner:
+                raise RuntimeError("retries exhausted") from inner
+        except RuntimeError as wrapped:
+            assert blamed_device(wrapped) == 4
+
+    def test_fault_attributor_streak(self):
+        from stateright_tpu.checker.resilience import FaultAttributor
+        a = FaultAttributor(blame_after=2)
+        assert not a.note(3)
+        assert a.note(3)          # same chip twice in a row
+        a.clear()
+        assert not a.note(3)
+        assert not a.note(2)      # a different chip resets the streak
+        assert a.note(2)
+        assert not a.note(None)   # unattributable faults break streaks
+        assert a.totals == {3: 3, 2: 2}  # lifetime totals survive clear()
+
+    def test_degrade_policy_bounds(self):
+        from stateright_tpu.checker.resilience import DegradePolicy
+        assert DegradePolicy.from_options({}).enabled
+        assert DegradePolicy.from_options({}).min_mesh == 1
+        assert not DegradePolicy.from_options({"degrade": False}).enabled
+        with pytest.raises(ValueError, match="min_mesh"):
+            DegradePolicy(min_mesh=3)
+        with pytest.raises(ValueError, match="min_mesh"):
+            (TwoPhaseSys(3).checker()
+             .tpu_options(race=False, min_mesh=3).spawn_tpu())
+
+
+@pytest.fixture(scope="module")
+def clean_paxos1():
+    """One uninterrupted single-chip paxos run (host-evaluated
+    linearizability), shared by the retry and degrade parity tests."""
+    return _run(lambda: PackedPaxos(1), capacity=1 << 12, fmax=64,
+                chunk_steps=2)
+
 
 class TestRetryParity:
     """Acceptance: an injected transient UNAVAILABLE on chunk k leaves
@@ -149,15 +252,13 @@ class TestRetryParity:
         _assert_parity(faulty, clean)
         assert faulty.profile()["retries"] == 1
 
-    def test_host_props_and_witness_paths(self):
+    def test_host_props_and_witness_paths(self, clean_paxos1):
         # paxos: 'linearizable' is host-evaluated — the recovery must
         # re-arm the in-carry history dedup and keep memoized results
-        clean = _run(lambda: PackedPaxos(1), capacity=1 << 12, fmax=64,
-                     chunk_steps=2)
         faulty = _run(lambda: PackedPaxos(1), capacity=1 << 12, fmax=64,
                       chunk_steps=2, retries=2, backoff=0.0,
                       fault_hook=_hook_at(2))
-        _assert_parity(faulty, clean)
+        _assert_parity(faulty, clean_paxos1)
         faulty.assert_properties()
 
     def test_mid_growth_recovery(self):
@@ -219,6 +320,226 @@ class TestRetryParity:
                  chunk_steps=2, retries=2, backoff=0.0, fault_hook=hook)
 
 
+@pytest.fixture(scope="module")
+def clean_2pc3_d2():
+    """One uninterrupted D=2 oracle run shared by the degrade parity
+    tests (set-semantics parity is pipeline-agnostic)."""
+    return _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                chunk_steps=2, mesh=_mesh(2))
+
+
+@pytest.fixture(scope="module")
+def clean_2pc3_single():
+    """One uninterrupted single-chip oracle run (the ladder's bottom
+    rung parity target)."""
+    return _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                chunk_steps=2)
+
+
+class TestDegrade:
+    """Acceptance: a permanently failing chip shrinks the mesh instead
+    of ending the run — D=4 degrades to D=2 (virtual CPU mesh) with
+    discoveries and unique/generated fingerprint sets bit-identical to
+    an uninterrupted D=2 run, pipelined and synchronous; the ladder
+    descends to the single-chip rung; raced mesh runs prefer a
+    degraded device finish over the host-BFS failover."""
+
+    def test_permanent_fault_degrades_to_half_mesh_pipelined(
+            self, clean_2pc3_d2):
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, mesh=_mesh(4), retries=1,
+                      backoff=0.0, fault_hook=_dead_above(2))
+        _assert_parity(faulty, clean_2pc3_d2)
+        prof = faulty.profile()
+        assert prof["degrades"] == 1
+        assert prof["mesh_shards"] == 2
+        assert prof["retries"] == 1  # the budget was spent, then the rung
+
+    def test_permanent_fault_degrades_to_half_mesh_sync(
+            self, clean_2pc3_d2):
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, mesh=_mesh(4), pipeline=False,
+                      retries=1, backoff=0.0, fault_hook=_dead_above(2))
+        _assert_parity(faulty, clean_2pc3_d2)
+        prof = faulty.profile()
+        assert prof["degrades"] == 1
+        assert prof["mesh_shards"] == 2
+
+    def test_blamed_chip_is_dropped_without_burning_budget(
+            self, clean_2pc3_d2):
+        # consecutive faults naming ONE chip drop a rung after
+        # blame_after=2, not after the full retries=5 budget — and the
+        # blamed device leaves the surviving mesh
+        trace = []
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, mesh=_mesh(4), retries=5,
+                      backoff=0.0, fault_hook=_dead_above(2, device=3),
+                      trace=trace)
+        _assert_parity(faulty, clean_2pc3_d2)
+        prof = faulty.profile()
+        assert prof["degrades"] == 1
+        assert prof["retries"] == 1  # one retry, then the blame streak
+        assert prof["fault_device"] == 3
+        assert jax.devices()[3] not in list(faulty._mesh.devices.flat)
+        degrades = [e for e in trace if e["ev"] == "degrade"]
+        assert len(degrades) == 1
+        assert degrades[0]["from_shards"] == 4
+        assert degrades[0]["to_shards"] == 2
+        assert degrades[0]["device"] == 3
+        retries = [e for e in trace if e["ev"] == "retry"]
+        assert retries and retries[0]["device"] == 3
+        assert retries[0]["shards"] == 4
+        from stateright_tpu.obs import validate_event
+        for ev in trace:
+            validate_event(ev)
+
+    def test_ladder_descends_to_single_chip(self, clean_2pc3_single):
+        # D=4 -> D=2 -> the single-chip rung (TpuChecker._run_device
+        # adopting the shadow handoff); parity against an uninterrupted
+        # single-chip run
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, mesh=_mesh(4), retries=1,
+                      backoff=0.0, fault_hook=_dead_above(1))
+        _assert_parity(faulty, clean_2pc3_single)
+        prof = faulty.profile()
+        assert prof["degrades"] == 2
+        assert prof["mesh_shards"] == 1
+
+    @pytest.mark.slow
+    def test_late_fault_reinserts_accumulated_mirror(self):
+        # a fault landing chunks into the run: the degraded mesh must
+        # re-route the mid-flight frontier AND re-insert the whole
+        # accumulated mirror at the new D (preload-aware limits)
+        clean = _run(lambda: TwoPhaseSys(4), capacity=1 << 8, fmax=16,
+                     chunk_steps=2, mesh=_mesh(2))
+        faulty = _run(lambda: TwoPhaseSys(4), capacity=1 << 8, fmax=16,
+                      chunk_steps=2, mesh=_mesh(4), retries=1,
+                      backoff=0.0, fault_hook=_dead_above_after(2, 3))
+        _assert_parity(faulty, clean)
+        assert faulty.profile()["degrades"] == 1
+
+    @pytest.mark.slow
+    def test_sound_degrade_to_single_chip_keeps_lasso(self):
+        # sound mode across a rung: the post-exhaustion SCC sweep must
+        # rebuild from the shadow's cross-RUNG insert/edge records
+        # (resharded down the ladder), not any single epoch's logs
+        from stateright_tpu.core import Property
+        from stateright_tpu.models.fixtures import PackedDGraph
+
+        def cyc():
+            return (PackedDGraph.with_property(
+                Property.eventually("odd", lambda _, s: s % 2 == 1))
+                .with_path([0, 2, 4, 2]))
+
+        clean = (cyc().checker().sound_eventually()
+                 .tpu_options(race=False, capacity=1 << 10,
+                              chunk_steps=1).spawn_tpu().join())
+        assert "odd" in clean.discoveries()
+        faulty = (cyc().checker().sound_eventually()
+                  .tpu_options(race=False, capacity=1 << 10, fmax=16,
+                               chunk_steps=1, mesh=_mesh(2), retries=1,
+                               backoff=0.0, fault_hook=_dead_above(1))
+                  .spawn_tpu().join())
+        assert "odd" in faulty.discoveries()
+        assert (faulty.generated_fingerprints()
+                == clean.generated_fingerprints())
+        assert faulty.profile()["degrades"] == 1
+        assert faulty.profile()["mesh_shards"] == 1
+
+    @pytest.mark.slow
+    def test_host_props_degrade_to_single_chip(self, clean_paxos1):
+        # paxos: 'linearizable' is host-evaluated — the sharded rung
+        # uses the post-hoc per-shard reduction, the single-chip rung
+        # the in-carry history dedup; the handoff must keep memoized
+        # results and carry prior discoveries across the switch
+        faulty = _run(lambda: PackedPaxos(1), capacity=1 << 12, fmax=64,
+                      chunk_steps=2, mesh=_mesh(2), retries=1,
+                      backoff=0.0, fault_hook=_dead_above(1))
+        _assert_parity(faulty, clean_paxos1)
+        assert faulty.profile()["degrades"] == 1
+        faulty.assert_properties()
+
+    def test_min_mesh_floors_the_ladder(self, tmp_path,
+                                        clean_2pc3_single):
+        # min_mesh=2: the ladder stops at D=2; a fault persisting there
+        # takes the old ending (autosave checkpoint + actionable raise)
+        path = tmp_path / "floor.npz"
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, mesh=_mesh(4), retries=1,
+                           backoff=0.0, min_mesh=2,
+                           autosave=os.fspath(path),
+                           fault_hook=_dead_above(1))
+              .spawn_tpu())
+        with pytest.raises(RuntimeError, match="resume_from"):
+            ck.join()
+        assert ck.profile()["degrades"] == 1  # 4 -> 2, then the floor
+        assert path.exists()
+        # the autosave written at the DEGRADED width resumes anywhere
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12)
+                   .resume_from(path).spawn_tpu().join())
+        assert (resumed.generated_fingerprints()
+                == clean_2pc3_single.generated_fingerprints())
+
+    def test_degrade_opt_out_keeps_old_ending(self):
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, mesh=_mesh(4), retries=1,
+                           backoff=0.0, degrade=False,
+                           fault_hook=_dead_above(2))
+              .spawn_tpu())
+        with pytest.raises(RuntimeError, match="autosave"):
+            ck.join()
+        assert "degrades" not in ck.profile()
+
+    def test_mesh_races_only_on_explicit_opt_in(self):
+        from stateright_tpu.checker.race import race_eligible
+        assert not race_eligible(
+            TwoPhaseSys(3).checker().tpu_options(mesh=_mesh(2)))
+        assert race_eligible(
+            TwoPhaseSys(3).checker().tpu_options(mesh=_mesh(2),
+                                                 race=True))
+        assert not race_eligible(
+            TwoPhaseSys(3).checker().tpu_options(mesh=_mesh(2),
+                                                 race=False))
+
+    def test_raced_mesh_prefers_ladder_over_failover(self):
+        # acceptance: a raced run under a permanent D=4 fault finishes
+        # on the DEGRADED device engine, not the host fallback
+        ck = (TwoPhaseSys(4).checker()
+              .tpu_options(capacity=1 << 12, fmax=64, chunk_steps=2,
+                           mesh=_mesh(4), race=True, race_budget=0.0,
+                           retries=1, backoff=0.0,
+                           fault_hook=_dead_above(2))
+              .spawn_tpu().join())
+        host = TwoPhaseSys(4).checker().spawn_bfs().join()
+        assert ck.unique_state_count() == host.unique_state_count()
+        assert (ck.generated_fingerprints()
+                == host.generated_fingerprints())
+        prof = ck.profile()
+        assert prof["engine"] == "device"
+        assert prof["degrades"] >= 1
+        assert prof.get("failovers", 0) == 0
+        ck.assert_properties()
+
+    def test_raced_mesh_ladder_exhaustion_still_fails_over(self):
+        # every rung dead (the hook faults at every width, single chip
+        # included): the ladder exhausts and the race's un-budgeted
+        # host BFS remains the last rung
+        ck = (TwoPhaseSys(4).checker()
+              .tpu_options(capacity=1 << 12, fmax=64, chunk_steps=2,
+                           mesh=_mesh(4), race=True, race_budget=0.0,
+                           retries=1, backoff=0.0,
+                           fault_hook=_dead_above(0))
+              .spawn_tpu().join())
+        host = TwoPhaseSys(4).checker().spawn_bfs().join()
+        assert ck.unique_state_count() == host.unique_state_count()
+        prof = ck.profile()
+        assert prof["engine"] == "host"
+        assert prof["failovers"] == 1
+
+
 class TestAutosave:
     def test_exhausted_retries_write_loadable_checkpoint(self, tmp_path):
         path = tmp_path / "auto.npz"
@@ -263,6 +584,37 @@ class TestAutosave:
                    .resume_from(path).spawn_tpu().join())
         assert (resumed.generated_fingerprints()
                 == ck.generated_fingerprints())
+
+    @pytest.mark.slow
+    def test_sharded_autosave_round_trips_across_mesh_sizes(
+            self, tmp_path, clean_2pc3_single):
+        # the shard-agnostic checkpoint claim (parallel/engine.py)
+        # pinned ACROSS D changes — the degrade path depends on it: an
+        # autosave written on a D=4 mesh must resume on D=2 and on a
+        # single chip, converging to the same reached set
+        path = tmp_path / "auto4.npz"
+
+        def hook(chunk):  # legacy one-parameter hook shape
+            if chunk >= 2:
+                raise _unavailable()
+
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, mesh=_mesh(4), retries=1,
+                           backoff=0.0, degrade=False,
+                           autosave=os.fspath(path), fault_hook=hook)
+              .spawn_tpu())
+        with pytest.raises(RuntimeError, match="resume_from"):
+            ck.join()
+        assert path.exists()
+        assert ck.profile()["retries"] == 1
+        for opts in ({"mesh": _mesh(2)}, {}):
+            resumed = (TwoPhaseSys(3).checker()
+                       .tpu_options(capacity=1 << 12, **opts)
+                       .resume_from(path).spawn_tpu().join())
+            assert resumed.unique_state_count() == 288, opts
+            assert (resumed.generated_fingerprints()
+                    == clean_2pc3_single.generated_fingerprints()), opts
 
     def test_degrade_without_autosave_names_the_knob(self):
         def hook(chunk):
@@ -355,6 +707,31 @@ def _run_bench(*flags):
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
          *flags],
         capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+
+
+def test_bench_degraded_tagging():
+    # a degraded primary sample must tag the stdout contract line
+    # ("degraded": true + final mesh size) so the perf trajectory can't
+    # silently mix rates measured on fewer chips
+    import bench
+
+    class FakeCk:
+        def profile(self):
+            return {"degrades": 2, "mesh_shards": 2}
+
+    class CleanCk:
+        def profile(self):
+            return {"chunks": 5}
+
+    saved = dict(bench.DEGRADED)
+    try:
+        bench.DEGRADED.update(any=False, final_shards=None)
+        assert bench._note_degraded(CleanCk()) == {}
+        assert bench.DEGRADED["any"] is False
+        assert bench._note_degraded(FakeCk()) == {}
+        assert bench.DEGRADED == {"any": True, "final_shards": 2}
+    finally:
+        bench.DEGRADED.update(saved)
 
 
 @pytest.mark.slow

@@ -13,9 +13,13 @@ found in the trace:
     hit-rate, table load factor, queue depth — the view that makes a
     pipeline stall or a growth storm visible after the fact;
   * interventions (grow/hgrow/egrow/kovf/compile, plus the resilience
-    layer's retry/watchdog/autosave/failover events) with timestamps —
-    on a flaky round this table says *where* the tunnel dropped, what
-    the engine did about it, and whether an autosave landed;
+    layer's retry/watchdog/autosave/failover/degrade events) with
+    timestamps — on a flaky round this table says *where* the tunnel
+    dropped, what the engine did about it, and whether an autosave
+    landed;
+  * a resilience summary line (retries/watchdogs/failovers/degrades,
+    the blamed device indices, and the mesh width a degraded run
+    finished on);
   * discoveries and the final counts.
 
 ``--validate`` additionally schema-checks every event and exits
@@ -134,13 +138,39 @@ def report(events, out=sys.stdout):
 
         inters = [e for e in evs if e["ev"] in
                   ("grow", "hgrow", "egrow", "kovf", "compile",
-                   "retry", "watchdog", "autosave", "failover")]
+                   "retry", "watchdog", "autosave", "failover",
+                   "degrade")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
                 detail = {k: v for k, v in ev.items()
                           if k not in ("t", "ev", "engine")}
                 out.write(f"  t={ev['t']:9.3f}  {ev['ev']:8} {detail}\n")
+
+        # resilience summary: how the run survived (and on how many
+        # chips it finished) — retries/failovers alongside the ladder's
+        # degrades, with every chip the faults were blamed on
+        resil = [e for e in evs
+                 if e["ev"] in ("retry", "failover", "degrade",
+                                "watchdog")]
+        if resil:
+            counts = {}
+            for ev in resil:
+                counts[ev["ev"]] = counts.get(ev["ev"], 0) + 1
+            plural = {"retry": "retries", "watchdog": "watchdogs",
+                      "failover": "failovers", "degrade": "degrades"}
+            parts = [f"{plural[kind]}={counts[kind]}"
+                     for kind in ("retry", "watchdog", "failover",
+                                  "degrade") if kind in counts]
+            blamed = sorted({ev["device"] for ev in resil
+                             if ev.get("device") is not None})
+            if blamed:
+                parts.append(f"blamed_devices={blamed}")
+            degrades = [e for e in resil if e["ev"] == "degrade"]
+            if degrades:
+                parts.append(
+                    f"final_mesh={degrades[-1]['to_shards']}")
+            out.write("\nresilience: " + " ".join(parts) + "\n")
 
         for ev in evs:
             if ev["ev"] == "discovery":
