@@ -74,6 +74,15 @@ FAILED: list = []
 #: measured on fewer chips than the round claims
 DEGRADED: dict = {"any": False, "final_shards": None}
 
+#: backend-init fallback record (ROADMAP item 3's hole, closed round 6):
+#: BENCH_r05 exited rc=1 because platform INIT raised UNAVAILABLE before
+#: any per-workload isolation existed. _ensure_backend now wraps init in
+#: the same resilient contract — a failed init is classified, reported
+#: on stderr, and the whole matrix falls back to CPU, so a contract
+#: line ALWAYS lands (tagged "init_fallback" so the trajectory can't
+#: mistake a CPU-fallback round for a device round).
+INIT_FALLBACK: dict = {"any": False, "cause": None}
+
 
 def _median(xs):
     s = sorted(xs)
@@ -115,7 +124,9 @@ def _compact_metrics(ck):
     m = {}
     for k in ("chunks", "levels", "grows", "hgrows", "kovfs",
               "compiles", "retries", "failovers", "degrades",
-              "autosaves", "engine", "shard_balance", "mesh_shards"):
+              "autosaves", "engine", "shard_balance", "mesh_shards",
+              "fused_chunks", "fused_fallbacks", "predup_hits",
+              "probe_rounds"):
         if prof.get(k):
             m[k] = prof[k]
     if prof.get("fault_device") is not None:  # device 0 is falsy
@@ -156,10 +167,19 @@ def _sampled(name, mk, value=None, unit="uniq/s", warmups=2,
         else:
             samples.append(round(ck.unique_state_count() / dt, 1))
     best = min(samples) if value == "seconds" else max(samples)
+    uniq, gen = ck.unique_state_count(), ck.state_count()
     row = {"workload": name, "best": best, "median": _median(samples),
            "unit": "s" if value == "seconds" else unit,
-           "uniq": ck.unique_state_count(),
-           "gen": ck.state_count(),
+           "uniq": uniq,
+           "gen": gen,
+           # generated-per-unique ratio: the duplicate-expansion cost
+           # the fused kernel attacks (ROADMAP item 1 names it as the
+           # fusion proxy — rows generated, hashed and probed per state
+           # actually kept)
+           "gen_per_uniq": round(gen / uniq, 3) if uniq else None,
+           # which dedup path produced this rate — the trajectory must
+           # never silently mix fused and staged numbers
+           "fused": bool(ck.profile().get("fused")),
            "samples": samples,
            # last sample's metrics snapshot: explains the round
            # (stalls, growth storms), not just ranks it
@@ -182,25 +202,37 @@ def _note_degraded(ck) -> dict:
 
 
 def _ensure_backend() -> str:
-    """Initialize the configured JAX backend, falling back to CPU when
-    it cannot come up (BENCH_r05 crashed rc=1 on a host whose TPU
-    tunnel was down, leaving the trajectory empty). An explicit
-    ``JAX_PLATFORMS`` is honored as-is — that is the user's override,
-    including forcing CPU on a TPU host."""
+    """Initialize the configured JAX backend under the resilient
+    contract: ANY init failure — including with an explicit
+    ``JAX_PLATFORMS`` naming a dead/unknown platform, the exact
+    BENCH_r05 rc=1 hole (init raised UNAVAILABLE before bench's
+    per-workload isolation existed) — is classified via the resilience
+    taxonomy, reported as a stderr row, and falls back to CPU so the
+    full matrix still runs and a contract line always lands (tagged
+    ``init_fallback``). An explicit ``JAX_PLATFORMS=cpu`` is simply
+    honored — that is the user forcing CPU on a TPU host."""
     import os
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        return jax.default_backend()
     try:
         return jax.default_backend()  # initializes the backend
     except Exception as exc:
+        from stateright_tpu.checker.resilience import classify_error
+        cause = classify_error(exc).value
+        INIT_FALLBACK["any"] = True
+        INIT_FALLBACK["cause"] = cause
         print(json.dumps({"workload": "backend", "fallback": "cpu",
-                          "error": repr(exc)}), file=sys.stderr)
+                          "cause": cause, "error": repr(exc)}),
+              file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        jax.config.update("jax_platforms", "cpu")
-        return jax.default_backend()
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            return jax.default_backend()
+        except Exception as exc2:  # CPU too: report, let _guarded land
+            print(json.dumps({"workload": "backend",
+                              "error": repr(exc2)}), file=sys.stderr)
+            raise
 
 
 def main() -> None:
@@ -233,6 +265,12 @@ def main() -> None:
         if DEGRADED["any"]:
             contract["degraded"] = True
             contract["final_shards"] = DEGRADED["final_shards"]
+        if INIT_FALLBACK["any"]:
+            # the round ran on the CPU fallback because the configured
+            # backend failed to INITIALIZE (classified cause rides
+            # along) — not comparable to device rounds
+            contract["init_fallback"] = True
+            contract["init_cause"] = INIT_FALLBACK["cause"]
         print(json.dumps(contract))
 
 

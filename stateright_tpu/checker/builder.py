@@ -87,6 +87,21 @@ class CheckerBuilder:
         """Tuning knobs for ``spawn_tpu`` (table capacity, batch caps,
         mesh selection, ...). Notable:
 
+        * ``fused`` (default ``'auto'``) selects the fused Pallas
+          expand→fingerprint→dedup kernel (``ops/fused.py``; README
+          § Fused device kernel). ``'auto'`` tries the Pallas build on
+          TPU backends and, on any lowering/compile failure (the `axon`
+          backend is experimental), classifies the error, emits a
+          ``fused_fallback`` trace event plus the ``fused_fallbacks``
+          metric, and runs the staged path — never a hard error; off
+          TPU, ``'auto'`` resolves to staged without an attempt
+          (``fused_attempt=True`` forces the attempt through the
+          interpreter — a testing/debug knob). ``True`` forces the
+          fused build (interpret mode off TPU — how the CPU parity
+          suite pins bit-identical behavior); ``False`` forces staged.
+          Configurations outside the fused support matrix
+          (sound-eventually, host-evaluated properties, ``hint=``) stay
+          staged under ``'auto'`` and raise under ``True``;
         * ``pipeline`` (default ``True``) double-buffers the chunk
           loop — chunk N+1 is dispatched while the host consumes chunk
           N's stats; ``pipeline=False`` forces the synchronous loop

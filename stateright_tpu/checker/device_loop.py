@@ -130,6 +130,11 @@ class ChunkCarry(NamedTuple):
     # sweep (checker/lasso.py) needs for cycle-complete liveness.
     elog: jax.Array     # uint32[ecap | 1, 4]
     e_n: jax.Array      # int32[]  edges logged so far
+    # --- per-chunk dedup telemetry (obs GLOSSARY: predup_hits /
+    # probe_rounds), reset at each dispatch like ``gen``
+    pdh: jax.Array      # int32[]  duplicate lanes killed by the in-batch
+    #                              pre-dedup this chunk
+    prb: jax.Array      # int32[]  visited-table probe rounds this chunk
 
 
 def shrink_indices(mask, k: int):
@@ -211,7 +216,8 @@ def model_cache_key(model):
 def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                    symmetry: bool = False, sound: bool = False,
                    hcap: int = 0, n_init: int = 0, kraw: int = 0,
-                   hint_eff: int = 0, ecap: int = 0):
+                   hint_eff: int = 0, ecap: int = 0,
+                   fused: bool = False, fused_interpret: bool = False):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit,
@@ -242,13 +248,14 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     """
     mkey = model_cache_key(model)
     key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound, hcap,
-           n_init, kraw, hint_eff, ecap)
+           n_init, kraw, hint_eff, ecap, fused, fused_interpret)
     if mkey is not None:
         cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
-                         sound, hcap, n_init, kraw, hint_eff, ecap)
+                         sound, hcap, n_init, kraw, hint_eff, ecap,
+                         fused, fused_interpret)
     if mkey is not None:
         _CHUNK_CACHE[key] = fn
     return fn
@@ -257,7 +264,13 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
 def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                     symmetry: bool, sound: bool = False, hcap: int = 0,
                     n_init: int = 0, kraw: int = 0, hint_eff: int = 0,
-                    ecap: int = 0):
+                    ecap: int = 0, fused: bool = False,
+                    fused_interpret: bool = False):
+    if fused:
+        # support matrix (ops/fused.py supports()): the engines route
+        # sound / host-property / hint configs to the staged build
+        assert not sound and not hcap and not hint_eff and not ecap, \
+            "fused chunk build outside its support matrix"
     n_actions = model.max_actions
     width = model.packed_width
     properties = model.properties()
@@ -305,11 +318,12 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # small constant. Hitting the bound reports hovf (the growth
         # signal) instead of spinning out the default 4096 rounds.
         h_rounds = min(4096, hcap + 64)
-    # the queue slice must cover BOTH the widest append (kmax rows) and
-    # the frontier dequeue (fmax rows — dynamic_slice would silently
-    # CLAMP its start near the end of the queue, re-expanding consumed
-    # rows and skipping pending ones)
-    qmargin = max(kmax, fmax)
+    # the queue slice must cover BOTH the widest append (kmax rows; the
+    # fused step appends straight from the F*A lane mask — no candidate
+    # staging — so its margin is fa) and the frontier dequeue (fmax rows
+    # — dynamic_slice would silently CLAMP its start near the end of the
+    # queue, re-expanding consumed rows and skipping pending ones)
+    qmargin = max(fa, fmax) if fused else max(kmax, fmax)
 
     def make_cond(lo_water, hi_water):
         def cond(state):
@@ -496,8 +510,9 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
             else:
                 kvalid = dvalid & ~kovf
 
-            inserted, key_hi, key_lo, t_ovf = table_insert(
-                c.key_hi, c.key_lo, k_chi, k_clo, kvalid)
+            inserted, key_hi, key_lo, t_ovf, t_rounds = table_insert(
+                c.key_hi, c.key_lo, k_chi, k_clo, kvalid,
+                with_rounds=True)
             t_ovf = t_ovf & ~kovf
             cnt = inserted.sum(dtype=jnp.int32)
 
@@ -582,7 +597,87 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                 steps=c.steps - 1,
                 vmax=jnp.maximum(c.vmax, vcount),
                 dmax=jnp.maximum(c.dmax, dcount),
-                rmax=jnp.maximum(c.rmax, rmax_it))
+                rmax=jnp.maximum(c.rmax, rmax_it),
+                # dedup telemetry (kovf iterations committed nothing)
+                pdh=c.pdh + jnp.where(kovf, 0, vcount - dcount),
+                prb=c.prb + jnp.where(kovf, 0, t_rounds))
+        return step
+
+    def make_fused_step(fmax_b: int):
+        """The fused analog of ``make_step``: ONE Pallas kernel
+        (ops/fused.py) expands, fingerprints, pre-dedups AND probes the
+        visited table — duplicate lanes die inside the kernel, so there
+        is no kraw/kmax candidate staging (and no kovf protocol: appends
+        gather the fresh-lane mask at the raw F*A width, covered by the
+        fa queue margin). Everything after the kernel — discovery
+        registers, the candidate-matrix assembly for the two block
+        appends — is the staged code on the kernel's outputs."""
+        from ..ops.expand import Expansion
+        from ..ops.fused import build_fused_block_fn
+
+        blk = build_fused_block_fn(model, fmax_b, capacity,
+                                   symmetry=symmetry, probe=True,
+                                   interpret=fused_interpret)
+        fa_b = fmax_b * n_actions
+
+        def step(state):
+            c, target_remaining, grow_limit = state
+            sl = jax.lax.dynamic_slice(
+                c.q, (c.q_head, 0), (fmax_b, width + 3))
+            frontier = sl[:, :width]
+            ebits = sl[:, width]
+            phi, plo = sl[:, width + 1], sl[:, width + 2]
+            take = jnp.minimum(c.q_tail - c.q_head, fmax_b)
+            fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
+
+            out = blk(frontier, ebits, fvalid, c.key_hi, c.key_lo)
+            vcount = out.cvalid.sum(dtype=jnp.int32)
+            dcount = out.dvalid.sum(dtype=jnp.int32)
+            cnt = out.inserted.sum(dtype=jnp.int32)
+
+            disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
+            if prop_count:
+                exp_like = Expansion(
+                    pbits=out.pbits, ebits=out.ebits, flat=None,
+                    avalid=None, cvalid=None, chi=None, clo=None,
+                    ohi=None, olo=None, phi=phi, plo=plo,
+                    terminal=out.terminal, xovf=out.xovf)
+                new_hit, cand_hi, cand_lo = discovery_candidates(
+                    properties, exp_like, fvalid, whi=phi, wlo=plo)
+                keep = disc_hit | ~new_hit
+                disc_hi = jnp.where(keep, disc_hi, cand_hi)
+                disc_lo = jnp.where(keep, disc_lo, cand_lo)
+                disc_hit = disc_hit | new_hit
+
+            # parent-side columns broadcast along the action axis;
+            # assemble_candidates keeps the staged column layout so the
+            # queue and log blocks stay the same contiguous slices
+            ceb = jnp.repeat(out.ebits, n_actions)
+            par_hi = jnp.repeat(phi, n_actions)
+            par_lo = jnp.repeat(plo, n_actions)
+            cand, log_off = assemble_candidates(
+                out.flat, ceb, out.chi, out.clo, par_hi, par_lo,
+                out.ohi, out.olo, width, symmetry, False)
+            src3 = shrink_indices(out.inserted, fa_b)
+            n_all = cand[src3]
+            q = jax.lax.dynamic_update_slice(
+                c.q, n_all[:, :width + 3], (c.q_tail, 0))
+            log = jax.lax.dynamic_update_slice(
+                c.log, n_all[:, log_off:log_off + c.log.shape[1]],
+                (c.log_n, 0))
+            return c._replace(
+                q=q, q_head=c.q_head + take, q_tail=c.q_tail + cnt,
+                key_hi=out.key_hi, key_lo=out.key_lo,
+                log=log, log_n=c.log_n + cnt,
+                gen=c.gen + vcount,
+                ovf=c.ovf | out.ovf,
+                disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
+                xovf=c.xovf | out.xovf,
+                steps=c.steps - 1,
+                vmax=jnp.maximum(c.vmax, vcount),
+                dmax=jnp.maximum(c.dmax, dcount),
+                pdh=c.pdh + (vcount - dcount),
+                prb=c.prb + out.rounds)
         return step
 
     # thin BFS frontiers (a few hundred pending states) are common at the
@@ -597,12 +692,18 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     fa_small = fmax_small * n_actions
     kraw_small = fmax_small * hint_eff if hint_eff \
         else min(fa_small, kraw)
-    step_large = make_step(fmax, kraw, kmax)
-    if two_size:
-        # the small step's raw bound is fa_small itself; its stage-two
-        # buffer shrinks with kmax but never below what dedup can survive
-        step_small = make_step(fmax_small, kraw_small,
-                               min(kmax_small, kraw_small))
+    if fused:
+        step_large = make_fused_step(fmax)
+        if two_size:
+            step_small = make_fused_step(fmax_small)
+    else:
+        step_large = make_step(fmax, kraw, kmax)
+        if two_size:
+            # the small step's raw bound is fa_small itself; its
+            # stage-two buffer shrinks with kmax but never below what
+            # dedup can survive
+            step_small = make_step(fmax_small, kraw_small,
+                                   min(kmax_small, kraw_small))
 
     def make_body(step):
         def body(state):
@@ -643,7 +744,8 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         # of a dozen scalars dominated the whole chunk sync. Layout
         # (tpu.py unpacks positionally — keep in sync):
         # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-        #  vmax, dmax, rmax, e_n, disc_hit[P], disc_hi[P], disc_lo[P],
+        #  vmax, dmax, rmax, e_n, pdh, prb,
+        #  disc_hit[P], disc_hi[P], disc_lo[P],
         #  recent queue row (W+3), hist window (hist_on only)]
         # the most recently enqueued state's queue row rides the sync
         # for free (the Explorer decodes it as live progress — the
@@ -657,7 +759,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                        out.h_n,
                        out.hovf.astype(jnp.int32),
                        out.vmax, out.dmax, out.rmax,
-                       out.e_n]).astype(jnp.uint32),
+                       out.e_n, out.pdh, out.prb]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo, recent])
         if not hist_on:
@@ -751,7 +853,8 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 elog=jnp.zeros((ecap if ecap else 1, 4), jnp.uint32),
                 e_n=jnp.int32(0),
                 vmax=jnp.int32(0), dmax=jnp.int32(0),
-                rmax=jnp.int32(0))
+                rmax=jnp.int32(0),
+                pdh=jnp.int32(0), prb=jnp.int32(0))
 
         fn = jax.jit(build)
         _SEED_CACHE[key] = fn

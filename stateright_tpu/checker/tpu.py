@@ -311,6 +311,16 @@ class TpuChecker(HostChecker):
             "capacity must be a power of two"
         self._max_segment = int(opts.get("max_segment", 1 << 15))
         self._grow_at = float(opts.get("grow_at", 0.55))
+        # fused Pallas expand→fingerprint→dedup kernel (ops/fused.py):
+        # 'auto' tries the Pallas build on TPU backends and falls back
+        # to the staged path on any failure (classified + traced —
+        # never a hard error); True forces it (interpret mode off TPU,
+        # how the CPU parity suite pins it); False forces staged
+        self._fused_mode = opts.get("fused", "auto")
+        if self._fused_mode not in (True, False, "auto"):
+            raise ValueError(
+                f"unknown tpu_options fused {self._fused_mode!r}; "
+                "expected True, False, or 'auto'")
         # host-evaluated properties (e.g. the linearizability search):
         # declared by the model, evaluated per level on newly inserted
         # states, memoized by model.host_property_key(row)
@@ -454,6 +464,60 @@ class TpuChecker(HostChecker):
                 "must keep the packed fast-path evaluators in lockstep "
                 "(or drop host_property_fns to fall back to decode())")
         return list(fns)
+
+    # --- fused-kernel selection (ops/fused.py) -------------------------
+    def _fused_resolve(self, *, sharded: bool, fmax: int,
+                       capacity: int) -> "tuple":
+        """Resolve ``tpu_options(fused=...)`` into ``(on, interpret)``.
+
+        ``'auto'``: configurations outside the support matrix quietly
+        stay staged; on a TPU backend the build is attempted via
+        ``ops.fused.verify_build`` (memoized) and ANY failure is
+        classified through the resilience taxonomy, counted
+        (``fused_fallbacks``) and traced (``fused_fallback`` event) —
+        never a hard error. Off-TPU, 'auto' resolves to staged without
+        an attempt (the interpreter would be slower than compiled XLA);
+        ``tpu_options(fused_attempt=True)`` forces the attempt with the
+        interpreter — the knob the forced-fallback tests use.
+        ``True`` forces the fused build: unsupported configurations
+        raise, and build failures surface.
+        """
+        mode = self._fused_mode
+        if mode is False:
+            return False, False
+        from ..ops import fused as fused_mod
+
+        hint = 0 if sharded else int(self._tpu_options.get("hint", 0))
+        reason = fused_mod.supports(
+            self._model, sound=self._sound,
+            host_props=bool(self._host_props), hint=hint)
+        if reason is not None:
+            if mode is True:
+                raise ValueError(
+                    f"tpu_options(fused=True) is unsupported for this "
+                    f"configuration: {reason}")
+            return False, False
+        import jax
+        interpret = jax.default_backend() != "tpu"
+        if mode is True:
+            return True, interpret
+        if interpret and not self._tpu_options.get("fused_attempt"):
+            return False, False
+        try:
+            fused_mod.verify_build(self._model, fmax, capacity,
+                                   symmetry=self._symmetry,
+                                   probe=not sharded,
+                                   interpret=interpret)
+        except Exception as exc:
+            from .resilience import classify_error
+            cause = classify_error(exc).value
+            self._metrics.inc("fused_fallbacks")
+            if self._trace:
+                self._trace.emit(
+                    "fused_fallback", cause=cause,
+                    error=f"{type(exc).__name__}: {exc}")
+            return False, False
+        return True, interpret
 
     # --- resilience plumbing (checker/resilience.py) -------------------
     def _make_shadow(self, shards: int):
@@ -866,6 +930,12 @@ class TpuChecker(HostChecker):
             # in-flight seed slowed the loop ~2.5x no longer reproduces
             # with the consolidated carry (q/log matrices, 2-D table);
             # PJRT orders the dependent programs itself.
+        # fused Pallas kernel selection (ops/fused.py): resolved ONCE
+        # per run — 'auto' probes the build and falls back classified
+        fused_on, fused_interp = self._fused_resolve(
+            sharded=False, fmax=fmax, capacity=self._capacity)
+        self._metrics.set("fused", 1 if fused_on else 0)
+
         def mk_chunk(reason: str = "initial"):
             # every rebuild implies an XLA retrace (unless the shapes
             # hit the compile cache) — count it and leave a trace event
@@ -876,7 +946,9 @@ class TpuChecker(HostChecker):
                                   kmax, symmetry=self._symmetry,
                                   sound=self._sound, hcap=hcap,
                                   n_init=n_init, kraw=kraw,
-                                  hint_eff=hint_eff, ecap=ecap)
+                                  hint_eff=hint_eff, ecap=ecap,
+                                  fused=fused_on,
+                                  fused_interpret=fused_interp)
 
         chunk_fn = mk_chunk()
         pipeline = bool(opts.get("pipeline", True))
@@ -947,11 +1019,14 @@ class TpuChecker(HostChecker):
                 if target is not None else 2**31 - 1)
             carry = carry._replace(gen=jnp.int32(0),
                                    steps=jnp.int32(k_steps),
-                                   vmax=jnp.int32(0))
+                                   vmax=jnp.int32(0),
+                                   pdh=jnp.int32(0), prb=jnp.int32(0))
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit,
                                           np.int32(self._h_pulled))
             self._metrics.inc("chunks")
+            if fused_on:
+                self._metrics.inc("fused_chunks")
             inflight.append((int(self._metrics.get("chunks")), stats_d,
                              self._h_pulled, int(grow_limit), hcap))
 
@@ -972,16 +1047,16 @@ class TpuChecker(HostChecker):
             t0 = time.perf_counter()
             acts: set = set()
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
-             vmax, dmax, rmax, e_n) = (
+             vmax, dmax, rmax, e_n, pdh, prb) = (
                 int(stats[0]), int(stats[1]), int(stats[2]),
                 int(stats[3]), bool(stats[4]), bool(stats[5]),
                 bool(stats[6]), int(stats[7]), bool(stats[8]),
                 int(stats[9]), int(stats[10]), int(stats[11]),
-                int(stats[12]))
-            disc_hit = stats[13:13 + prop_count].astype(bool)
-            disc_hi = stats[13 + prop_count:13 + 2 * prop_count]
-            disc_lo = stats[13 + 2 * prop_count:13 + 3 * prop_count]
-            tail0 = 13 + 3 * prop_count
+                int(stats[12]), int(stats[13]), int(stats[14]))
+            disc_hit = stats[15:15 + prop_count].astype(bool)
+            disc_hi = stats[15 + prop_count:15 + 2 * prop_count]
+            disc_lo = stats[15 + 2 * prop_count:15 + 3 * prop_count]
+            tail0 = 15 + 3 * prop_count
             width3 = model.packed_width + 3
             if q_tail > 0:
                 # most recently enqueued state (live Explorer progress)
@@ -1015,6 +1090,12 @@ class TpuChecker(HostChecker):
             metrics.observe_max("vmax", vmax)
             metrics.observe_max("dmax", dmax)
             metrics.observe_max("rmax", rmax)
+            # dedup telemetry: chunk-local counters (reset at dispatch,
+            # so a zero-iteration speculative chunk contributes 0)
+            if pdh:
+                metrics.inc("predup_hits", pdh)
+            if prb:
+                metrics.inc("probe_rounds", prb)
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen

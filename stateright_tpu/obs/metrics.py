@@ -80,6 +80,22 @@ GLOSSARY: Dict[str, str] = {
     "autosaves": "resilience checkpoints written (periodic "
                  "tpu_options(autosave=...) snapshots plus the "
                  "exhausted-retries write)",
+    "fused_chunks": "chunks dispatched through the fused Pallas "
+                    "expand→fingerprint→dedup kernel (ops/fused.py; "
+                    "tpu_options(fused=...))",
+    "fused_fallbacks": "fused='auto' build attempts that failed and "
+                       "fell back to the staged path (cause classified "
+                       "via the resilience taxonomy; see the "
+                       "fused_fallback trace event)",
+    "predup_hits": "duplicate candidate lanes killed by the in-batch "
+                   "pre-dedup before the visited-table probe — the "
+                   "fusion win's direct measure (compare against "
+                   "state_count - unique_state_count, the TOTAL "
+                   "duplicate work)",
+    "probe_rounds": "visited-table bucket probe rounds taken across "
+                    "the run (claim-retry pressure: rising rounds per "
+                    "chunk mean duplicate lanes or load factor are "
+                    "stressing the open-addressed table)",
     # --- observed maxima (buffer autotuning inputs) -------------------
     "vmax": "max raw-valid candidate lanes in one iteration (sizes "
             "kraw; compare against fmax*max_actions)",
@@ -100,6 +116,9 @@ GLOSSARY: Dict[str, str] = {
                     "error message)",
     "engine": "race winner tag on a raced spawn_tpu profile: 'host' "
               "or 'device'",
+    "fused": "1 when the run's chunk program took the fused Pallas "
+             "path, 0 when staged (bench tags its contract lines from "
+             "this so the perf trajectory can't silently mix paths)",
     # --- host search timers -------------------------------------------
     "search": "host-engine search loop wall time",
 }

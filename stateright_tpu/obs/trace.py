@@ -83,6 +83,11 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # a degradation-ladder rung: the mesh halved onto the surviving
     # device subset (optional fields: the blamed device, the error)
     "degrade": frozenset({"from_shards", "to_shards"}),
+    # tpu_options(fused='auto') attempted the Pallas build and fell
+    # back to the staged path; `cause` is the resilience taxonomy's
+    # classification of the build failure (transient / capacity /
+    # programming), `error` the underlying message
+    "fused_fallback": frozenset({"cause", "error"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

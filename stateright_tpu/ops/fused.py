@@ -1,0 +1,363 @@
+"""Fused expand→fingerprint→dedup kernel (Pallas) with a bit-identical
+staged fallback.
+
+The staged device loops (`checker/device_loop.py`, `parallel/sharded.py`)
+run expansion, whitening/fingerprinting, in-batch pre-dedup and the
+visited-table probe as separate XLA op groups with the full ``F*A``-wide
+intermediates materialized in HBM between stages. BENCH_r04 put a number
+on the cost: 2pc7 generates 2.74M rows for 296k unique — ~9.3× duplicate
+expansion re-hashed, re-compacted and re-probed every chunk. This module
+builds ONE Pallas kernel (grid over frontier blocks) that, per block:
+
+  * expands successors via the model's vmapped ``packed_step`` (and
+    evaluates ``packed_properties`` + clears eventually-bits, exactly
+    like ``ops.expand.expand_frontier``);
+  * computes the (hi, lo) uint32 fingerprint pair in-register with the
+    SAME whitening construction as ``ops.hash_kernel.fp64_device`` — the
+    kernel body literally evaluates that function's jaxpr, so host/device
+    fingerprint agreement is preserved by construction;
+  * drops in-batch duplicate lanes with the SAME scatter-min claim arena
+    as ``ops.expand.pre_dedup``;
+  * (single-chip only) probes/claims the 4-slot buckets of the visited
+    table with the SAME probe loop as ``ops.hashtable.table_insert`` —
+    the table halves ride the kernel as whole-array refs initialized from
+    the input at grid step 0 and carried across the sequential grid, so a
+    later frontier block observes an earlier block's claims exactly like
+    the staged path's batch insert. Duplicate lanes die INSIDE the
+    kernel; only fresh-key lanes are compacted out to the queue append.
+
+Bit-identical by construction: the kernel does not reimplement any of the
+three stages — it traces the shared staged ops (``packed_step``,
+``fp64_device``, ``pre_dedup``, ``table_insert``) into one jaxpr and
+evaluates that jaxpr inside the kernel body (array constants the trace
+captures — fingerprint column keys, model lookup tables — become explicit
+kernel inputs; Pallas forbids captured array constants). Same fingerprint
+function, same bucket-probe invariant, same benign which-duplicate-wins
+race the staged path (and the reference's DashMap, `bfs.rs:198,206,268`)
+tolerates.
+
+The sharded engine fuses up to the all-to-all exchange boundary: children
+must route to their owner shard BEFORE the table probe, so its kernel
+(``probe=False``) fuses expand→fingerprint→pre-dedup and hands the
+surviving lanes to the existing exchange + probe stages.
+
+**Fallback contract** (`tpu_options(fused='auto' | True | False)`): the
+`axon` TPU backend is experimental and may fail to lower Pallas kernels
+(and CPU lowers them only through the interpreter). ``'auto'`` attempts
+the build via :func:`verify_build` (memoized per model-config/backend)
+and, on ANY failure, classifies the error through
+``checker.resilience.classify_error``, emits a ``fused_fallback`` trace
+event plus the ``fused_fallbacks`` metric, and runs the staged path —
+never a hard error. ``True`` forces the fused build (interpret mode off
+TPU — how the CPU tier-1 parity suite pins the kernel without hardware);
+``False`` forces staged. Combinations outside the support matrix
+(:func:`supports`: sound-eventually node keys, host-property history
+dedup, the per-row ``hint`` compaction) quietly stay staged under
+``'auto'`` and raise under ``True``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checker.device_loop import LruCache, model_cache_key
+from .expand import eventually_indices, expand_frontier, pre_dedup
+from .hashtable import _BUCKET, table_insert
+
+#: frontier rows per grid block: the largest of these dividing the step's
+#: frontier width (engine fmax values are 256-aligned; odd user fmax
+#: degrades to one block)
+_BLOCK_ROWS = (256, 128, 64, 32, 16, 8, 4, 2, 1)
+
+_BUILD_CACHE = LruCache(limit=32)
+_VERIFY_CACHE = LruCache(limit=64)
+
+
+class FusedUnavailable(RuntimeError):
+    """The fused kernel cannot be built/compiled for this config on this
+    backend (memoized so later runs skip the re-attempt). ``'auto'``
+    classifies and falls back; ``True`` surfaces it."""
+
+
+class FusedOut(NamedTuple):
+    """One fused step over a full ``fmax_b``-row frontier slice."""
+
+    pbits: jax.Array     # bool[F, P]    property bits per frontier row
+    ebits: jax.Array     # uint32[F]     eventually-bits after clearing
+    terminal: jax.Array  # bool[F]       rows with no valid action
+    flat: jax.Array      # uint32[F*A, W] children (action-major)
+    chi: jax.Array       # uint32[F*A]   child fp (canonical under sym)
+    clo: jax.Array
+    ohi: jax.Array       # uint32[F*A]   child ORIGINAL-state fp
+    olo: jax.Array
+    cvalid: jax.Array    # bool[F*A]     raw-valid child lanes
+    dvalid: jax.Array    # bool[F*A]     pre-dedup survivors
+    inserted: jax.Array  # bool[F*A]     fresh-key lanes (probe=True only;
+    #                                    aliases dvalid otherwise)
+    key_hi: jax.Array    # updated table halves (probe=True; inputs
+    key_lo: jax.Array    #                       passed through otherwise)
+    xovf: jax.Array      # bool[]   model capacity overflow
+    ovf: jax.Array       # bool[]   table probe overflow (probe=True)
+    rounds: jax.Array    # int32[]  probe rounds taken (probe=True)
+
+
+def supports(model, *, sound: bool, host_props: bool,
+             hint: int = 0) -> Optional[str]:
+    """``None`` when the fused path covers this configuration, else the
+    reason it stays staged (the README capability-matrix entries)."""
+    if sound:
+        return ("sound_eventually dedups on (state, ebits) node keys "
+                "and logs cross edges — staged only")
+    if host_props:
+        return ("host-evaluated properties need the in-loop history "
+                "dedup — staged only")
+    if hint:
+        return ("tpu_options(hint=...) selects the per-row staged "
+                "compaction — drop it to fuse")
+    return None
+
+
+def _block_rows(fmax_b: int) -> int:
+    return next(d for d in _BLOCK_ROWS if fmax_b % d == 0)
+
+
+def _staged_block(model, symmetry: bool, probe: bool, eventually_idx,
+                  width: int):
+    """The staged pipeline over ONE frontier block, as a pure function —
+    this is what gets traced into the kernel body, so the fused kernel is
+    the staged math by construction."""
+
+    def block(rows, ebits, fvalid, key_hi, key_lo):
+        # frontier fingerprints come from the engine's queue cache, not
+        # a re-hash — zeros keep the traced jaxpr free of the dead
+        # frontier-hash graph (the engines never read phi/plo here)
+        zero_pfp = (jnp.zeros_like(ebits), jnp.zeros_like(ebits))
+        exp = expand_frontier(model, rows, fvalid, ebits, eventually_idx,
+                              symmetry=symmetry, pfp=zero_pfp)
+        dvalid = pre_dedup(exp.chi, exp.clo, exp.cvalid)
+        if probe:
+            inserted, key_hi, key_lo, ovf, rounds = table_insert(
+                key_hi, key_lo, exp.chi, exp.clo, dvalid,
+                with_rounds=True)
+        else:
+            inserted = dvalid
+            ovf = jnp.bool_(False)
+            rounds = jnp.int32(0)
+        return (exp.pbits, exp.ebits, exp.terminal, exp.flat, exp.chi,
+                exp.clo, exp.ohi, exp.olo, exp.cvalid, dvalid, inserted,
+                key_hi, key_lo, exp.xovf, ovf, rounds)
+
+    return block
+
+
+def build_fused_block_fn(model, fmax_b: int, capacity: int, *,
+                         symmetry: bool = False, probe: bool = True,
+                         interpret: bool = True):
+    """Build (memoized) the fused step callable for fixed shapes.
+
+    Returns ``fn(frontier, ebits, fvalid, key_hi, key_lo) -> FusedOut``
+    (``key_hi``/``key_lo`` are the 2-D bucket-major table halves; pass
+    1-element dummies with ``probe=False``). The callable is traceable —
+    the engines embed it inside their chunk ``while_loop``.
+    """
+    mkey = model_cache_key(model)
+    key = None
+    if mkey is not None:
+        key = (mkey, fmax_b, capacity, symmetry, probe, interpret)
+        cached = _BUILD_CACHE.get(key)
+        if cached is not None:
+            return cached
+    fn = _build_fused_block_fn(model, fmax_b, capacity, symmetry, probe,
+                               interpret)
+    if key is not None:
+        _BUILD_CACHE[key] = fn
+    return fn
+
+
+def _build_fused_block_fn(model, fmax_b: int, capacity: int,
+                          symmetry: bool, probe: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    width = model.packed_width
+    n_actions = model.max_actions
+    properties = model.properties()
+    prop_count = len(properties)
+    eventually_idx = eventually_indices(properties)
+    fb = _block_rows(fmax_b)
+    nblk = fmax_b // fb
+    fab = fb * n_actions
+    n_buckets = capacity // _BUCKET if probe else 1
+
+    staged = _staged_block(model, symmetry, probe, eventually_idx, width)
+
+    # trace the staged block once at BLOCK shapes; captured array
+    # constants (fp column keys, model tables) become explicit inputs —
+    # Pallas kernels may not close over array constants
+    closed = jax.make_jaxpr(staged)(
+        jax.ShapeDtypeStruct((fb, width), jnp.uint32),
+        jax.ShapeDtypeStruct((fb,), jnp.uint32),
+        jax.ShapeDtypeStruct((fb,), jnp.bool_),
+        jax.ShapeDtypeStruct((n_buckets, _BUCKET), jnp.uint32),
+        jax.ShapeDtypeStruct((n_buckets, _BUCKET), jnp.uint32))
+    consts = [jnp.asarray(c) for c in closed.consts]
+    const_in = [c.reshape(1) if c.ndim == 0 else c for c in consts]
+    nc = len(consts)
+
+    def kernel(*refs):
+        (frontier_ref, ebits_ref, fvalid_ref, khi_in, klo_in) = refs[:5]
+        const_refs = refs[5:5 + nc]
+        (pb_ref, eb_ref, term_ref, flat_ref, chi_ref, clo_ref, ohi_ref,
+         olo_ref, cv_ref, dv_ref, ins_ref, khi_ref, klo_ref,
+         flags_ref) = refs[5 + nc:]
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            # the table rides the kernel: copied from the input halves
+            # once, then carried across the sequential grid so block
+            # k+1 probes against block k's claims (the staged batch
+            # insert's intra-batch visibility, by construction)
+            khi_ref[...] = khi_in[...]
+            klo_ref[...] = klo_in[...]
+            flags_ref[...] = jnp.zeros((4,), jnp.int32)
+
+        cs = [r[...].reshape(c.shape) for r, c in zip(const_refs, consts)]
+        (pbits, ebits2, terminal, flat, chi, clo, ohi, olo, cvalid,
+         dvalid, inserted, khi, klo, xovf, ovf, rounds) = \
+            jax.core.eval_jaxpr(
+                closed.jaxpr, cs, frontier_ref[...], ebits_ref[...],
+                fvalid_ref[...], khi_ref[...], klo_ref[...])
+        pb_ref[...] = pbits[:, :prop_count] if prop_count \
+            else jnp.zeros((fb, 1), jnp.bool_)
+        eb_ref[...] = ebits2
+        term_ref[...] = terminal
+        flat_ref[...] = flat
+        chi_ref[...] = chi
+        clo_ref[...] = clo
+        ohi_ref[...] = ohi
+        olo_ref[...] = olo
+        cv_ref[...] = cvalid
+        dv_ref[...] = dvalid
+        ins_ref[...] = inserted
+        khi_ref[...] = khi
+        klo_ref[...] = klo
+        flags = flags_ref[...]
+        flags_ref[...] = jnp.stack([
+            flags[0] | xovf.astype(jnp.int32),
+            flags[1] | ovf.astype(jnp.int32),
+            flags[2] + rounds,
+            flags[3]])
+
+    def full(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape))
+
+    tshape = (n_buckets, _BUCKET)
+    pcols = max(prop_count, 1)
+    call = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((fb, width), lambda i: (i, 0)),
+                  pl.BlockSpec((fb,), lambda i: (i,)),
+                  pl.BlockSpec((fb,), lambda i: (i,)),
+                  full(tshape), full(tshape)]
+                 + [full(c.shape) for c in const_in],
+        out_specs=[pl.BlockSpec((fb, pcols), lambda i: (i, 0)),
+                   pl.BlockSpec((fb,), lambda i: (i,)),
+                   pl.BlockSpec((fb,), lambda i: (i,)),
+                   pl.BlockSpec((fab, width), lambda i: (i, 0)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   pl.BlockSpec((fab,), lambda i: (i,)),
+                   full(tshape), full(tshape), full((4,))],
+        out_shape=[jax.ShapeDtypeStruct((fmax_b, pcols), jnp.bool_),
+                   jax.ShapeDtypeStruct((fmax_b,), jnp.uint32),
+                   jax.ShapeDtypeStruct((fmax_b,), jnp.bool_),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions, width),
+                                        jnp.uint32),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.uint32),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.uint32),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.uint32),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.uint32),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.bool_),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.bool_),
+                   jax.ShapeDtypeStruct((fmax_b * n_actions,),
+                                        jnp.bool_),
+                   jax.ShapeDtypeStruct(tshape, jnp.uint32),
+                   jax.ShapeDtypeStruct(tshape, jnp.uint32),
+                   jax.ShapeDtypeStruct((4,), jnp.int32)],
+        interpret=interpret,
+    )
+
+    dummy = jnp.zeros(tshape, jnp.uint32)
+
+    def fn(frontier, ebits, fvalid, key_hi=None, key_lo=None) -> FusedOut:
+        khi = key_hi if probe else dummy
+        klo = key_lo if probe else dummy
+        (pbits, ebits2, terminal, flat, chi, clo, ohi, olo, cvalid,
+         dvalid, inserted, khi, klo, flags) = call(
+            frontier, ebits.astype(jnp.uint32), fvalid, khi, klo,
+            *const_in)
+        if not probe:
+            khi, klo = key_hi, key_lo
+        return FusedOut(
+            pbits=pbits, ebits=ebits2, terminal=terminal, flat=flat,
+            chi=chi, clo=clo, ohi=ohi, olo=olo, cvalid=cvalid,
+            dvalid=dvalid, inserted=inserted, key_hi=khi, key_lo=klo,
+            xovf=flags[0] > 0, ovf=flags[1] > 0, rounds=flags[2])
+
+    return fn
+
+
+def verify_build(model, fmax: int, capacity: int, *, symmetry: bool,
+                 probe: bool, interpret: bool) -> None:
+    """The ``'auto'`` attempt: build the fused step at the run's real
+    shapes and force an end-to-end lower+compile of a standalone wrapper.
+    Raises on ANY failure (the caller classifies and falls back).
+    Success AND failure are memoized per (model config, shapes, backend)
+    so repeated runs neither re-pay the probe compile nor re-attempt a
+    known-bad build.
+    """
+    backend = jax.default_backend()
+    mkey = model_cache_key(model)
+    key = (mkey, fmax, capacity if probe else 0, symmetry, probe,
+           interpret, backend) if mkey is not None else None
+    if key is not None:
+        cached = _VERIFY_CACHE.get(key)
+        if cached is True:
+            return
+        if cached is not None:
+            raise FusedUnavailable(cached)
+    try:
+        fn = build_fused_block_fn(model, fmax, capacity,
+                                  symmetry=symmetry, probe=probe,
+                                  interpret=interpret)
+        width = model.packed_width
+        n_buckets = capacity // _BUCKET if probe else 1
+        tshape = jax.ShapeDtypeStruct((n_buckets, _BUCKET), jnp.uint32)
+        jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((fmax, width), jnp.uint32),
+            jax.ShapeDtypeStruct((fmax,), jnp.uint32),
+            jax.ShapeDtypeStruct((fmax,), jnp.bool_),
+            tshape, tshape).compile()
+    except Exception as exc:
+        if key is not None:
+            _VERIFY_CACHE[key] = (f"fused kernel build failed on "
+                                  f"{backend}: {type(exc).__name__}: "
+                                  f"{exc}")
+        raise
+    if key is not None:
+        _VERIFY_CACHE[key] = True

@@ -68,7 +68,8 @@ def _next_pow2(n: int) -> int:
     return 1 << max((n - 1).bit_length(), 0)
 
 
-def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
+def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096,
+                 with_rounds: bool = False):
     """Insert a batch of fingerprints.
 
     Args:
@@ -82,11 +83,15 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
       fhi, flo: uint32[N] fingerprints to insert.
       valid: bool[N]; invalid rows are ignored.
       max_rounds: probe-round bound; hitting it reports overflow.
+      with_rounds: also return the int32 probe-round count this insert
+        took (free — the loop carries already count rounds; feeds the
+        ``probe_rounds`` obs metric).
 
     Returns:
       (inserted bool[N], key_hi, key_lo, overflowed bool[]) — ``inserted``
       marks rows that claimed a fresh slot (first occurrence of a fingerprint
-      across the table's lifetime *and* within this batch).
+      across the table's lifetime *and* within this batch). With
+      ``with_rounds``, a trailing int32 rounds scalar rides along.
     """
     two_d = key_hi.ndim == 2
     if two_d:
@@ -176,10 +181,11 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
                 unres, ins, g, khi2, klo2, fhi, flo, token, claim_full)
             return unres, ins, g, khi2, klo2, rounds + 1
 
-        unres, inserted, _g, khi2, klo2, _r = lax.while_loop(
+        unres, inserted, _g, khi2, klo2, rounds0 = lax.while_loop(
             cond0, body0, (valid, jnp.zeros((n,), dtype=bool), group0,
                            khi2, klo2, jnp.int32(0)))
-        return (inserted,) + out_shape(khi2, klo2) + (unres.any(),)
+        out = (inserted,) + out_shape(khi2, klo2) + (unres.any(),)
+        return out + (rounds0,) if with_rounds else out
 
     # --- round 1 at full width -----------------------------------------
     inserted = jnp.zeros((n,), dtype=bool)
@@ -235,12 +241,17 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096):
             unres, ins, g, khi2, klo2, fhi, flo, token, claim_full)
         return unres, ins, g, khi2, klo2, rounds + 1
 
-    unres3, inserted, _g, khi2, klo2, _r = lax.while_loop(
+    unres3, inserted, _g, khi2, klo2, rounds3 = lax.while_loop(
         cond3, body3,
         (unresolved & ~narrow_ok, inserted, group, khi2, klo2,
          jnp.int32(1)))
     overflowed = (unres2 & (rounds2 >= max_rounds)).any() | unres3.any()
-    return (inserted,) + out_shape(khi2, klo2) + (overflowed,)
+    out = (inserted,) + out_shape(khi2, klo2) + (overflowed,)
+    if with_rounds:
+        # rounds executed: the width-1 round + the narrow loop (counter
+        # seeded at 1) + the rare full-width fallback (likewise)
+        out = out + (1 + (rounds2 - 1) + (rounds3 - 1),)
+    return out
 
 
 def plan_insert_host(fps, capacity: int):

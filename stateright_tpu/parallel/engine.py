@@ -204,6 +204,12 @@ class ShardedTpuChecker(TpuChecker):
                     insert_fn, carry.key_hi, carry.key_lo, table_fps, D)
                 carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
 
+        # fused Pallas kernel selection (ops/fused.py): the sharded step
+        # fuses expand→fingerprint→pre-dedup up to the exchange boundary
+        fused_on, fused_interp = self._fused_resolve(
+            sharded=True, fmax=fmax, capacity=0)
+        self._metrics.set("fused", 1 if fused_on else 0)
+
         def rebuild_chunk(reason: str = "initial"):
             self._metrics.inc("compiles")
             if self._trace:
@@ -211,7 +217,8 @@ class ShardedTpuChecker(TpuChecker):
             return build_sharded_chunk_fn(
                 model, mesh, axis, qcap, self._capacity, fmax, kmax,
                 symmetry=self._symmetry, sound=self._sound, kraw=kraw,
-                exchange=exchange, kb=kb, ecap=ecap)
+                exchange=exchange, kb=kb, ecap=ecap, fused=fused_on,
+                fused_interpret=fused_interp)
 
         chunk_fn = rebuild_chunk()
         pipeline = bool(opts.get("pipeline", True))
@@ -294,10 +301,14 @@ class ShardedTpuChecker(TpuChecker):
                                    steps=jnp.int32(k_steps),
                                    vmax=jnp.int32(0),
                                    dmax=jnp.int32(0),
-                                   bmax=jnp.int32(0))
+                                   bmax=jnp.int32(0),
+                                   pdh=jnp.int32(0),
+                                   prb=jnp.int32(0))
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit)
             self._metrics.inc("chunks")
+            if fused_on:
+                self._metrics.inc("fused_chunks")
             inflight.append((int(self._metrics.get("chunks")), stats_d,
                              int(grow_limit)))
 
@@ -324,7 +335,9 @@ class ShardedTpuChecker(TpuChecker):
             vmax = int(stats[3 * D + 4])
             dmax = int(stats[3 * D + 5])
             bmax = int(stats[3 * D + 6])
-            base = 3 * D + 7
+            pdh = int(stats[3 * D + 7])
+            prb = int(stats[3 * D + 8])
+            base = 3 * D + 9
             disc_hit = stats[base:base + prop_count].astype(bool)
             disc_hi = stats[base + prop_count:base + 2 * prop_count]
             disc_lo = stats[base + 2 * prop_count:base + 3 * prop_count]
@@ -390,6 +403,12 @@ class ShardedTpuChecker(TpuChecker):
             metrics = self._metrics
             metrics.observe_max("vmax", vmax)
             metrics.observe_max("dmax", dmax)
+            # dedup telemetry: chunk-local (reset at dispatch, so a
+            # zero-iteration speculative chunk contributes 0)
+            if pdh:
+                metrics.inc("predup_hits", pdh)
+            if prb:
+                metrics.inc("probe_rounds", prb)
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen
@@ -931,7 +950,9 @@ class ShardedTpuChecker(TpuChecker):
             bmax=jax.device_put(np.int32(0), rep),
             steps=jax.device_put(steps, rep),
             go=jax.device_put(np.bool_(False), rep),
-            pavail=jax.device_put(np.int32(0), rep))
+            pavail=jax.device_put(np.int32(0), rep),
+            pdh=jax.device_put(np.int32(0), rep),
+            prb=jax.device_put(np.int32(0), rep))
         return new_carry, new_qcap
 
     # ------------------------------------------------------------------

@@ -139,6 +139,11 @@ class ShardedCarry(NamedTuple):
     #                              shard — the two-size loop windows key
     #                              on it so every shard takes the same
     #                              sized step
+    pdh: jax.Array      # int32[]  replicated: duplicate lanes killed by
+    #                              the in-batch pre-dedup this chunk
+    #                              (psum across shards; obs predup_hits)
+    prb: jax.Array      # int32[]  replicated: visited-table probe
+    #                              rounds this chunk (obs probe_rounds)
 
 
 def _owner_bits(d: int) -> int:
@@ -162,7 +167,8 @@ def carry_specs(axis: str) -> ShardedCarry:
         q=s, q_head=s, q_tail=s, key_hi=s, key_lo=s, log=s, log_n=s,
         elog=s, e_n=s,
         disc_hit=r, disc_hi=r, disc_lo=r, gen=r, ovf=r, xovf=r,
-        kovf=r, vmax=r, dmax=r, bmax=r, steps=r, go=r, pavail=r)
+        kovf=r, vmax=r, dmax=r, bmax=r, steps=r, go=r, pavail=r,
+        pdh=r, prb=r)
 
 
 from ..checker.device_loop import LruCache as _LruCache
@@ -174,7 +180,9 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                            capacity: int, fmax: int, kmax: int,
                            symmetry: bool = False, sound: bool = False,
                            kraw: int = 0, exchange: str = "ring",
-                           kb: int = 0, ecap: int = 0):
+                           kb: int = 0, ecap: int = 0,
+                           fused: bool = False,
+                           fused_interpret: bool = False):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
@@ -198,13 +206,15 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     key = None
     if mkey is not None:
         key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax, kmax,
-               symmetry, sound, kraw, exchange, kb, ecap)
+               symmetry, sound, kraw, exchange, kb, ecap, fused,
+               fused_interpret)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
                                  fmax, kmax, symmetry, sound, kraw,
-                                 exchange, kb, ecap)
+                                 exchange, kb, ecap, fused,
+                                 fused_interpret)
     if key is not None:
         _SHARDED_CACHE[key] = fn
     return fn
@@ -215,8 +225,14 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                             symmetry: bool = False,
                             sound: bool = False, kraw: int = 0,
                             exchange: str = "ring", kb: int = 0,
-                            ecap: int = 0):
+                            ecap: int = 0, fused: bool = False,
+                            fused_interpret: bool = False):
     from ..checker.device_loop import shrink_indices
+    if fused:
+        # the sharded fusion boundary is the exchange: expand, hash and
+        # pre-dedup run in one kernel; probe/append stay staged on the
+        # owner shard (ops/fused.py supports() keeps sound staged)
+        assert not sound, "fused sharded build outside its support matrix"
 
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
@@ -288,6 +304,18 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         return go
 
     def make_step(fmax_b: int, kraw_b: int, kfin_b: int):
+      if fused:
+        from ..ops.expand import Expansion
+        from ..ops.fused import build_fused_block_fn
+        fused_blk = build_fused_block_fn(
+            model, fmax_b, 0, symmetry=symmetry, probe=False,
+            interpret=fused_interpret)
+        # the kernel's in-register dedup subsumes the kraw staging: the
+        # stage-two compaction (and the kovf abort, still pre-mutation
+        # here — the probe runs after the exchange) works off the raw
+        # F*A lane masks
+        kraw_b = fmax_b * n_actions
+
       def step(state):
         c, target_remaining, grow_limit = state
         me = lax.axis_index(axis).astype(jnp.uint32)
@@ -302,58 +330,96 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         pfp = (sl[:, width + 1], sl[:, width + 2])
         fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
-        # shared check_block analog (ops/expand.py) on local rows; the
-        # frontier fingerprints come from the queue cache, not a re-hash,
-        # and child fingerprints are deferred to the narrow buffer below
-        exp = expand_frontier(model, frontier, fvalid, ebits,
-                              eventually_idx, symmetry=symmetry, pfp=pfp,
-                              child_fp=False)
-        cvalid = exp.cvalid
-        gen_count = cvalid.sum(dtype=jnp.int32)
-        vcount = gen_count
-
-        if sound:
-            p_whi, p_wlo = fp64_node_device(exp.phi, exp.plo, ebits)
-        else:
+        if fused:
+            # fused front-end (ops/fused.py): ONE Pallas kernel expands,
+            # fingerprints and pre-dedups this shard's frontier block in
+            # register — the staged exchange/probe below consumes its
+            # lane masks directly
+            fout = fused_blk(frontier, ebits, fvalid)
+            exp = Expansion(pbits=fout.pbits, ebits=fout.ebits,
+                            flat=fout.flat, avalid=None,
+                            cvalid=fout.cvalid, chi=None, clo=None,
+                            ohi=None, olo=None, phi=pfp[0], plo=pfp[1],
+                            terminal=fout.terminal, xovf=fout.xovf)
+            cvalid = fout.cvalid
+            gen_count = cvalid.sum(dtype=jnp.int32)
+            vcount = gen_count
             p_whi, p_wlo = exp.phi, exp.plo
-
-        # local discovery candidates; the cross-shard selection rides
-        # the fused collectives below (idempotent: safe under kovf
-        # re-expansion)
-        disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
-        if prop_count:
-            hit_l, cand_hi, cand_lo = discovery_candidates(
-                properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
-            # pmax of (D-1 - shard) selects the LOWEST-indexed shard
-            # with a hit; -1 encodes "no hit anywhere"
-            negsel = jnp.where(hit_l, jnp.int32(D - 1) - me_i,
-                               jnp.int32(-1))
-        else:
-            negsel = jnp.zeros((0,), jnp.int32)
-
-        # stage one: compact raw-valid lanes to the kraw buffer; hash
-        # (and canonicalize, under symmetry) and in-batch dedup there —
-        # local duplicates never enter the ring
-        src = shrink_indices(cvalid, kraw_b)
-        rvalid = jnp.arange(kraw_b, dtype=jnp.int32) < vcount
-        rows_k = exp.flat[src]
-        ridx = src // n_actions
-        if symmetry:
-            canon = jax.vmap(model.packed_representative)
-            s_chi, s_clo = fp64_device(canon(rows_k))
-            o_hi, o_lo = fp64_device(rows_k)
-        else:
-            s_chi, s_clo = fp64_device(rows_k)
-            o_hi, o_lo = s_chi, s_clo
-        par3 = jnp.stack([exp.ebits, p_whi, p_wlo], axis=1)[ridx]
-        ebits_k = par3[:, 0]
-        if sound:
-            # dedup/routing identity under sound = node keys
-            k_chi, k_clo = fp64_node_device(s_chi, s_clo, ebits_k)
-            dvalid = rvalid
-        else:
-            dvalid = pre_dedup(s_chi, s_clo, rvalid)
+            disc_hit, disc_hi, disc_lo = (c.disc_hit, c.disc_hi,
+                                          c.disc_lo)
+            if prop_count:
+                hit_l, cand_hi, cand_lo = discovery_candidates(
+                    properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
+                negsel = jnp.where(hit_l, jnp.int32(D - 1) - me_i,
+                                   jnp.int32(-1))
+            else:
+                negsel = jnp.zeros((0,), jnp.int32)
+            rows_k = fout.flat
+            rvalid = cvalid
+            s_chi, s_clo = fout.chi, fout.clo
+            o_hi, o_lo = fout.ohi, fout.olo
+            # parent-side columns broadcast along the action axis
+            par3 = jnp.repeat(
+                jnp.stack([fout.ebits, p_whi, p_wlo], axis=1),
+                n_actions, axis=0)
+            ebits_k = par3[:, 0]
+            dvalid = fout.dvalid
             k_chi, k_clo = s_chi, s_clo
+        else:
+            # shared check_block analog (ops/expand.py) on local rows;
+            # the frontier fingerprints come from the queue cache, not a
+            # re-hash, and child fingerprints are deferred to the narrow
+            # buffer below
+            exp = expand_frontier(model, frontier, fvalid, ebits,
+                                  eventually_idx, symmetry=symmetry,
+                                  pfp=pfp, child_fp=False)
+            cvalid = exp.cvalid
+            gen_count = cvalid.sum(dtype=jnp.int32)
+            vcount = gen_count
+
+            if sound:
+                p_whi, p_wlo = fp64_node_device(exp.phi, exp.plo, ebits)
+            else:
+                p_whi, p_wlo = exp.phi, exp.plo
+
+            # local discovery candidates; the cross-shard selection
+            # rides the fused collectives below (idempotent: safe under
+            # kovf re-expansion)
+            disc_hit, disc_hi, disc_lo = (c.disc_hit, c.disc_hi,
+                                          c.disc_lo)
+            if prop_count:
+                hit_l, cand_hi, cand_lo = discovery_candidates(
+                    properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
+                # pmax of (D-1 - shard) selects the LOWEST-indexed shard
+                # with a hit; -1 encodes "no hit anywhere"
+                negsel = jnp.where(hit_l, jnp.int32(D - 1) - me_i,
+                                   jnp.int32(-1))
+            else:
+                negsel = jnp.zeros((0,), jnp.int32)
+
+            # stage one: compact raw-valid lanes to the kraw buffer;
+            # hash (and canonicalize, under symmetry) and in-batch dedup
+            # there — local duplicates never enter the ring
+            src = shrink_indices(cvalid, kraw_b)
+            rvalid = jnp.arange(kraw_b, dtype=jnp.int32) < vcount
+            rows_k = exp.flat[src]
+            ridx = src // n_actions
+            if symmetry:
+                canon = jax.vmap(model.packed_representative)
+                s_chi, s_clo = fp64_device(canon(rows_k))
+                o_hi, o_lo = fp64_device(rows_k)
+            else:
+                s_chi, s_clo = fp64_device(rows_k)
+                o_hi, o_lo = s_chi, s_clo
+            par3 = jnp.stack([exp.ebits, p_whi, p_wlo], axis=1)[ridx]
+            ebits_k = par3[:, 0]
+            if sound:
+                # dedup/routing identity under sound = node keys
+                k_chi, k_clo = fp64_node_device(s_chi, s_clo, ebits_k)
+                dvalid = rvalid
+            else:
+                dvalid = pre_dedup(s_chi, s_clo, rvalid)
+                k_chi, k_clo = s_chi, s_clo
         dcount = dvalid.sum(dtype=jnp.int32)
         if bucket:
             # exact per-destination counts (the dedup key's top bits
@@ -432,9 +498,9 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 sendbuf.reshape(D, kb, -1), axis, split_axis=0,
                 concat_axis=0, tiled=True).reshape(D * kb, -1)
             mine = recv[:, -1] == 1
-            inserted, key_hi, key_lo, t_ovf = table_insert(
+            inserted, key_hi, key_lo, t_ovf, prb_it = table_insert(
                 key_hi, key_lo, recv[:, log_off], recv[:, log_off + 1],
-                mine)
+                mine, with_rounds=True)
             cnt = inserted.sum(dtype=jnp.int32)
             if sound and eloc:
                 # cross edges for the lasso sweep: dedup hits whose
@@ -460,12 +526,14 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             # claims and dedups the in-flight children it owns, then
             # forwards the buffer
             rc = (k_all, kvalid, owner)
+            prb_it = jnp.int32(0)
             for hop in range(D):
                 k_c, val_c, own_c = rc
                 mine = val_c & (own_c == me)
-                inserted, key_hi, key_lo, o = table_insert(
+                inserted, key_hi, key_lo, o, rnds = table_insert(
                     key_hi, key_lo, k_c[:, log_off],
-                    k_c[:, log_off + 1], mine)
+                    k_c[:, log_off + 1], mine, with_rounds=True)
+                prb_it = prb_it + rnds
                 t_ovf = t_ovf | o
                 cnt = inserted.sum(dtype=jnp.int32)
                 if sound and eloc:
@@ -497,21 +565,28 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         pavail, max_tail, max_log, max_e = pm2[0], pm2[1], pm2[2], pm2[3]
         ovf = c.ovf | ((pm2[4] > 0) & ~kovf)
         xovf = c.xovf | xovf_any
+        pdh_it = vcount - dcount  # in-batch duplicate lanes this shard
         if prop_count:
             ps = lax.psum(jnp.concatenate([
-                jnp.stack([gen_count.astype(jnp.uint32)]),
+                jnp.stack([gen_count, pdh_it,
+                           prb_it]).astype(jnp.uint32),
                 jnp.where(pick, cand_hi, jnp.uint32(0)),
                 jnp.where(pick, cand_lo, jnp.uint32(0))]), axis)
             gen_sum = ps[0].astype(jnp.int32)
-            g_hi = ps[1:1 + prop_count]
-            g_lo = ps[1 + prop_count:1 + 2 * prop_count]
+            pdh_sum = ps[1].astype(jnp.int32)
+            prb_sum = ps[2].astype(jnp.int32)
+            g_hi = ps[3:3 + prop_count]
+            g_lo = ps[3 + prop_count:3 + 2 * prop_count]
             keep = disc_hit | ~g_hit
             disc_hi = jnp.where(keep, disc_hi, g_hi)
             disc_lo = jnp.where(keep, disc_lo, g_lo)
             disc_hit = disc_hit | g_hit
         else:
-            gen_sum = lax.psum(gen_count, axis)
+            ps = lax.psum(jnp.stack([gen_count, pdh_it, prb_it]), axis)
+            gen_sum, pdh_sum, prb_sum = ps[0], ps[1], ps[2]
         gen = c.gen + jnp.where(kovf, 0, gen_sum)
+        pdh = c.pdh + jnp.where(kovf, 0, pdh_sum)
+        prb = c.prb + jnp.where(kovf, 0, prb_sum)
         vmax = jnp.maximum(c.vmax, vshard)
         dmax = jnp.maximum(c.dmax, dshard)
         bmax_c = jnp.maximum(c.bmax, bshard)
@@ -526,7 +601,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             elog=elog, e_n=e_n[None],
             disc_hit=disc_hit, disc_hi=disc_hi, disc_lo=disc_lo,
             gen=gen, ovf=ovf, xovf=xovf, kovf=kovf, vmax=vmax,
-            dmax=dmax, bmax=bmax_c, steps=steps, go=go, pavail=pavail)
+            dmax=dmax, bmax=bmax_c, steps=steps, go=go, pavail=pavail,
+            pdh=pdh, prb=prb)
         return (nc, target_remaining, grow_limit)
       return step
 
@@ -569,7 +645,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # ONE replicated sync vector for everything the host reads per
         # chunk (layout parsed by parallel/engine.py — keep in sync):
         # [q_head[D], q_tail[D], log_n[D],
-        #  gen, ovf, xovf, kovf, vmax, dmax, bmax,
+        #  gen, ovf, xovf, kovf, vmax, dmax, bmax, pdh, prb,
         #  disc_hit[P], disc_hi[P], disc_lo[P], e_n[D]]
         hs = lax.all_gather(out.q_head, axis, tiled=True)
         ts = lax.all_gather(out.q_tail, axis, tiled=True)
@@ -583,7 +659,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                        out.xovf.astype(jnp.int32),
                        out.kovf.astype(jnp.int32),
                        out.vmax, out.dmax,
-                       out.bmax]).astype(jnp.uint32),
+                       out.bmax, out.pdh,
+                       out.prb]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo, es.astype(jnp.uint32)])
         return out, stats
@@ -811,7 +888,7 @@ def seed_sharded_carry(model, mesh: Mesh, axis: str, qcap: int,
                 disc_hi=jnp.zeros((prop_count,), jnp.uint32),
                 disc_lo=jnp.zeros((prop_count,), jnp.uint32),
                 gen=z, ovf=f, xovf=f, kovf=f, vmax=z, dmax=z, bmax=z,
-                steps=z, go=f, pavail=z)
+                steps=z, go=f, pavail=z, pdh=z, prb=z)
 
         s = P(axis)
         fn = jax.jit(shard_map_compat(

@@ -752,6 +752,38 @@ class TestBenchContract:
         assert set(payload["pipeline"]) == {"on", "off"}
         assert payload["value"] is not None
         assert "partial" not in payload
+        assert "init_fallback" not in payload  # backend came up clean
+        # every workload row carries the fusion-proxy ratio and the
+        # dedup-path tag, so the trajectory can't silently mix paths
+        rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+                if ln.startswith("{")]
+        samples = [r for r in rows if "samples" in r]
+        assert samples
+        for row in samples:
+            assert "fused" in row, row["workload"]
+            assert row["gen_per_uniq"] is None \
+                or row["gen_per_uniq"] >= 1.0
+
+    def test_backend_init_failure_falls_back_to_cpu(self):
+        # ROADMAP item 3's hole (BENCH_r05: rc=1, no artifact, because
+        # platform INIT raised before per-workload isolation): an
+        # unusable configured backend must be classified, fall back to
+        # CPU, run the matrix, and still land a tagged contract line
+        env = dict(os.environ, JAX_PLATFORMS="definitely_not_a_backend")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert payload["backend"] == "cpu"
+        assert payload["init_fallback"] is True
+        assert payload["init_cause"]  # classified, not just recorded
+        assert payload["value"] is not None  # the matrix actually ran
+        rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+                if ln.startswith("{")]
+        fb = [r for r in rows if r.get("workload") == "backend"]
+        assert fb and fb[0]["fallback"] == "cpu"
 
     def test_forced_failure_still_lands_artifact(self):
         proc = _run_bench("--inject-fault")

@@ -139,7 +139,7 @@ def report(events, out=sys.stdout):
         inters = [e for e in evs if e["ev"] in
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
-                   "degrade")]
+                   "degrade", "fused_fallback")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
@@ -171,6 +171,16 @@ def report(events, out=sys.stdout):
                 parts.append(
                     f"final_mesh={degrades[-1]['to_shards']}")
             out.write("\nresilience: " + " ".join(parts) + "\n")
+
+        # fused-kernel summary: which path the run took, and why a
+        # fused='auto' attempt fell back (the classified cause)
+        fb = [e for e in evs if e["ev"] == "fused_fallback"]
+        if fb:
+            causes = sorted({e.get("cause", "?") for e in fb})
+            out.write(f"\nfused: fallbacks={len(fb)} "
+                      f"causes={causes} "
+                      f"(staged path ran; first error: "
+                      f"{fb[0].get('error', '?')!r})\n")
 
         for ev in evs:
             if ev["ev"] == "discovery":
