@@ -51,7 +51,10 @@ after one unrecorded warm-up run that pays the compile-cache load.
 Flags: ``--smoke`` shrinks every cap for a seconds-scale CPU run (the
 contract-line schema test in tests/test_resilience.py); ``--inject-fault``
 forces every device workload to die with a fake transient backend error
-(pins the partial-contract shape end to end).
+(pins the partial-contract shape end to end); ``--soak-smoke`` runs the
+chaos soak harness (tools/soak.py) against the real actor runtime and
+emits a soak contract line (ops/s, faults injected, ``history_ok``)
+under the same crash-proof contract — no device required.
 """
 
 from __future__ import annotations
@@ -235,10 +238,64 @@ def _ensure_backend() -> str:
             raise
 
 
+def _soak_smoke() -> None:
+    """``--soak-smoke``: a seconds-scale chaos soak of the REAL actor
+    runtime (tools/soak.py — no device, no JAX) emitting its own
+    contract line under the same crash-proof contract as the checker
+    workloads: ops/s, the injected-fault counts, and the history
+    cross-check verdict, printed from a ``finally`` path with
+    ``"partial"``/``"failed"`` on any error, rc=0 regardless."""
+    import importlib.util
+    import os
+
+    contract = {
+        "metric": "soak write_once ops/sec (live chaos, "
+                  "linearizability cross-checked)",
+        "value": None,
+        "unit": "ops/s",
+        "history_ok": None,
+        "faults": None,
+    }
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "soak", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools", "soak.py"))
+        soak = importlib.util.module_from_spec(spec)
+        # register before exec: @dataclass resolves annotations through
+        # sys.modules[cls.__module__]
+        sys.modules["soak"] = soak
+        spec.loader.exec_module(soak)
+        res = soak.run_soak(soak.SoakConfig(
+            protocol="write_once", ops=250, clients=3, seed=7,
+            loss=0.03, duplicate=0.03, delay=0.1, crashes=1,
+            partitions=1, op_timeout=0.2, deadline=30.0))
+        contract["value"] = res["ops_per_s"]
+        contract["history_ok"] = res["history_ok"]
+        contract["op_timeouts"] = res["op_timeouts"]
+        contract["faults"] = {k: res[k] for k in (
+            "crashes", "restarts", "dropped", "duplicated", "delayed",
+            "reordered", "partitions")}
+        if not res["history_ok"]:
+            contract["artifact"] = res["artifact"]
+            FAILED.append("soak-history")
+    except BaseException as exc:
+        print(json.dumps({"workload": "soak", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("soak")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
 def main() -> None:
     global N, SMOKE, INJECT_FAULT
     SMOKE = "--smoke" in sys.argv
     INJECT_FAULT = "--inject-fault" in sys.argv
+    if "--soak-smoke" in sys.argv:
+        _soak_smoke()
+        return
     if SMOKE:
         N = 1
     # the contract line is assembled as the run progresses and ALWAYS

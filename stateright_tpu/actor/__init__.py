@@ -7,6 +7,7 @@ implementations are exhaustively model-checked through :class:`ActorModel`
 sockets via :func:`spawn` — the framework's signature dual use.
 """
 
+from .chaos import ChaosNetwork, ChaosSocket
 from .core import (Actor, CancelTimer, Envelope, Id, Out, ScriptedActor,
                    Send, SetTimer, is_no_op, majority, model_peers,
                    model_timeout, peer_ids)
@@ -17,10 +18,10 @@ from .packed import PackedActorModel
 from .runtime import SpawnHandle, spawn
 
 __all__ = [
-    "Actor", "ActorModel", "ActorModelState", "CancelTimer", "Deliver",
-    "Drop", "Envelope", "Id", "Network", "Ordered", "Out",
-    "PackedActorModel", "ScriptedActor", "Send", "SetTimer",
-    "SpawnHandle", "Timeout", "UnorderedDuplicating",
-    "UnorderedNonDuplicating", "is_no_op", "majority", "model_peers",
-    "model_timeout", "peer_ids", "spawn",
+    "Actor", "ActorModel", "ActorModelState", "CancelTimer",
+    "ChaosNetwork", "ChaosSocket", "Deliver", "Drop", "Envelope", "Id",
+    "Network", "Ordered", "Out", "PackedActorModel", "ScriptedActor",
+    "Send", "SetTimer", "SpawnHandle", "Timeout",
+    "UnorderedDuplicating", "UnorderedNonDuplicating", "is_no_op",
+    "majority", "model_peers", "model_timeout", "peer_ids", "spawn",
 ]

@@ -1,0 +1,289 @@
+"""Chaos network layer: seeded fault injection on the UDP runtime path.
+
+The model checker makes faults first-class *in the model* (lossy/
+duplicating networks, ``crash_restart``); this module makes the same
+vocabulary first-class *at runtime* so the spawned cluster can be soaked
+under production-style faults and its recorded history cross-checked
+against the identical consistency semantics (README § Soak testing,
+Jepsen-style fault-injected history checking).
+
+:class:`ChaosNetwork` wraps each actor's UDP socket (via
+``spawn(..., chaos=...)``, or :meth:`ChaosNetwork.wrap` for client
+sockets) and intercepts the send path with seeded, per-link decisions:
+
+* **loss** — the datagram is silently dropped (the runtime's
+  fire-and-forget contract already tolerates this);
+* **duplication** — a second copy is delivered later through the delay
+  scheduler (duplicates that also reorder, the adversarial flavor);
+* **delay/reorder** — delivery is deferred by a background scheduler;
+  a deferred datagram overtaken by a later direct send on the same link
+  counts as ``reordered``;
+* **partitions** — :meth:`set_partition` installs id groups; links that
+  cross groups drop every datagram until :meth:`heal`.
+
+Every decision draws from a per-(src, dst)-link ``random.Random`` stream
+derived from the cluster seed with integer mixing (stable under any
+``PYTHONHASHSEED``), so a soak schedule is reproducible: same seed, same
+per-link fault pattern. All three decision draws happen on every send —
+the stream stays aligned when knobs change, so turning a fault off does
+not reshuffle the others.
+
+Counters ride an :class:`~stateright_tpu.obs.Metrics` registry
+(``dropped``/``duplicated``/``delayed``/``reordered``/``partitions`` —
+obs GLOSSARY) and partition flips emit ``partition`` trace events when a
+:class:`~stateright_tpu.obs.RunTrace` is attached.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from random import Random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..obs import Metrics, NULL_TRACE
+from .core import Id
+
+#: default extra latency for delayed/duplicated datagrams (seconds)
+DEFAULT_DELAY_RANGE = (0.0005, 0.01)
+
+
+def _id_of(addr: Tuple[str, int]) -> Id:
+    ip = tuple(int(b) for b in addr[0].split("."))
+    return Id.from_socket_addr(ip, addr[1])
+
+
+class _Link:
+    """Per-(src, dst) fault state: the seeded decision stream plus the
+    sequence bookkeeping behind the ``reordered`` counter."""
+
+    __slots__ = ("rng", "next_seq", "last_direct")
+
+    def __init__(self, rng: Random):
+        self.rng = rng
+        self.next_seq = 0       # per-link send sequence numbers
+        self.last_direct = -1   # highest seq delivered without delay
+
+
+class ChaosSocket:
+    """A UDP socket shim: ``sendto`` goes through the chaos layer,
+    everything else (``recvfrom``, ``settimeout``, ``close``, ...)
+    delegates to the wrapped socket."""
+
+    __slots__ = ("_net", "_id", "_sock")
+
+    def __init__(self, net: "ChaosNetwork", id: Id, sock):
+        self._net = net
+        self._id = id
+        self._sock = sock
+
+    def sendto(self, data: bytes, addr: Tuple[str, int]) -> int:
+        return self._net.send(self._id, self._sock, data, addr)
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+class ChaosNetwork:
+    """Seeded fault injector for the UDP runtime (see module docstring).
+
+    ``loss``/``duplicate``/``delay`` are global per-datagram
+    probabilities; :meth:`set_link` overrides them for one directed
+    link. ``delay_range`` bounds the extra latency of delayed and
+    duplicated deliveries. Call :meth:`close` when the cluster stops —
+    it flushes the delay scheduler (pending datagrams are delivered
+    immediately, best-effort) and joins its thread.
+    """
+
+    def __init__(self, seed: int = 0, loss: float = 0.0,
+                 duplicate: float = 0.0, delay: float = 0.0,
+                 delay_range: Tuple[float, float] = DEFAULT_DELAY_RANGE,
+                 metrics: Optional[Metrics] = None,
+                 trace: Any = None):
+        self.seed = int(seed)
+        self.loss = float(loss)
+        self.duplicate = float(duplicate)
+        self.delay = float(delay)
+        self.delay_range = tuple(delay_range)
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._trace = trace if trace is not None else NULL_TRACE
+        self._lock = threading.Lock()
+        self._links: Dict[Tuple[int, int], _Link] = {}
+        self._overrides: Dict[Tuple[int, int], Dict[str, float]] = {}
+        self._groups: Optional[Dict[int, int]] = None  # id -> group ix
+        # delay scheduler: heap of (due, tiebreak, link_key, seq, sock,
+        # data, addr) drained by a lazy daemon thread
+        self._heap: List[tuple] = []
+        self._cv = threading.Condition(self._lock)
+        self._pump: Optional[threading.Thread] = None
+        self._tiebreak = 0
+        self._closed = False
+
+    # --- wiring -----------------------------------------------------------
+    def wrap(self, id, sock) -> ChaosSocket:
+        """Wrap a bound UDP socket so its sends are fault-injected as
+        actor ``id`` (used by ``spawn(..., chaos=...)`` for cluster
+        actors and directly by soak drivers for client sockets)."""
+        return ChaosSocket(self, Id(id), sock)
+
+    def _link(self, key: Tuple[int, int]) -> _Link:
+        link = self._links.get(key)
+        if link is None:
+            src, dst = key
+            mixed = ((self.seed * 0x9E3779B1)
+                     ^ (src * 0x85EBCA6B) ^ (dst * 0xC2B2AE35)) \
+                & 0xFFFFFFFFFFFF
+            link = self._links[key] = _Link(Random(mixed))
+        return link
+
+    def set_link(self, src, dst, loss: Optional[float] = None,
+                 duplicate: Optional[float] = None,
+                 delay: Optional[float] = None) -> None:
+        """Override the global fault probabilities for one directed
+        link (``None`` keeps the global value)."""
+        over = {}
+        if loss is not None:
+            over["loss"] = float(loss)
+        if duplicate is not None:
+            over["duplicate"] = float(duplicate)
+        if delay is not None:
+            over["delay"] = float(delay)
+        with self._lock:
+            self._overrides[(int(src), int(dst))] = over
+
+    # --- partitions -------------------------------------------------------
+    def set_partition(self, groups: Sequence[Sequence[Any]]) -> None:
+        """Install a partition: ids in different groups cannot exchange
+        datagrams; ids in no group are unaffected (they reach everyone).
+        Replaces any existing partition."""
+        mapping: Dict[int, int] = {}
+        shape = []
+        for ix, group in enumerate(groups):
+            ids = sorted(int(i) for i in group)
+            shape.append(ids)
+            for i in ids:
+                mapping[i] = ix
+        with self._lock:
+            self._groups = mapping
+        self.metrics.inc("partitions")
+        if self._trace:
+            self._trace.emit("partition", groups=shape)
+
+    def heal(self) -> None:
+        """Remove the partition (all links flow again)."""
+        with self._lock:
+            self._groups = None
+        if self._trace:
+            self._trace.emit("partition", groups=[])
+
+    def allows(self, src, dst) -> bool:
+        """Whether the current partition lets ``src`` reach ``dst``."""
+        groups = self._groups
+        if groups is None:
+            return True
+        a = groups.get(int(src))
+        b = groups.get(int(dst))
+        return a is None or b is None or a == b
+
+    # --- the send path ----------------------------------------------------
+    def send(self, src: Id, sock, data: bytes,
+             addr: Tuple[str, int]) -> int:
+        dst = _id_of(addr)
+        key = (int(src), int(dst))
+        with self._lock:
+            link = self._link(key)
+            rng = link.rng
+            # always draw all three decisions so the per-link stream
+            # stays aligned across knob settings
+            r_loss, r_dup, r_delay = (rng.random(), rng.random(),
+                                      rng.random())
+            over = self._overrides.get(key, {})
+            loss = over.get("loss", self.loss)
+            duplicate = over.get("duplicate", self.duplicate)
+            delay = over.get("delay", self.delay)
+            seq = link.next_seq
+            link.next_seq += 1
+            if not self.allows(src, dst):
+                self.metrics.inc("dropped")
+                return len(data)
+            if r_loss < loss:
+                self.metrics.inc("dropped")
+                return len(data)
+            delayed = r_delay < delay
+            extra = rng.uniform(*self.delay_range)
+            dup_extra = rng.uniform(*self.delay_range)
+            if delayed:
+                self.metrics.inc("delayed")
+                self._schedule(time.monotonic() + extra, key, seq, sock,
+                               data, addr)
+            if r_dup < duplicate:
+                # the duplicate rides the scheduler: it arrives later
+                # (and possibly out of order), the adversarial flavor
+                self.metrics.inc("duplicated")
+                self._schedule(time.monotonic() + dup_extra, key,
+                               link.next_seq, sock, data, addr)
+                link.next_seq += 1
+        if not delayed:
+            n = sock.sendto(data, addr)
+            with self._lock:
+                if seq > link.last_direct:
+                    link.last_direct = seq
+            return n
+        return len(data)
+
+    # --- delay scheduler --------------------------------------------------
+    def _schedule(self, due: float, key, seq, sock, data, addr) -> None:
+        # caller holds self._lock
+        self._tiebreak += 1
+        heapq.heappush(self._heap,
+                       (due, self._tiebreak, key, seq, sock, data, addr))
+        if self._pump is None:
+            self._pump = threading.Thread(target=self._pump_loop,
+                                          daemon=True,
+                                          name="chaos-delayer")
+            self._pump.start()
+        self._cv.notify()
+
+    def _pump_loop(self) -> None:
+        with self._cv:
+            while True:
+                if self._closed and not self._heap:
+                    return
+                if not self._heap:
+                    self._cv.wait(0.2)
+                    continue
+                due = self._heap[0][0]
+                now = time.monotonic()
+                if due > now and not self._closed:
+                    self._cv.wait(min(due - now, 0.2))
+                    continue
+                (_due, _tb, key, seq, sock, data,
+                 addr) = heapq.heappop(self._heap)
+                link = self._links.get(key)
+                if link is not None and link.last_direct > seq:
+                    # a later send on this link already landed: this
+                    # deferred delivery arrives out of order
+                    self.metrics.inc("reordered")
+                self._cv.release()
+                try:
+                    sock.sendto(data, addr)
+                except OSError:
+                    pass  # the source socket died (crash): drop
+                finally:
+                    self._cv.acquire()
+
+    def close(self) -> None:
+        """Flush pending deliveries (best-effort, immediately) and stop
+        the scheduler thread."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._pump is not None:
+            self._pump.join(2.0)
+            self._pump = None
+
+    # --- read side --------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        """The fault counters recorded so far (obs GLOSSARY keys)."""
+        return self.metrics.snapshot()

@@ -8,6 +8,20 @@ the timer, fire-and-forget datagrams, pluggable serde functions (JSON in
 the examples). Reliability/ordering are layered on via
 :mod:`stateright_tpu.actor.ordered_reliable_link`, exactly as in the
 reference.
+
+Fault-injection surface (the chaos soak harness, README § Soak testing):
+
+* ``spawn(..., chaos=ChaosNetwork(...))`` routes every actor's sends
+  through a seeded fault layer (loss, duplication, delay/reorder,
+  partitions — :mod:`stateright_tpu.actor.chaos`);
+* ``SpawnHandle.crash(id)`` tears down ONE actor thread, capturing its
+  :meth:`Actor.durable` projection exactly like the modeled ``Crash``
+  action; ``SpawnHandle.restart(id)`` reboots it through
+  :meth:`Actor.on_restart` — the runtime twin of
+  ``ActorModel.crash_restart``;
+* ``spawn(..., seed=N)`` derives a private per-actor RNG stream for
+  timer jitter (precedent: ``tpu_options(retry_seed=)``), so soak runs
+  and timer tests are deterministic under any ``PYTHONHASHSEED``.
 """
 
 from __future__ import annotations
@@ -17,7 +31,7 @@ import random
 import socket as socket_mod
 import threading
 import time
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .core import Actor, CancelTimer, Id, Out, Send, SetTimer, is_no_op
 
@@ -30,6 +44,41 @@ def _practically_never() -> float:
     return time.monotonic() + _PRACTICALLY_NEVER
 
 
+def cluster_rng(seed: Optional[int], id: Id):
+    """The per-actor RNG used for timer jitter: a private stream derived
+    from the cluster seed and the actor id (stable across processes and
+    ``PYTHONHASHSEED`` — the mix avoids tuple/str hashing). ``seed=None``
+    keeps the legacy behavior: the process-global ``random`` module."""
+    if seed is None:
+        return random
+    return random.Random(((seed * 0x9E3779B1) ^ (int(id) * 0x85EBCA6B))
+                         & 0xFFFFFFFFFFFF)
+
+
+class _ActorCell:
+    """Control block for one spawned actor: its thread, a private stop
+    signal (so ``crash`` can tear down ONE actor while the cluster keeps
+    running), the latest state published by the loop, and the durable
+    projection captured at crash time."""
+
+    __slots__ = ("id", "actor", "serialize", "deserialize", "chaos",
+                 "rng", "stop", "thread", "state", "durable", "crashed")
+
+    def __init__(self, id: Id, actor: Actor, serialize, deserialize,
+                 chaos, rng):
+        self.id = id
+        self.actor = actor
+        self.serialize = serialize
+        self.deserialize = deserialize
+        self.chaos = chaos
+        self.rng = rng
+        self.stop = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+        self.state: Any = None
+        self.durable: Any = None
+        self.crashed = False
+
+
 class SpawnHandle:
     """Join handle for a spawned actor cluster.
 
@@ -38,14 +87,22 @@ class SpawnHandle:
     the daemon thread: they are recorded per actor and re-raised from
     :meth:`join`/:meth:`stop`, so a cluster that failed to come up reads
     as a failure, not a hang.
+
+    :meth:`crash`/:meth:`restart` inject the live twin of the modeled
+    crash–restart fault: a crash joins the actor's thread (closing its
+    socket) and captures ``actor.durable(id, state)``; a restart reboots
+    it on the same address through ``actor.on_restart(id, durable)``.
     """
 
-    def __init__(self, threads: List[threading.Thread],
+    def __init__(self, cells: List[_ActorCell],
                  stop_event: threading.Event,
                  failures: List[Tuple[Id, BaseException]]):
-        self._threads = threads
+        self._cells: Dict[Id, _ActorCell] = {c.id: c for c in cells}
         self._stop = stop_event
         self._failures = failures
+
+    def actor_ids(self) -> List[Id]:
+        return list(self._cells)
 
     def failures(self) -> List[Tuple[Id, BaseException]]:
         """(actor id, exception) pairs for threads that died on an
@@ -67,10 +124,12 @@ class SpawnHandle:
         """Block until the actors exit (they normally never do); raises
         if any actor thread died on an unhandled error."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        for t in self._threads:
+        for cell in self._cells.values():
+            if cell.thread is None:
+                continue
             remaining = None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
-            t.join(remaining)
+            cell.thread.join(remaining)
         self._raise_failures()
 
     def stop(self) -> None:
@@ -81,109 +140,181 @@ class SpawnHandle:
         self._stop.set()
         self.join(timeout=2.0)
 
+    # --- live crash–restart (the runtime twin of Crash/Restart) ---------
+    def crash(self, id) -> Any:
+        """Tear down one actor thread, capturing and returning its
+        :meth:`Actor.durable` projection (``None`` for the default
+        fail-stop actor). The actor's socket closes with the thread; its
+        address stays reserved for :meth:`restart`."""
+        cell = self._cells[Id(id)]
+        if cell.crashed:
+            raise ValueError(f"actor {int(cell.id)} is already down")
+        cell.stop.set()
+        if cell.thread is not None:
+            cell.thread.join(2.0)
+            if cell.thread.is_alive():
+                raise RuntimeError(
+                    f"actor {int(cell.id)} did not stop within 2s")
+        cell.durable = cell.actor.durable(cell.id, cell.state)
+        cell.crashed = True
+        log.info("Actor crashed. id=%s, durable=%r", int(cell.id),
+                 cell.durable)
+        return cell.durable
 
-def _actor_thread(id: Id, actor: Actor,
-                  serialize: Callable[[Any], bytes],
-                  deserialize: Callable[[bytes], Any],
-                  stop: threading.Event,
-                  failures: List[Tuple[Id, BaseException]]) -> None:
+    def restart(self, id) -> None:
+        """Reboot a crashed actor on its original address through
+        :meth:`Actor.on_restart` with the durable projection captured by
+        :meth:`crash` — exactly the modeled ``Restart`` action."""
+        cell = self._cells[Id(id)]
+        if not cell.crashed:
+            raise ValueError(f"actor {int(cell.id)} is not down")
+        cell.stop = threading.Event()
+        cell.crashed = False
+        t = threading.Thread(
+            target=_actor_thread,
+            args=(cell, self._stop, self._failures, "restart"),
+            daemon=True,
+            name=f"actor-{int(cell.id)}")
+        cell.thread = t
+        t.start()
+        log.info("Actor restarted. id=%s", int(cell.id))
+
+
+def _actor_thread(cell: _ActorCell, cluster_stop: threading.Event,
+                  failures: List[Tuple[Id, BaseException]],
+                  boot: str = "start") -> None:
     try:
-        _actor_loop(id, actor, serialize, deserialize, stop)
+        _actor_loop(cell, cluster_stop, boot)
     except Exception as e:
         # surface the failure on the SpawnHandle (raised from
         # join()/stop()) instead of dying silently in a daemon thread
-        log.error("Actor thread failed. id=%s, err=%r", int(id), e)
-        failures.append((id, e))
+        log.error("Actor thread failed. id=%s, err=%r", int(cell.id), e)
+        failures.append((cell.id, e))
 
 
-def _actor_loop(id: Id, actor: Actor,
-                serialize: Callable[[Any], bytes],
-                deserialize: Callable[[bytes], Any],
-                stop: threading.Event) -> None:
+def _actor_loop(cell: _ActorCell, cluster_stop: threading.Event,
+                boot: str) -> None:
+    id, actor = cell.id, cell.actor
+    serialize, deserialize = cell.serialize, cell.deserialize
     ip, port = id.socket_addr()
     addr = (".".join(map(str, ip)), port)
-    sock = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
-    sock.bind(addr)
-    next_interrupt = _practically_never()
+    raw = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    try:
+        raw.bind(addr)
+        # chaos shim: sends go through the fault layer; reads and
+        # timeouts delegate to the raw socket
+        sock = raw if cell.chaos is None else cell.chaos.wrap(id, raw)
+        next_interrupt = _practically_never()
 
-    def on_command(command) -> None:
-        nonlocal next_interrupt
-        if isinstance(command, Send):
-            dst_ip, dst_port = command.dst.socket_addr()
-            try:
-                data = serialize(command.msg)
-            except Exception as e:  # mirror "ignore and log" semantics
-                log.warning("Unable to serialize. Ignoring. id=%s, msg=%r, "
-                            "err=%r", addr, command.msg, e)
-                return
-            log.info("Sending. id=%s, dst=%s:%s, msg=%r",
-                     addr, dst_ip, dst_port, command.msg)
-            sock.sendto(data, (".".join(map(str, dst_ip)), dst_port))
-        elif isinstance(command, SetTimer):
-            # random jitter within the range, as in spawn.rs:168-180
-            duration = random.uniform(command.min_seconds,
-                                      command.max_seconds)
-            next_interrupt = time.monotonic() + duration
-        elif isinstance(command, CancelTimer):
-            next_interrupt = _practically_never()
-        else:
-            raise TypeError(f"unknown command {command!r}")
+        def on_command(command) -> None:
+            nonlocal next_interrupt
+            if isinstance(command, Send):
+                dst_ip, dst_port = command.dst.socket_addr()
+                try:
+                    data = serialize(command.msg)
+                except Exception as e:  # mirror "ignore and log"
+                    log.warning("Unable to serialize. Ignoring. id=%s, "
+                                "msg=%r, err=%r", addr, command.msg, e)
+                    return
+                log.info("Sending. id=%s, dst=%s:%s, msg=%r",
+                         addr, dst_ip, dst_port, command.msg)
+                try:
+                    sock.sendto(data,
+                                (".".join(map(str, dst_ip)), dst_port))
+                except OSError as e:
+                    # a transient send failure (EMSGSIZE, unreachable,
+                    # buffer pressure) follows the runtime's "ignore and
+                    # log" semantics instead of killing the actor thread
+                    log.warning("Unable to send. Ignoring. id=%s, "
+                                "dst=%s:%s, err=%r", addr, dst_ip,
+                                dst_port, e)
+            elif isinstance(command, SetTimer):
+                # random jitter within the range, as in spawn.rs:168-180
+                # (a private seeded stream under spawn(..., seed=))
+                duration = cell.rng.uniform(command.min_seconds,
+                                            command.max_seconds)
+                next_interrupt = time.monotonic() + duration
+            elif isinstance(command, CancelTimer):
+                next_interrupt = _practically_never()
+            else:
+                raise TypeError(f"unknown command {command!r}")
 
-    out = Out()
-    state = actor.on_start(id, out)
-    log.info("Actor started. id=%s, state=%r, out=%r", addr, state, out)
-    for c in out:
-        on_command(c)
-
-    while not stop.is_set():
         out = Out()
-        max_wait = next_interrupt - time.monotonic()
-        if max_wait > 0:
-            # wait for a message (bounded so stop() stays responsive)
-            sock.settimeout(min(max_wait, 0.2))
-            try:
-                data, src_addr = sock.recvfrom(65535)
-            except socket_mod.timeout:
-                continue
-            except OSError as e:
-                log.warning("Unable to read socket. Ignoring. id=%s, "
-                            "err=%r", addr, e)
-                continue
-            try:
-                msg = deserialize(data)
-            except Exception as e:
-                log.debug("Unable to parse message. Ignoring. id=%s, "
-                          "src=%s, buf=%r, err=%r", addr, src_addr, data, e)
-                continue
-            src_ip = tuple(int(b) for b in src_addr[0].split("."))
-            src = Id.from_socket_addr(src_ip, src_addr[1])
-            log.info("Received message. id=%s, src=%s, msg=%r",
-                     addr, src_addr, msg)
-            next_state = actor.on_msg(id, state, src, msg, out)
+        if boot == "restart":
+            state = actor.on_restart(id, cell.durable, out)
+            log.info("Actor rebooted. id=%s, state=%r, out=%r",
+                     addr, state, out)
         else:
-            next_interrupt = _practically_never()  # timer consumed
-            next_state = actor.on_timeout(id, state, out)
-
-        if not is_no_op(next_state, out):
-            log.debug("Acted. id=%s, state=%r, out=%r", addr, state, out)
-        if next_state is not None:
-            state = next_state
+            state = actor.on_start(id, out)
+            log.info("Actor started. id=%s, state=%r, out=%r",
+                     addr, state, out)
+        cell.state = state
         for c in out:
             on_command(c)
+
+        while not (cluster_stop.is_set() or cell.stop.is_set()):
+            out = Out()
+            max_wait = next_interrupt - time.monotonic()
+            if max_wait > 0:
+                # wait for a message (bounded so stop()/crash() stay
+                # responsive)
+                sock.settimeout(min(max_wait, 0.2))
+                try:
+                    data, src_addr = sock.recvfrom(65535)
+                except socket_mod.timeout:
+                    continue
+                except OSError as e:
+                    log.warning("Unable to read socket. Ignoring. id=%s, "
+                                "err=%r", addr, e)
+                    continue
+                try:
+                    msg = deserialize(data)
+                except Exception as e:
+                    log.debug("Unable to parse message. Ignoring. id=%s, "
+                              "src=%s, buf=%r, err=%r", addr, src_addr,
+                              data, e)
+                    continue
+                src_ip = tuple(int(b) for b in src_addr[0].split("."))
+                src = Id.from_socket_addr(src_ip, src_addr[1])
+                log.info("Received message. id=%s, src=%s, msg=%r",
+                         addr, src_addr, msg)
+                next_state = actor.on_msg(id, state, src, msg, out)
+            else:
+                next_interrupt = _practically_never()  # timer consumed
+                next_state = actor.on_timeout(id, state, out)
+
+            if not is_no_op(next_state, out):
+                log.debug("Acted. id=%s, state=%r, out=%r",
+                          addr, state, out)
+            if next_state is not None:
+                state = next_state
+                cell.state = state
+            for c in out:
+                on_command(c)
+    finally:
+        # every exit path (stop, crash, unhandled error) releases the
+        # port — repeated spawn/stop or crash/restart cycles must not
+        # exhaust fds or wedge the address
+        raw.close()
 
 
 def spawn(serialize: Callable[[Any], bytes],
           deserialize: Callable[[bytes], Any],
           actors: Sequence[Tuple[Any, Actor]],
-          background: bool = False) -> SpawnHandle:
+          background: bool = False,
+          seed: Optional[int] = None,
+          chaos: Any = None) -> SpawnHandle:
     """Run actors over UDP, one thread each (`spawn.rs:63-140`).
 
     ``actors`` pairs an :class:`Id` (or ``((ip, port))`` tuple) with an
     actor. Blocks forever unless ``background=True``, in which case the
-    returned handle's ``stop()`` tears the cluster down.
+    returned handle's ``stop()`` tears the cluster down. ``seed`` makes
+    timer jitter deterministic (a private per-actor RNG stream);
+    ``chaos`` routes sends through a
+    :class:`~stateright_tpu.actor.chaos.ChaosNetwork` fault layer.
     """
     stop = threading.Event()
-    threads: List[threading.Thread] = []
+    cells: List[_ActorCell] = []
     failures: List[Tuple[Id, BaseException]] = []
     for raw_id, actor in actors:
         if isinstance(raw_id, Id):
@@ -191,14 +322,17 @@ def spawn(serialize: Callable[[Any], bytes],
         else:
             ip, port = raw_id
             id = Id.from_socket_addr(tuple(ip), port)
+        cell = _ActorCell(id, actor, serialize, deserialize, chaos,
+                          cluster_rng(seed, id))
         t = threading.Thread(
             target=_actor_thread,
-            args=(id, actor, serialize, deserialize, stop, failures),
+            args=(cell, stop, failures),
             daemon=True,
             name=f"actor-{int(id)}")
+        cell.thread = t
         t.start()
-        threads.append(t)
-    handle = SpawnHandle(threads, stop, failures)
+        cells.append(cell)
+    handle = SpawnHandle(cells, stop, failures)
     if not background:
         handle.join()
     return handle

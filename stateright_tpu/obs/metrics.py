@@ -96,6 +96,33 @@ GLOSSARY: Dict[str, str] = {
                     "the run (claim-retry pressure: rising rounds per "
                     "chunk mean duplicate lanes or load factor are "
                     "stressing the open-addressed table)",
+    # --- soak harness (actor/chaos.py + tools/soak.py) ----------------
+    "ops": "client operations completed (returned) during a soak run "
+           "against the spawned UDP cluster",
+    "op_timeouts": "client operations that timed out awaiting a reply "
+                   "and were abandoned (the op stays in-flight in the "
+                   "recorded history; the client retires that logical "
+                   "thread id)",
+    "crashes": "live actor crash injections taken "
+               "(SpawnHandle.crash: thread torn down, durable() "
+               "projection captured)",
+    "restarts": "live actor restarts taken (SpawnHandle.restart: "
+                "reboot through on_restart with the captured durable "
+                "projection)",
+    "dropped": "datagrams dropped by the chaos layer (seeded loss plus "
+               "partition suppression)",
+    "duplicated": "datagrams duplicated by the chaos layer (the copy "
+                  "rides the delay scheduler)",
+    "delayed": "datagrams deferred by the chaos layer's delay "
+               "scheduler",
+    "reordered": "deferred datagrams delivered after a later-sent "
+                 "datagram on the same link had already landed",
+    "partitions": "partition episodes installed "
+                  "(ChaosNetwork.set_partition)",
+    "history_ok": "1 when the recorded runtime history passed the "
+                  "consistency cross-check (LinearizabilityTester / "
+                  "SequentialConsistencyTester), 0 when it was "
+                  "rejected (a dumped seed artifact reproduces it)",
     # --- observed maxima (buffer autotuning inputs) -------------------
     "vmax": "max raw-valid candidate lanes in one iteration (sizes "
             "kraw; compare against fmax*max_actions)",

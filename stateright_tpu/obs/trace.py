@@ -88,6 +88,17 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # classification of the build failure (transient / capacity /
     # programming), `error` the underlying message
     "fused_fallback": frozenset({"cause", "error"}),
+    # chaos soak harness (actor/chaos.py + tools/soak.py): live
+    # crash/restart of one spawned actor (the runtime twin of the
+    # modeled Crash/Restart), a partition flip (groups=[] on heal), a
+    # periodic op-counter summary (op_invoke/op_return cumulative
+    # counts — per-op events would flood the stream), and the soak
+    # verdict with the history cross-check result
+    "crash": frozenset({"actor"}),
+    "restart": frozenset({"actor"}),
+    "partition": frozenset({"groups"}),
+    "ops": frozenset({"op_invoke", "op_return", "op_timeouts"}),
+    "soak_done": frozenset({"ops", "history_ok"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

@@ -13,6 +13,7 @@ host, not per state on device.
 """
 
 from .core import ConsistencyTester, SequentialSpec
+from .history import HistoryRecorder, RecordedHistory
 from .linearizability import LinearizabilityTester
 from .register import Read, ReadOk, Register, Write, WriteOk
 from .sequential_consistency import SequentialConsistencyTester
@@ -20,8 +21,9 @@ from .vec import Len, LenOk, Pop, PopOk, Push, PushOk, VecSpec
 from .write_once_register import WORegister, WriteFail
 
 __all__ = [
-    "ConsistencyTester", "LinearizabilityTester", "Len", "LenOk", "Pop",
-    "PopOk", "Push", "PushOk", "Read", "ReadOk", "Register",
-    "SequentialConsistencyTester", "SequentialSpec", "VecSpec",
-    "WORegister", "Write", "WriteFail", "WriteOk",
+    "ConsistencyTester", "HistoryRecorder", "LinearizabilityTester",
+    "Len", "LenOk", "Pop", "PopOk", "Push", "PushOk", "Read", "ReadOk",
+    "RecordedHistory", "Register", "SequentialConsistencyTester",
+    "SequentialSpec", "VecSpec", "WORegister", "Write", "WriteFail",
+    "WriteOk",
 ]

@@ -1,0 +1,198 @@
+"""Runtime history adapter: record a *real* execution's operation
+history and replay it through the SAME consistency testers the model
+checker uses.
+
+The checker records invoke/return pairs via the ``record_msg_out``/
+``record_msg_in`` hooks while enumerating the model; the soak harness
+(``tools/soak.py``) records them from live client threads driving a
+spawned UDP cluster. Both feed the identical
+:class:`~stateright_tpu.semantics.LinearizabilityTester` /
+:class:`~stateright_tpu.semantics.SequentialConsistencyTester`
+semantics (Herlihy & Wing), closing the loop between "model checked"
+and "serves real traffic": a runtime history the tester rejects is a
+real consistency violation, dumped as a reproducible seed artifact.
+
+Pieces:
+
+* :class:`HistoryRecorder` — thread-safe invoke/return recording; the
+  append order under the lock IS the real-time order the tester's
+  per-thread ``last_completed`` bookkeeping needs. Clients that abandon
+  a timed-out operation must retire that logical thread id (the op
+  stays in flight forever — linearizability permits an incomplete op to
+  take effect or not) and continue under a fresh one; see
+  :meth:`HistoryRecorder.abandon`.
+* :class:`RecordedHistory` — an immutable event list with JSONL
+  (de)serialization over the register op vocabulary and
+  :meth:`replay`/:meth:`check` against any tester. ``check`` raises the
+  recursion limit for the serialization search: the tester recurses
+  once per serialized operation, and soak histories run to thousands of
+  ops (far past the default 1000-frame limit).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Any, Iterable, List, Optional, Tuple
+
+from .register import Read, ReadOk, Write, WriteOk
+from .write_once_register import WriteFail
+
+#: recorded event: ("inv", thread_id, op) or ("ret", thread_id, ret)
+Event = Tuple[str, Any, Any]
+
+
+# --- op/ret wire encoding (register vocabulary) -----------------------------
+
+def op_to_json(op: Any) -> list:
+    if isinstance(op, Write):
+        return ["W", op.value]
+    if isinstance(op, Read):
+        return ["R"]
+    if isinstance(op, WriteOk):
+        return ["WOk"]
+    if isinstance(op, WriteFail):
+        return ["WFail"]
+    if isinstance(op, ReadOk):
+        return ["ROk", op.value]
+    raise TypeError(f"unknown op/return {op!r}")
+
+
+def op_from_json(data: list) -> Any:
+    tag = data[0]
+    if tag == "W":
+        return Write(data[1])
+    if tag == "R":
+        return Read()
+    if tag == "WOk":
+        return WriteOk()
+    if tag == "WFail":
+        return WriteFail()
+    if tag == "ROk":
+        return ReadOk(data[1])
+    raise ValueError(f"unknown op tag in {data!r}")
+
+
+class HistoryRecorder:
+    """Thread-safe operation-history recorder for live client threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[Event] = []
+        self.invoked = 0
+        self.returned = 0
+        self.abandoned = 0
+
+    def invoke(self, thread_id: Any, op: Any) -> None:
+        with self._lock:
+            self._events.append(("inv", thread_id, op))
+            self.invoked += 1
+
+    def ret(self, thread_id: Any, ret: Any) -> None:
+        with self._lock:
+            self._events.append(("ret", thread_id, ret))
+            self.returned += 1
+
+    def abandon(self, thread_id: Any) -> None:
+        """Mark a timed-out operation abandoned: no event is recorded
+        (the op stays in flight), but the caller must not reuse
+        ``thread_id`` — the tester rejects a second in-flight op on the
+        same thread."""
+        with self._lock:
+            self.abandoned += 1
+
+    def completed(self) -> int:
+        return self.returned
+
+    def history(self) -> "RecordedHistory":
+        with self._lock:
+            return RecordedHistory(list(self._events))
+
+
+class RecordedHistory:
+    """An ordered invoke/return event list from a real execution."""
+
+    def __init__(self, events: Iterable[Event]):
+        self._events: List[Event] = list(events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self) -> List[Event]:
+        return list(self._events)
+
+    # --- the cross-check --------------------------------------------------
+    def replay(self, tester):
+        """Feed the events into ``tester`` in recorded (real-time)
+        order; returns the tester, or ``None`` if the event stream
+        itself is malformed (double in-flight, return without invoke —
+        a recorder bug or a corrupt artifact, not a consistency
+        verdict)."""
+        try:
+            for kind, thread_id, payload in self._events:
+                if kind == "inv":
+                    tester.on_invoke(thread_id, payload)
+                else:
+                    tester.on_return(thread_id, payload)
+        except ValueError:
+            return None
+        return tester
+
+    def check(self, tester) -> bool:
+        """Replay into ``tester`` and run its consistency search. The
+        recursion limit is raised to cover the search's one-frame-per-
+        serialized-op depth on long soak histories."""
+        replayed = self.replay(tester)
+        if replayed is None:
+            return False
+        need = 4 * len(self._events) + 1000
+        old = sys.getrecursionlimit()
+        if need > old:
+            sys.setrecursionlimit(need)
+        try:
+            return replayed.is_consistent()
+        finally:
+            if need > old:
+                sys.setrecursionlimit(old)
+
+    # --- artifact (de)serialization ---------------------------------------
+    def to_jsonl(self, meta: Optional[dict] = None) -> str:
+        """JSONL artifact: an optional ``{"meta": ...}`` header line,
+        then one ``{"k", "th", "v"}`` line per event. Thread ids must be
+        JSON-serializable (the soak driver uses strings)."""
+        lines = []
+        if meta is not None:
+            lines.append(json.dumps({"meta": meta},
+                                    separators=(",", ":")))
+        for kind, thread_id, payload in self._events:
+            lines.append(json.dumps(
+                {"k": kind, "th": thread_id, "v": op_to_json(payload)},
+                separators=(",", ":")))
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> Tuple[Optional[dict],
+                                            "RecordedHistory"]:
+        """Inverse of :meth:`to_jsonl`; returns ``(meta, history)``."""
+        meta = None
+        events: List[Event] = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if "meta" in obj and "k" not in obj:
+                meta = obj["meta"]
+                continue
+            events.append((obj["k"], obj["th"], op_from_json(obj["v"])))
+        return meta, cls(events)
+
+    def dump(self, path, meta: Optional[dict] = None) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_jsonl(meta))
+
+    @classmethod
+    def load(cls, path) -> Tuple[Optional[dict], "RecordedHistory"]:
+        with open(path) as f:
+            return cls.from_jsonl(f.read())

@@ -125,8 +125,17 @@ class LinearizabilityTester(ConsistencyTester):
         remaining = {
             t: [(i, entry) for i, entry in enumerate(h)]
             for t, h in self._history.items()}
+        # Wing&Gong-style dead-configuration memo (Lowe's optimization):
+        # the search below a node depends only on (spec state, per-thread
+        # progress, in-flight set), never on the path that reached it, so
+        # a configuration that once failed can be pruned on revisit. This
+        # is what makes REJECTING a long runtime soak history tractable —
+        # the naive search must exhaust every interleaving of the valid
+        # prefix before concluding "not linearizable". Only usable when
+        # the spec has value equality (same `cacheable` condition).
+        failed = set() if cacheable else None
         result = _serialize([], self._init, remaining,
-                            dict(self._in_flight))
+                            dict(self._in_flight), failed)
         if cacheable:
             if len(_SERIALIZATION_CACHE) >= _CACHE_MAX:
                 _SERIALIZATION_CACHE.clear()
@@ -147,9 +156,30 @@ def _violates_realtime(last_completed: dict, remaining: dict) -> bool:
     return False
 
 
-def _serialize(valid_history, ref_obj, remaining, in_flight):
+#: dead-configuration memo cap (soak histories are long; a runaway
+#: search should degrade to the naive behavior, not exhaust memory)
+_FAILED_MAX = 1 << 20
+
+
+def _config_key(ref_obj, remaining, in_flight):
+    return (ref_obj,
+            tuple(sorted((t, h[0][0] if h else -1)
+                         for t, h in remaining.items())),
+            frozenset(in_flight))
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight,
+               failed=None):
     if all(not h for h in remaining.values()):
         return valid_history
+    key = None
+    if failed is not None:
+        # (spec, per-thread next index, in-flight threads) pins the
+        # whole subtree: in_flight entries only ever *leave* the dict,
+        # so the thread set identifies their content
+        key = _config_key(ref_obj, remaining, in_flight)
+        if key in failed:
+            return None
     for thread_id in list(remaining):
         history = remaining[thread_id]
         if not history:
@@ -176,7 +206,9 @@ def _serialize(valid_history, ref_obj, remaining, in_flight):
             branch_remaining[thread_id] = history[1:]
             branch_in_flight = in_flight
         result = _serialize(valid_history + [(op, ret)], obj,
-                            branch_remaining, branch_in_flight)
+                            branch_remaining, branch_in_flight, failed)
         if result is not None:
             return result
+    if key is not None and len(failed) < _FAILED_MAX:
+        failed.add(key)
     return None

@@ -95,8 +95,12 @@ class SequentialConsistencyTester(ConsistencyTester):
             if hit is not _MISS:
                 return None if hit is None else list(hit)
         remaining = {t: list(h) for t, h in self._history.items()}
+        # dead-configuration memo (see the linearizability tester): the
+        # subtree depends only on (spec state, per-thread suffix length,
+        # in-flight threads), so failed configurations prune on revisit
+        failed = set() if cacheable else None
         result = _serialize([], self._init, remaining,
-                            dict(self._in_flight))
+                            dict(self._in_flight), failed)
         if cacheable:
             if len(_SERIALIZATION_CACHE) >= _CACHE_MAX:
                 _SERIALIZATION_CACHE.clear()
@@ -105,9 +109,23 @@ class SequentialConsistencyTester(ConsistencyTester):
         return result
 
 
-def _serialize(valid_history, ref_obj, remaining, in_flight):
+#: dead-configuration memo cap (matches the linearizability tester)
+_FAILED_MAX = 1 << 20
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight,
+               failed=None):
     if all(not h for h in remaining.values()):
         return valid_history
+    key = None
+    if failed is not None:
+        # each thread's remaining list is a suffix of its original, so
+        # its length pins the position; in-flight entries only leave
+        key = (ref_obj,
+               tuple(sorted((t, len(h)) for t, h in remaining.items())),
+               frozenset(in_flight))
+        if key in failed:
+            return None
     for thread_id in list(remaining):
         history = remaining[thread_id]
         if not history:
@@ -128,7 +146,9 @@ def _serialize(valid_history, ref_obj, remaining, in_flight):
             branch_remaining[thread_id] = history[1:]
             branch_in_flight = in_flight
         result = _serialize(valid_history + [(op, ret)], obj,
-                            branch_remaining, branch_in_flight)
+                            branch_remaining, branch_in_flight, failed)
         if result is not None:
             return result
+    if key is not None and len(failed) < _FAILED_MAX:
+        failed.add(key)
     return None

@@ -10,10 +10,15 @@ pseudo-random digraphs (fixed seeds — deterministic in CI):
   implies the DFS engine also reports one, and every reported trace both
   replays and genuinely never satisfies the property;
 * device engine: reached-set parity with host BFS, in both modes (a few
-  cases only — each random graph compiles a fresh device program).
+  cases only — each random graph compiles a fresh device program);
+* soak seed corpus: rejected-history artifacts dumped by the chaos soak
+  harness (``tools/soak.py``) replay as regressions — the consistency
+  cross-check must keep rejecting every committed ``soak_seeds/*.jsonl``.
 """
 
+import os
 import random
+import sys
 
 import pytest
 
@@ -86,6 +91,41 @@ class TestHostFuzz:
         if default.discovery("odd") is not None:
             assert sound.discovery("odd") is not None, \
                 f"seed {seed}: sound mode lost a default-mode discovery"
+
+
+_SOAK_SEEDS = sorted(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "soak_seeds", name)
+    for name in os.listdir(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "soak_seeds"))
+    if name.endswith(".jsonl"))
+
+
+@pytest.mark.faults
+class TestSoakSeedCorpus:
+    """Rejected-history seed artifacts dumped by the chaos soak harness
+    (tools/soak.py) replay as regressions: each committed corpus entry
+    captured a REAL runtime consistency violation (e.g. the volatile
+    write-once server losing an acknowledged write across a live
+    crash–restart), and the cross-check must keep rejecting it — a
+    tester change that starts accepting one of these histories has
+    broken the semantics, not fixed the bug."""
+
+    @pytest.mark.parametrize(
+        "path", _SOAK_SEEDS, ids=[os.path.basename(p)
+                                  for p in _SOAK_SEEDS])
+    def test_seed_artifact_still_rejected(self, path):
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        sys.path.insert(0, tools)
+        try:
+            import soak
+        finally:
+            sys.path.pop(0)
+        verdicts = soak.check_artifact(path)
+        assert verdicts, f"empty artifact {path}"
+        assert not any(verdicts.values()), \
+            f"{path}: history now ACCEPTED by {verdicts}"
 
 
 @pytest.mark.slow

@@ -239,7 +239,7 @@ class TestOrlWrappedTimers:
             [(Id.from_socket_addr(loop, base),
               ActorWrapper(TickProducer(receiver_id, 2),
                            resend_interval=(0.2, 0.3)))],
-            background=True)
+            background=True, seed=17)  # deterministic timer jitter
         try:
             got = {}
             deadline = time.monotonic() + 5.0

@@ -1,0 +1,465 @@
+"""Chaos soak harness: live fault injection on the UDP runtime + the
+linearizability cross-check of recorded histories.
+
+Tier-1 keeps a fast deterministic smoke (loopback, a few hundred ops,
+seconds-scale); the acceptance-criteria grids (≥2k client ops under
+loss + duplication + partition + repeated crash–restart, write-once AND
+ABD) ride under ``-m slow``. The "volatile caught" twin — the live
+analog of ``write_once_packed.py``'s buggy variant — must be rejected
+by the cross-check and dump a reproducible seed artifact, which
+``tests/test_fuzz_differential.py`` replays from the committed
+``soak_seeds/`` corpus.
+"""
+
+import os
+import pickle
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from stateright_tpu.actor import ChaosNetwork, Id, spawn
+from stateright_tpu.actor.core import Actor, Out
+from stateright_tpu.actor.runtime import cluster_rng
+from stateright_tpu.obs import Metrics, validate_event
+from stateright_tpu.semantics import (HistoryRecorder,
+                                      LinearizabilityTester,
+                                      RecordedHistory, Read, ReadOk,
+                                      WORegister, Write, WriteOk)
+
+pytestmark = pytest.mark.faults
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _soak():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import soak
+    finally:
+        sys.path.pop(0)
+    return soak
+
+
+def _free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class _FakeSock:
+    """Records sendto calls (no network); stands in for a bound UDP
+    socket under the chaos layer."""
+
+    def __init__(self):
+        self.sent = []
+
+    def sendto(self, data, addr):
+        self.sent.append((data, addr))
+        return len(data)
+
+
+_A = Id.from_socket_addr((127, 0, 0, 1), 5001)
+_B = Id.from_socket_addr((127, 0, 0, 1), 5002)
+_B_ADDR = ("127.0.0.1", 5002)
+
+
+class TestChaosDecisions:
+    def test_seeded_loss_is_deterministic(self):
+        def pattern(seed):
+            net = ChaosNetwork(seed=seed, loss=0.5)
+            sock = net.wrap(_A, _FakeSock())
+            out = []
+            for i in range(64):
+                before = len(sock._sock.sent)
+                sock.sendto(b"x%d" % i, _B_ADDR)
+                out.append(len(sock._sock.sent) > before)
+            net.close()
+            return out
+
+        assert pattern(7) == pattern(7)
+        assert pattern(7) != pattern(8)
+
+    def test_decision_stream_alignment_across_knobs(self):
+        # the same seed drops the same datagram positions whether or
+        # not OTHER fault knobs are enabled (all draws always happen)
+        def drops(**kw):
+            net = ChaosNetwork(seed=3, loss=0.4, **kw)
+            sock = net.wrap(_A, _FakeSock())
+            out = []
+            for i in range(64):
+                net.metrics.set("dropped", 0)
+                sock.sendto(b"y", _B_ADDR)
+                out.append(net.metrics.get("dropped", 0) > 0)
+            net.close()
+            return out
+
+        assert drops() == drops(delay=0.9, delay_range=(0.0, 0.001))
+
+    def test_partition_blocks_cross_group_links(self):
+        net = ChaosNetwork(seed=0)
+        fake = _FakeSock()
+        sock = net.wrap(_A, fake)
+        net.set_partition([[int(_A)], [int(_B)]])
+        assert not net.allows(_A, _B)
+        sock.sendto(b"blocked", _B_ADDR)
+        assert fake.sent == []
+        assert net.metrics.get("dropped") == 1
+        assert net.metrics.get("partitions") == 1
+        # unlisted ids are wildcards; healing restores the link
+        other = Id.from_socket_addr((127, 0, 0, 1), 5003)
+        assert net.allows(_A, other) and net.allows(other, _B)
+        net.heal()
+        sock.sendto(b"flows", _B_ADDR)
+        assert len(fake.sent) == 1
+        net.close()
+
+    def test_duplicate_and_delay_deliver_everything(self):
+        net = ChaosNetwork(seed=1, duplicate=1.0, delay=1.0,
+                           delay_range=(0.0, 0.001))
+        fake = _FakeSock()
+        sock = net.wrap(_A, fake)
+        for i in range(10):
+            sock.sendto(b"d%d" % i, _B_ADDR)
+        deadline = time.monotonic() + 2.0
+        while len(fake.sent) < 20 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(fake.sent) == 20  # 10 delayed + 10 duplicates
+        assert net.metrics.get("duplicated") == 10
+        assert net.metrics.get("delayed") == 10
+        net.close()
+
+    def test_per_link_override(self):
+        net = ChaosNetwork(seed=2, loss=0.0)
+        net.set_link(_A, _B, loss=1.0)
+        fake = _FakeSock()
+        sock = net.wrap(_A, fake)
+        sock.sendto(b"gone", _B_ADDR)
+        assert fake.sent == []
+        other = ("127.0.0.1", 5003)
+        sock.sendto(b"kept", other)
+        assert len(fake.sent) == 1
+        net.close()
+
+
+class _WOVolatile(Actor):
+    """Write-once register, value in volatile memory (None=unwritten);
+    messages are plain pickled tuples for the runtime tests."""
+
+    def on_start(self, id, o):
+        return None
+
+    def on_msg(self, id, state, src, msg, o):
+        kind, rid, val = msg
+        if kind == "put":
+            if state is None or state == val:
+                o.send(src, ("put_ok", rid, None))
+                return val if state is None else None
+            o.send(src, ("put_fail", rid, None))
+            return None
+        if kind == "get":
+            o.send(src, ("get_ok", rid, state))
+        return None
+
+
+class _WODurable(_WOVolatile):
+    def durable(self, id, state):
+        return state
+
+    def on_restart(self, id, durable, o):
+        return durable
+
+
+def _rpc(sock, addr, msg, timeout=2.0):
+    rid = msg[1]
+    sock.settimeout(0.25)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sock.sendto(pickle.dumps(msg), addr)
+        try:
+            reply = pickle.loads(sock.recv(65535))
+        except (socket.timeout, OSError):
+            continue
+        if reply[1] == rid:
+            return reply
+    raise AssertionError(f"no reply for {msg!r}")
+
+
+class TestCrashRestart:
+    def _cluster(self, actor):
+        port = _free_udp_port()
+        sid = Id.from_socket_addr((127, 0, 0, 1), port)
+        handle = spawn(pickle.dumps, pickle.loads, [(sid, actor)],
+                       background=True, seed=5)
+        client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        client.bind(("127.0.0.1", 0))
+        return handle, sid, client, ("127.0.0.1", port)
+
+    def test_durable_value_survives_crash_restart(self):
+        handle, sid, client, addr = self._cluster(_WODurable())
+        try:
+            assert _rpc(client, addr, ("put", 1, "X"))[0] == "put_ok"
+            durable = handle.crash(sid)
+            assert durable == "X"  # the captured projection
+            handle.restart(sid)
+            assert _rpc(client, addr, ("get", 2, None)) \
+                == ("get_ok", 2, "X")
+        finally:
+            handle.stop()
+            client.close()
+
+    def test_volatile_value_lost_and_cross_check_catches_it(self):
+        handle, sid, client, addr = self._cluster(_WOVolatile())
+        rec = HistoryRecorder()
+        try:
+            rec.invoke("c0", Write("X"))
+            assert _rpc(client, addr, ("put", 1, "X"))[0] == "put_ok"
+            rec.ret("c0", WriteOk())
+            assert handle.crash(sid) is None  # fail-stop: no durable
+            handle.restart(sid)
+            rec.invoke("c0", Read())
+            reply = _rpc(client, addr, ("get", 2, None))
+            rec.ret("c0", ReadOk(reply[2]))
+            assert reply == ("get_ok", 2, None)  # the write is GONE
+        finally:
+            handle.stop()
+            client.close()
+        history = rec.history()
+        assert not history.check(LinearizabilityTester(WORegister()))
+        # and the artifact round-trips to the same rejection
+        meta, loaded = RecordedHistory.from_jsonl(
+            history.to_jsonl({"spec": "woregister"}))
+        assert meta == {"spec": "woregister"}
+        assert not loaded.check(LinearizabilityTester(WORegister()))
+
+    def test_crash_restart_state_machine_guards(self):
+        handle, sid, client, addr = self._cluster(_WODurable())
+        try:
+            handle.crash(sid)
+            with pytest.raises(ValueError, match="already down"):
+                handle.crash(sid)
+            handle.restart(sid)
+            with pytest.raises(ValueError, match="not down"):
+                handle.restart(sid)
+        finally:
+            handle.stop()
+            client.close()
+
+
+class _BigSender(Actor):
+    """Emits an oversized datagram (EMSGSIZE) on first contact, then
+    acks — the send path must log-and-ignore, not die."""
+
+    def on_start(self, id, o):
+        return 0
+
+    def on_msg(self, id, state, src, msg, o):
+        if state == 0:
+            o.send(src, b"x" * 100_000)  # > UDP max: sendto raises
+            o.send(src, "alive")
+            return 1
+        o.send(src, "alive")
+        return None
+
+
+class TestRuntimeSatellites:
+    def test_seeded_timer_rng_is_deterministic(self):
+        a = cluster_rng(42, Id(3))
+        b = cluster_rng(42, Id(3))
+        other = cluster_rng(42, Id(4))
+        seq_a = [a.uniform(0, 1) for _ in range(8)]
+        assert seq_a == [b.uniform(0, 1) for _ in range(8)]
+        assert seq_a != [other.uniform(0, 1) for _ in range(8)]
+        # seed=None keeps the legacy global-random behavior
+        assert cluster_rng(None, Id(3)) is __import__("random")
+
+    def test_send_oserror_does_not_kill_actor(self):
+        port = _free_udp_port()
+        sid = Id.from_socket_addr((127, 0, 0, 1), port)
+        handle = spawn(pickle.dumps, pickle.loads,
+                       [(sid, _BigSender())], background=True)
+        client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        client.bind(("127.0.0.1", 0))
+        try:
+            client.settimeout(0.25)
+            for attempt in range(2):
+                # first contact triggers the EMSGSIZE send; the actor
+                # must survive it and still answer
+                deadline = time.monotonic() + 2.0
+                got = None
+                while got is None and time.monotonic() < deadline:
+                    client.sendto(pickle.dumps("ping"),
+                                  ("127.0.0.1", port))
+                    try:
+                        got = pickle.loads(client.recv(65535))
+                    except (socket.timeout, OSError):
+                        continue
+                assert got == "alive"
+            assert handle.failures() == []
+        finally:
+            handle.stop()
+            client.close()
+
+    def test_socket_released_on_every_exit_path(self):
+        # stop/crash close the socket in a finally: rebinding the SAME
+        # port repeatedly only works if each cycle released it
+        port = _free_udp_port()
+        sid = Id.from_socket_addr((127, 0, 0, 1), port)
+        for _ in range(6):
+            handle = spawn(pickle.dumps, pickle.loads,
+                           [(sid, _WODurable())], background=True)
+            handle.stop()
+        # crash/restart cycles rebind too
+        handle = spawn(pickle.dumps, pickle.loads,
+                       [(sid, _WODurable())], background=True)
+        try:
+            for _ in range(3):
+                handle.crash(sid)
+                handle.restart(sid)
+            assert handle.failures() == []
+        finally:
+            handle.stop()
+
+
+class TestSoakSmoke:
+    """The tier-1 soak: a few hundred ops on loopback with every fault
+    class live, finished and cross-checked in seconds."""
+
+    def test_durable_write_once_soak_history_ok(self, tmp_path):
+        soak = _soak()
+        trace = []
+        res = soak.run_soak(soak.SoakConfig(
+            protocol="write_once", ops=220, clients=3, seed=3,
+            loss=0.04, duplicate=0.04, delay=0.12, crashes=1,
+            partitions=1, op_timeout=0.2, crash_down=0.05,
+            partition_span=0.1, deadline=30.0, trace=trace,
+            artifact_dir=str(tmp_path)))
+        assert res["history_ok"] is True
+        assert res["artifact"] is None
+        assert res["crashes"] == 1 and res["restarts"] == 1
+        assert res["partitions"] == 1
+        assert res["dropped"] > 0  # seeded loss really fired
+        assert res["completed"] > 150
+        # obs integration: every event validates against the schema,
+        # and the soak lifecycle events are all present
+        for ev in trace:
+            validate_event(ev)
+        kinds = {e["ev"] for e in trace}
+        assert {"run_start", "fault_injection", "ops", "crash",
+                "restart", "partition", "soak_done"} <= kinds
+        done = [e for e in trace if e["ev"] == "soak_done"][-1]
+        assert done["history_ok"] is True
+        assert done["engine"] == "soak"
+
+    def test_abd_soak_smoke_history_ok(self, tmp_path):
+        # quorum replication + durable (seq, val) + request dedup stay
+        # linearizable under dup/loss/delay and a live crash-restart
+        soak = _soak()
+        res = soak.run_soak(soak.SoakConfig(
+            protocol="abd", ops=300, clients=3, seed=6, loss=0.02,
+            duplicate=0.02, delay=0.08, crashes=1, partitions=1,
+            op_timeout=0.2, deadline=40.0,
+            artifact_dir=str(tmp_path)))
+        assert res["history_ok"] is True
+        assert res["crashes"] == 1 and res["restarts"] == 1
+        assert res["completed"] > 200
+
+    def test_volatile_twin_is_caught_and_dumps_artifact(self, tmp_path):
+        soak = _soak()
+        res = soak.run_soak(soak.volatile_demo_config(
+            artifact_dir=str(tmp_path)))
+        assert res["history_ok"] is False
+        assert res["crashes"] == 1
+        path = res["artifact"]
+        assert path is not None and os.path.exists(path)
+        # the artifact replays to the same rejection (the regression
+        # contract test_fuzz_differential.py runs over the corpus)
+        assert soak.check_artifact(path) == {"linearizability": False}
+
+    def test_trace_report_renders_soak_postmortem(self, tmp_path,
+                                                  capsys):
+        soak = _soak()
+        path = tmp_path / "soak.jsonl"
+        soak.run_soak(soak.SoakConfig(
+            protocol="write_once", ops=60, clients=2, seed=9,
+            loss=0.0, duplicate=0.0, delay=0.0, crashes=1,
+            partitions=0, op_timeout=0.2, deadline=20.0,
+            trace=str(path), artifact_dir=str(tmp_path)))
+        sys.path.insert(0, _TOOLS)
+        try:
+            import trace_report
+        finally:
+            sys.path.pop(0)
+        assert trace_report.main([str(path), "--validate"]) == 0
+        out = capsys.readouterr().out
+        assert "=== engine: soak" in out
+        assert "soak: ops=" in out and "history_ok=True" in out
+        assert "crash" in out and "restart" in out
+
+
+@pytest.mark.slow
+class TestSoakGrids:
+    """The acceptance-criteria grids: ≥2k client ops with loss +
+    duplication + partition + ≥2 live crash–restarts, write-once AND
+    ABD, deterministic seeds."""
+
+    def test_write_once_2k_ops_full_fault_grid(self, tmp_path):
+        soak = _soak()
+        res = soak.run_soak(soak.SoakConfig(
+            protocol="write_once", ops=2000, clients=4, seed=1,
+            loss=0.03, duplicate=0.03, delay=0.1, crashes=2,
+            partitions=2, op_timeout=0.25, deadline=120.0,
+            testers=("linearizability", "sequential"),
+            artifact_dir=str(tmp_path)))
+        assert res["ops"] >= 2000
+        assert res["history_ok"] is True
+        assert res["testers"] == {"linearizability": True,
+                                  "sequential": True}
+        assert res["crashes"] == 2 and res["restarts"] == 2
+        assert res["partitions"] == 2
+        assert res["dropped"] > 0 and res["duplicated"] > 0
+
+    def test_abd_2k_ops_full_fault_grid(self, tmp_path):
+        soak = _soak()
+        res = soak.run_soak(soak.SoakConfig(
+            protocol="abd", ops=2000, clients=3, seed=2,
+            loss=0.02, duplicate=0.02, delay=0.08, crashes=2,
+            partitions=1, op_timeout=0.25, deadline=240.0,
+            artifact_dir=str(tmp_path)))
+        assert res["ops"] >= 2000
+        assert res["history_ok"] is True
+        assert res["crashes"] == 2 and res["restarts"] == 2
+
+    def test_soak_cli_and_bench_soak_smoke_contract(self, tmp_path):
+        import json
+        import subprocess
+
+        root = os.path.dirname(_TOOLS)
+        # the CLI lands a JSON result line, rc=0 on history_ok
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_TOOLS, "soak.py"),
+             "--ops", "80", "--clients", "2", "--seed", "4",
+             "--crashes", "1", "--partitions", "0",
+             "--artifact-dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=120, cwd=root)
+        assert proc.returncode == 0, proc.stderr
+        line = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert line["history_ok"] is True
+        # bench --soak-smoke: the crash-proof soak contract line
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "bench.py"),
+             "--soak-smoke"],
+            capture_output=True, text=True, timeout=120, cwd=root)
+        assert proc.returncode == 0, proc.stderr
+        contract = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert contract["unit"] == "ops/s"
+        assert contract["history_ok"] is True
+        assert contract["value"] > 0
+        assert contract["faults"]["crashes"] == 1
+        assert "partial" not in contract

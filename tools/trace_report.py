@@ -12,11 +12,15 @@ found in the trace:
   * a chunk/level timeline in ~12 buckets — unique-states rate, dedup
     hit-rate, table load factor, queue depth — the view that makes a
     pipeline stall or a growth storm visible after the fact;
-  * interventions (grow/hgrow/egrow/kovf/compile, plus the resilience
-    layer's retry/watchdog/autosave/failover/degrade events) with
+  * interventions (grow/hgrow/egrow/kovf/compile, the resilience
+    layer's retry/watchdog/autosave/failover/degrade events, and the
+    soak harness's live crash/restart/partition injections) with
     timestamps — on a flaky round this table says *where* the tunnel
     dropped, what the engine did about it, and whether an autosave
     landed;
+  * a soak summary line (ops, op timeouts, fault-injection counts,
+    the history cross-check verdict) when the trace came from
+    ``tools/soak.py``;
   * a resilience summary line (retries/watchdogs/failovers/degrades,
     the blamed device indices, and the mesh width a degraded run
     finished on);
@@ -102,7 +106,10 @@ def chunk_timeline(rows, out):
         prev_t, prev_uniq = t_end, uniq if uniq is not None else prev_uniq
 
 
-def report(events, out=sys.stdout):
+def report(events, out=None):
+    # late-bind stdout: a default argument would freeze whatever stream
+    # was installed at import time (pytest capture, redirections)
+    out = sys.stdout if out is None else out
     by_engine = {}
     for ev in events:
         by_engine.setdefault(ev.get("engine", "?"), []).append(ev)
@@ -139,7 +146,8 @@ def report(events, out=sys.stdout):
         inters = [e for e in evs if e["ev"] in
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
-                   "degrade", "fused_fallback")]
+                   "degrade", "fused_fallback",
+                   "crash", "restart", "partition")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
@@ -171,6 +179,29 @@ def report(events, out=sys.stdout):
                 parts.append(
                     f"final_mesh={degrades[-1]['to_shards']}")
             out.write("\nresilience: " + " ".join(parts) + "\n")
+
+        # soak summary: a chaos soak postmortem reads like a checker
+        # postmortem — op throughput, the live faults injected, and
+        # whether the recorded history survived the consistency
+        # cross-check
+        soak_done = [e for e in evs if e["ev"] == "soak_done"]
+        if soak_done:
+            last = soak_done[-1]
+            counts = {}
+            for ev in evs:
+                if ev["ev"] in ("crash", "restart", "partition"):
+                    counts[ev["ev"]] = counts.get(ev["ev"], 0) + 1
+            ops_evs = [e for e in evs if e["ev"] == "ops"]
+            timeouts = ops_evs[-1].get("op_timeouts", 0) \
+                if ops_evs else 0
+            plural = {"crash": "crashes", "restart": "restarts",
+                      "partition": "partitions"}
+            parts = [f"ops={last.get('ops')}",
+                     f"op_timeouts={timeouts}"]
+            parts += [f"{plural[k]}={v}"
+                      for k, v in sorted(counts.items())]
+            parts.append(f"history_ok={last.get('history_ok')}")
+            out.write("\nsoak: " + " ".join(parts) + "\n")
 
         # fused-kernel summary: which path the run took, and why a
         # fused='auto' attempt fell back (the classified cause)
