@@ -137,7 +137,13 @@ def _compact_metrics(ck):
     search = prof.get("search")
     if search:
         for k, label in (("sync_stall", "stall_frac"),
-                         ("host_overlap", "overlap_frac")):
+                         ("host_overlap", "overlap_frac"),
+                         # device-time attribution (obs GLOSSARY
+                         # device_s/xfer_s): how much of the wall was
+                         # device compute vs tunnel transfer, so a
+                         # slow round can be blamed on the right side
+                         ("device_s", "device_frac"),
+                         ("xfer_s", "xfer_frac")):
             if k in prof:
                 m[label] = round(prof[k] / search, 3)
     uniq, gen = ck.unique_state_count(), ck.state_count()

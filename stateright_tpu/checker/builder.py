@@ -111,9 +111,22 @@ class CheckerBuilder:
           structured run-trace: every engine (host engines included)
           emits timestamped JSONL events (chunk completed, growth and
           resize interventions, compiles, discoveries, ...) to the
-          sink, at zero cost when unset. Format and the metrics key
-          glossary: README.md § Observability and
-          ``stateright_tpu.obs``;
+          sink. Format and the metrics key glossary: README.md
+          § Observability and ``stateright_tpu.obs``;
+        * ``flight`` (default ``True``) keeps the **flight recorder**
+          on: a bounded ring of the most recent trace events (no sink
+          needed) dumped as a JSONL postmortem artifact on any engine
+          error, watchdog expiry, exhausted retries, and each
+          degradation rung — ``checker.flight_path()`` names the
+          artifact, ``tools/trace_report.py`` reads it. ``flight=N``
+          resizes the ring, ``flight=False`` disables it (restoring
+          the zero-cost NULL trace), ``flight_path=...`` pins the
+          artifact destination (default: next to ``autosave``, else
+          the temp dir);
+        * ``profile_dir=path`` captures a ``jax.profiler`` trace of
+          the whole run into the directory (TensorBoard/Perfetto) —
+          the deep-dive tier above the per-chunk ``device_s``/
+          ``xfer_s`` attribution in ``profile()``;
         * resilience (README § Resilience, ``checker/resilience.py``):
           ``retries=N`` retries a transient backend fault (UNAVAILABLE,
           DEADLINE_EXCEEDED, tunnel resets) up to N consecutive times,

@@ -13,7 +13,17 @@ server. The API is identical:
 * ``GET /.metrics`` — our addition beyond the reference: the engine's
   live metrics registry (per-chunk stats, phase timers, growth
   counters; key glossary in ``stateright_tpu.obs.GLOSSARY``), served
-  mid-run for dashboards/polling;
+  mid-run for dashboards/polling; ``GET /.metrics?history`` returns
+  the bounded time-series ring of periodic snapshots (one sample/sec
+  while the run is live) so a dashboard can plot a trend without
+  having polled from the start;
+* ``GET /.events`` — Server-Sent Events over the run trace: the
+  flight-recorder backlog is replayed first (a late client still sees
+  the run so far), then live events stream as ``data:`` lines. Each
+  client gets a bounded queue; a slow client DROPS events rather than
+  ever blocking an engine writer (drop counts ride a trailing SSE
+  comment). ``tools/watch.py --url`` renders this stream as a
+  terminal console;
 * ``GET /.states/{fp}/{fp}/...`` — a state is addressed by the fingerprint
   path from an init state (`explorer.rs:159-240`): the server replays the
   model to the addressed state on every request and returns one
@@ -28,8 +38,10 @@ from __future__ import annotations
 
 import json
 import os
+import queue as _queue
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -135,6 +147,113 @@ def metrics_view(checker) -> Dict[str, Any]:
     }
 
 
+class MetricsRing:
+    """Bounded time series of periodic ``/.metrics`` snapshots.
+
+    A daemon sampler (started by :func:`serve`) appends one snapshot
+    per ``interval`` seconds while the run is live; the ring keeps the
+    most recent ``limit`` samples, so a dashboard attaching mid-run can
+    plot the trend it missed without having polled from the start."""
+
+    def __init__(self, limit: int = 512, interval: float = 1.0):
+        self.interval = interval
+        self._buf: deque = deque(maxlen=max(4, int(limit)))
+        self._lock = threading.Lock()
+
+    def add(self, sample: Dict[str, Any]) -> None:
+        sample = dict(sample)
+        sample["wall"] = time.time()
+        with self._lock:
+            self._buf.append(sample)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def run_sampler(self, checker) -> None:
+        """Sampler loop body (run on a daemon thread): one snapshot
+        immediately, then one per interval until the run completes —
+        plus a final post-done sample so the series ends at the
+        terminal counts."""
+        while True:
+            done = checker.is_done()
+            try:
+                self.add(metrics_view(checker))
+            except Exception:
+                pass  # a mid-teardown snapshot race must not kill it
+            if done:
+                return
+            time.sleep(self.interval)
+
+
+def serve_events(handler, checker, qsize: int = 256) -> None:
+    """``GET /.events``: SSE-stream the run trace to one client.
+
+    The flight-recorder backlog is replayed first (so a client
+    attaching late — or after the run finished — still sees the whole
+    recorded history), then live events arrive via a trace subscriber
+    feeding a bounded per-client queue: a slow client drops events
+    (counted) instead of ever blocking the engine's emit path. The
+    stream ends once the run is done and the queue has drained."""
+    trace = getattr(checker, "_trace", None)
+    if trace is None or not trace:
+        handler._send(503, b"run trace disabled "
+                      b"(tpu_options(flight=False) with no trace sink)",
+                      "text/plain")
+        return
+    q: "_queue.Queue" = _queue.Queue(maxsize=qsize)
+    dropped = [0]
+
+    def sub(ev):
+        try:
+            q.put_nowait(ev)
+        except _queue.Full:
+            dropped[0] += 1  # slow client: drop, never block the engine
+
+    # backlog BEFORE subscribing: a client may then miss an event
+    # emitted in the gap, but never sees duplicates (the lesser evil
+    # for a console tailing deltas)
+    recorder = getattr(checker, "_recorder", None)
+    backlog = recorder.snapshot() if recorder is not None else []
+    trace.subscribe(sub)
+    try:
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.end_headers()
+
+        def write_ev(ev):
+            handler.wfile.write(
+                b"data: " + json.dumps(ev, default=str).encode()
+                + b"\n\n")
+
+        for ev in backlog:
+            write_ev(ev)
+        handler.wfile.flush()
+        while True:
+            try:
+                ev = q.get(timeout=0.5)
+            except _queue.Empty:
+                if checker.is_done():
+                    break
+                handler.wfile.write(b": keep-alive\n\n")
+                handler.wfile.flush()
+                continue
+            write_ev(ev)
+            handler.wfile.flush()
+        if dropped[0]:
+            handler.wfile.write(
+                f": dropped {dropped[0]} events (slow client)\n\n"
+                .encode())
+        handler.wfile.flush()
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        pass  # client went away; unsubscribe below
+    finally:
+        unsub = getattr(trace, "unsubscribe", None)
+        if unsub is not None:
+            unsub(sub)
+
+
 def parse_fingerprints(fingerprints_str: str) -> List[int]:
     """Parse the `/`-joined fingerprint path suffix; raises NotFound on
     junk (`explorer.rs:168-181`)."""
@@ -200,7 +319,8 @@ def state_views(model, fingerprints: List[int]) -> List[Dict[str, Any]]:
     return results
 
 
-def _make_handler(checker, snapshot: Optional[Snapshot]):
+def _make_handler(checker, snapshot: Optional[Snapshot],
+                  ring: Optional[MetricsRing] = None):
     model = checker.model()
 
     class Handler(BaseHTTPRequestHandler):
@@ -219,12 +339,17 @@ def _make_handler(checker, snapshot: Optional[Snapshot]):
                        "application/json")
 
         def do_GET(self) -> None:  # noqa: N802 (stdlib API)
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             try:
                 if path == "/.status":
                     self._send_json(200, status_view(checker, snapshot))
+                elif path == "/.metrics" and "history" in query:
+                    samples = ring.snapshot() if ring is not None else []
+                    self._send_json(200, {"samples": samples})
                 elif path == "/.metrics":
                     self._send_json(200, metrics_view(checker))
+                elif path == "/.events":
+                    serve_events(self, checker)
                 elif path == "/.states" or path.startswith("/.states/"):
                     fps = parse_fingerprints(path[len("/.states"):])
                     self._send_json(200, state_views(model, fps))
@@ -285,7 +410,14 @@ def serve(checker_builder, address: Tuple[str, int] | str,
 
         threading.Thread(target=rearm_loop, daemon=True).start()
 
-    server = ThreadingHTTPServer(address, _make_handler(checker, snapshot))
+    # time-series ring behind GET /.metrics?history: a daemon sampler
+    # snapshots the live registry once per second until the run ends
+    ring = MetricsRing()
+    threading.Thread(target=ring.run_sampler, args=(checker,),
+                     daemon=True).start()
+
+    server = ThreadingHTTPServer(address,
+                                 _make_handler(checker, snapshot, ring))
     if block:
         try:
             server.serve_forever()

@@ -11,13 +11,15 @@ the TPU engine is differentially tested against.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, Optional
 
 from ..core import Expectation, Model
 from ..fingerprint import fp64_node
-from ..obs import Metrics, fault_info, make_trace
+from ..obs import (FlightRecorder, Metrics, default_flight_path,
+                   fault_info, make_trace)
 from .builder import Checker, CheckerBuilder
 
 
@@ -40,10 +42,28 @@ class HostChecker(Checker):
         self._cancel_event = threading.Event()
         # unified observability (obs/): every engine records into ONE
         # Metrics registry behind profile(), and emits structured
-        # run-trace events when tpu_options(trace=...) names a sink
+        # run-trace events when tpu_options(trace=...) names a sink.
+        # The flight recorder (obs/recorder.py) is always on by default:
+        # with no trace configured the engine still holds a sink-less
+        # RunTrace feeding the bounded event ring, dumped as a JSONL
+        # artifact on any crash (tpu_options(flight=False) opts out,
+        # flight=N resizes the ring)
         self._metrics = Metrics()
-        self._trace = make_trace(builder.tpu_options_.get("trace"),
-                                 engine=type(self).__name__)
+        obs_opts = builder.tpu_options_
+        flight = obs_opts.get("flight", True)
+        if flight is False:
+            self._recorder = None
+        else:
+            self._recorder = FlightRecorder() if flight is True \
+                else FlightRecorder(limit=int(flight))
+        self._flight_path: Optional[str] = None
+        self._flight_target_cached: Optional[str] = None
+        self._autosave_path = obs_opts.get("autosave")
+        self._flight_path_opt = obs_opts.get("flight_path")
+        self._profile_dir = obs_opts.get("profile_dir")
+        self._trace = make_trace(obs_opts.get("trace"),
+                                 engine=type(self).__name__,
+                                 recorder=self._recorder)
 
     def _timed(self, name: str):
         """Accumulate wall time under a glossary phase key."""
@@ -58,10 +78,57 @@ class HostChecker(Checker):
         return self._metrics.snapshot()
 
     def subscribe(self, fn) -> None:
-        """Register a live progress callback on the run trace (requires
-        an enabled trace, e.g. ``tpu_options(trace=[])``); ``fn`` is
-        invoked with every emitted event dict."""
+        """Register a live progress callback on the run trace; ``fn``
+        is invoked with every emitted event dict. Enabled by default
+        (the flight recorder keeps the trace live); only with
+        ``tpu_options(flight=False)`` and no trace sink does this
+        raise."""
         self._trace.subscribe(fn)
+
+    # --- flight recorder (obs/recorder.py) -----------------------------
+    def flight_path(self) -> Optional[str]:
+        """Path of the most recent flight-recorder artifact this run
+        dumped, or ``None`` when nothing went wrong (or flight=False)."""
+        return self._flight_path
+
+    def _flight_target(self) -> str:
+        """Stable per-run artifact destination: explicit
+        ``tpu_options(flight_path=...)``, else next to the autosave
+        checkpoint, else a per-checker file in the temp dir — repeated
+        dumps of one run (watchdog, then retries, then the final error)
+        overwrite in place, keeping the most complete artifact."""
+        if self._flight_target_cached is None:
+            if self._flight_path_opt is not None:
+                self._flight_target_cached = os.fspath(
+                    self._flight_path_opt)
+            elif self._autosave_path is not None:
+                self._flight_target_cached = (
+                    os.fspath(self._autosave_path) + ".flight.jsonl")
+            else:
+                self._flight_target_cached = default_flight_path(
+                    type(self._model).__name__)
+        return self._flight_target_cached
+
+    def _flight_dump(self, reason: str) -> Optional[str]:
+        """Dump the event ring as a JSONL postmortem artifact. The
+        ``recorder_dump`` event is emitted FIRST (and thus recorded),
+        so the artifact names itself; dump failures (read-only temp
+        dir, full disk) never mask the original fault."""
+        rec = self._recorder
+        if rec is None:
+            return None
+        path = self._flight_target()
+        try:
+            if self._trace:
+                self._trace.emit("recorder_dump", path=path,
+                                 reason=reason, events=rec.recorded,
+                                 dropped=rec.dropped)
+            rec.dump(path)
+        except OSError:
+            return None
+        self._flight_path = path
+        self._metrics.inc("recorder_dumps")
+        return path
 
     def _note_discovery(self, name: str, fp) -> None:
         """Emit the trace event for a just-recorded discovery
@@ -134,6 +201,26 @@ class HostChecker(Checker):
                                                 daemon=True)
                 self._thread.start()
 
+    def _start_profiler(self) -> bool:
+        """Optional ``jax.profiler`` capture behind
+        ``tpu_options(profile_dir=...)``: the full XLA-level trace
+        (device timelines, HLO costs) lands in the directory for
+        TensorBoard/Perfetto — the deep-dive tier above the host-side
+        ``device_s``/``xfer_s`` estimates. Failures never kill the run."""
+        if self._profile_dir is None:
+            return False
+        try:
+            import jax
+            jax.profiler.start_trace(os.fspath(self._profile_dir))
+            return True
+        except Exception as exc:
+            import warnings
+            warnings.warn(
+                f"tpu_options(profile_dir=...) capture failed to start "
+                f"({type(exc).__name__}: {exc}); run continues "
+                "unprofiled", RuntimeWarning, stacklevel=2)
+            return False
+
     def _run_wrapper(self) -> None:
         trace = self._trace
         if trace:
@@ -143,6 +230,7 @@ class HostChecker(Checker):
             faults = fault_info(self._model)
             if faults is not None:
                 trace.emit("fault_injection", **faults)
+        profiling = self._start_profiler()
         try:
             with self._metrics.timed("search"):
                 self._run()
@@ -151,7 +239,16 @@ class HostChecker(Checker):
             if trace:
                 trace.emit("error",
                            error=f"{type(exc).__name__}: {exc}")
+            # the crash postmortem: dump the always-on event ring as a
+            # JSONL artifact, trace or no trace configured
+            self._flight_dump("error")
         finally:
+            if profiling:
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass  # a failed stop must not mask the run result
             self._done = True
             if trace:
                 trace.emit("done", gen=self._state_count,

@@ -412,6 +412,9 @@ class TpuChecker(HostChecker):
         # most recently enqueued queue row (rides each chunk sync) —
         # the Explorer's live-progress sample for the device engine
         self._recent_row = None
+        # last sync's (device_s, xfer_s) split, set by
+        # _materialize_stats (None when the pull never completed)
+        self._pull_timing = None
         self._resume_path = builder.resume_path_
         self._resume_frontier = None
         self._base_fps: List[int] = []
@@ -540,15 +543,25 @@ class TpuChecker(HostChecker):
                           translate=self._symmetry or self._sound,
                           sound=self._sound)
 
-    def _materialize_stats(self, stats_d, ordinal: int) -> np.ndarray:
+    def _materialize_stats(self, stats_d, ordinal: int,
+                           t_disp: "Optional[float]" = None) -> np.ndarray:
         """Pull one chunk's stats vector through the resilience hooks:
         the injected fault hook fires first (the tests' transient-fault
         injection point), then the optional watchdog deadline bounds
-        the device round trip (a hang becomes a classified fault)."""
+        the device round trip (a hang becomes a classified fault).
+
+        Device-time attribution: the host-side ``sync_stall`` timer
+        conflated device compute with the tunnel transfer. The pull now
+        splits the interval at the stats future's readiness —
+        dispatch→ready is the ``device_s`` estimate (the chunk program
+        executing; an upper bound under pipelining, where host work
+        overlaps it), ready→materialized is ``xfer_s`` (the transfer).
+        Stored in ``_pull_timing`` for the caller's metrics/trace."""
         import jax
 
         hook = self._fault_hook
         shards = int(self._fault_shards)
+        self._pull_timing = None
 
         def pull():
             if hook is not None:
@@ -556,7 +569,17 @@ class TpuChecker(HostChecker):
                     hook(ordinal, shards)
                 else:
                     hook(ordinal)
-            return np.asarray(jax.device_get(stats_d))
+            t0 = time.perf_counter()
+            try:
+                stats_d.block_until_ready()
+            except AttributeError:
+                pass  # already host-side (host fallbacks, tests)
+            t1 = time.perf_counter()
+            out = np.asarray(jax.device_get(stats_d))
+            t2 = time.perf_counter()
+            base = t_disp if t_disp is not None else t0
+            self._pull_timing = (max(t1 - base, 0.0), max(t2 - t1, 0.0))
+            return out
 
         deadline = self._chunk_deadline
         if not deadline:
@@ -571,6 +594,10 @@ class TpuChecker(HostChecker):
                 # width at least scopes the postmortem
                 self._trace.emit("watchdog", deadline=float(deadline),
                                  chunk=ordinal, shards=shards)
+            # a hung sync is exactly the crash the flight recorder
+            # exists for: land the postmortem before the retry envelope
+            # decides what happens next
+            self._flight_dump("watchdog")
             raise
 
     def _checkpoint_save(self, path, rows, ebits, ffps,
@@ -628,6 +655,9 @@ class TpuChecker(HostChecker):
         ``degrade=False``): land an artifact instead of just dying —
         write the autosave checkpoint (when configured) and raise ONE
         actionable error naming the resume command."""
+        # exhausted retries are a flight-recorder trigger in their own
+        # right: the ring at this point holds the whole retry burst
+        self._flight_dump("retries_exhausted")
         if self._autosave_path is not None:
             self._write_autosave(shadow, discoveries)
             path = os.fspath(self._autosave_path)
@@ -1028,10 +1058,11 @@ class TpuChecker(HostChecker):
             if fused_on:
                 self._metrics.inc("fused_chunks")
             inflight.append((int(self._metrics.get("chunks")), stats_d,
-                             self._h_pulled, int(grow_limit), hcap))
+                             self._h_pulled, int(grow_limit), hcap,
+                             time.perf_counter()))
 
         def process(ordinal: int, stats_d, h_base: int, grow_limit: int,
-                    hcap_d: int) -> set:
+                    hcap_d: int, t_disp: float) -> set:
             """Consume one chunk's stats vector; returns the host
             actions it demands (handled once the pipeline is drained)."""
             nonlocal seed_ovf, fault_attempt
@@ -1040,7 +1071,13 @@ class TpuChecker(HostChecker):
                 # (scalars + the representative window when host props
                 # are on): each transfer costs ~100 ms of tunnel latency
                 # — routed through the fault hook + watchdog deadline
-                stats = self._materialize_stats(stats_d, ordinal)
+                stats = self._materialize_stats(stats_d, ordinal,
+                                                t_disp=t_disp)
+            # device-time attribution from the completed pull
+            timing = self._pull_timing
+            if timing is not None:
+                self._metrics.add_time("device_s", timing[0])
+                self._metrics.add_time("xfer_s", timing[1])
             # a successful sync proves the backend is alive: the retry
             # budget bounds CONSECUTIVE faults, not lifetime hiccups
             fault_attempt = 0
@@ -1111,7 +1148,11 @@ class TpuChecker(HostChecker):
                     dedup_hit=(round(1.0 - new / gen, 4) if gen else 0.0),
                     # hash-table load factor (growth trips near grow_at)
                     load=round(log_n / self._capacity, 4),
-                    vmax=vmax, dmax=dmax)
+                    vmax=vmax, dmax=dmax,
+                    # dispatch->ready / ready->materialized split (see
+                    # _materialize_stats: device compute vs transfer)
+                    device_s=(round(timing[0], 6) if timing else None),
+                    xfer_s=(round(timing[1], 6) if timing else None))
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:

@@ -6,25 +6,34 @@
   :data:`~stateright_tpu.obs.metrics.GLOSSARY`.
 * :class:`~stateright_tpu.obs.trace.RunTrace` — the structured JSONL
   run-trace event stream enabled via ``tpu_options(trace=...)``
-  (zero-cost :data:`~stateright_tpu.obs.trace.NULL_TRACE` when off),
-  with per-event requirements pinned by
+  (zero-cost :data:`~stateright_tpu.obs.trace.NULL_TRACE` when fully
+  off), with per-event requirements pinned by
   :data:`~stateright_tpu.obs.trace.EVENT_SCHEMA`.
+* :class:`~stateright_tpu.obs.recorder.FlightRecorder` — the always-on
+  bounded ring of recent trace events behind every checker, dumped as
+  a JSONL postmortem artifact when a run dies (README.md
+  § Observability, "Flight recorder").
 
 See README.md § Observability for the trace format and how to read a
 stall; ``tools/trace_report.py`` renders a trace as a per-phase table.
 """
 
-from .metrics import GLOSSARY, Metrics
+from .metrics import GAUGES, GLOSSARY, MAXIMA, Metrics
+from .recorder import FlightRecorder, default_flight_path
 from .trace import (EVENT_SCHEMA, NULL_TRACE, NullTrace, RunTrace,
                     fault_info, make_trace, validate_event)
 
 __all__ = [
     "EVENT_SCHEMA",
+    "FlightRecorder",
+    "GAUGES",
     "GLOSSARY",
+    "MAXIMA",
     "Metrics",
     "NULL_TRACE",
     "NullTrace",
     "RunTrace",
+    "default_flight_path",
     "fault_info",
     "make_trace",
     "validate_event",

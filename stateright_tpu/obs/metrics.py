@@ -148,7 +148,35 @@ GLOSSARY: Dict[str, str] = {
              "this so the perf trajectory can't silently mix paths)",
     # --- host search timers -------------------------------------------
     "search": "host-engine search loop wall time",
+    # --- device-time attribution (chunk loops) ------------------------
+    "device_s": "estimated device-execution seconds: the dispatch-to-"
+                "stats-ready interval summed over chunks. Splits the "
+                "old host-side sync_stall conflation of compute and "
+                "transfer; under the pipelined loop host work overlaps "
+                "this interval, so it is an upper bound on pure device "
+                "compute (per-chunk values ride the chunk trace event)",
+    "xfer_s": "estimated device->host transfer seconds: stats-ready-to-"
+              "materialized, summed over chunks (the tunnel round-trip "
+              "component of each sync)",
+    # --- flight recorder (obs/recorder.py) -----------------------------
+    "recorder_dumps": "flight-recorder artifacts written (the bounded "
+                      "always-on event ring dumped as JSONL on error, "
+                      "watchdog expiry, exhausted retries, and "
+                      "degradation rungs; see the recorder_dump trace "
+                      "event for the path)",
 }
+
+#: keys that are point-in-time GAUGES, not accumulating counters:
+#: :meth:`Metrics.merge` takes the incoming value (last-writer-wins)
+#: instead of summing — summing gauges produced impossible merged
+#: values (``fused=2``, a ``mesh_shards`` no mesh ever had).
+GAUGES = frozenset({
+    "mesh_shards", "fused", "engine", "fault_device", "history_ok",
+    "shard_balance",
+})
+
+#: keys merged by maximum (observed buffer-sizing maxima).
+MAXIMA = frozenset({"vmax", "dmax", "rmax", "visit_peak_resident"})
 
 
 class Metrics:
@@ -197,14 +225,19 @@ class Metrics:
         return dict(self._data)
 
     def merge(self, other: "Metrics") -> None:
-        """Fold ``other`` in: timers/counters add, maxima take max.
+        """Fold ``other`` in: timers/counters add, maxima take max, and
+        gauges (:data:`GAUGES`) take the incoming value — last-writer-
+        wins, so a merged profile can never report ``fused=2`` or a
+        summed ``mesh_shards`` no mesh ever had.
 
         Used by consumers that aggregate engines (e.g. the host-vs-
         device race reporting the winner on top of its own bookkeeping).
         """
         for key, value in other._data.items():
-            if key in ("vmax", "dmax", "rmax", "visit_peak_resident"):
+            if key in MAXIMA:
                 self.observe_max(key, value)
+            elif key in GAUGES:
+                self.set(key, value)
             else:
                 self.add_time(key, value)
 

@@ -1,0 +1,95 @@
+"""Flight recorder: an always-on bounded ring of run-trace events.
+
+PR 3's ``RunTrace`` made postmortems possible — *if* the user had
+configured ``tpu_options(trace=...)`` before the run died. Crashes do
+not schedule themselves: the runs that need a postmortem most are
+exactly the ones nobody thought to trace. The flight recorder closes
+that hole the way avionics do — a small ring buffer that is **always
+recording** and is dumped to a JSONL artifact the moment something goes
+wrong (engine error, watchdog expiry, exhausted retries, a degradation
+rung), so every crash is a zero-config postmortem readable by
+``tools/trace_report.py``.
+
+Wiring (see `obs/trace.py` and `checker/host.py`): the recorder rides
+the :class:`~stateright_tpu.obs.trace.RunTrace` emit path as an extra
+sink, so the engines' existing one-branch ``if trace:`` guard covers it
+— no second per-event check on any hot path. With no user trace
+configured the checker now holds a sink-less ``RunTrace`` whose only
+consumer is the ring; ``tpu_options(flight=False)`` restores the old
+``NULL_TRACE`` (and with it the subscribe-refuses behavior). The ring
+is bounded (default 1024 events, ``tpu_options(flight=N)`` resizes), so
+a week-long run records the *recent* history — which is what a
+postmortem reads first — at O(limit) memory.
+
+Dump destination (``HostChecker._flight_target``): an explicit
+``tpu_options(flight_path=...)``, else next to the autosave checkpoint
+(``<autosave>.flight.jsonl`` — the two artifacts a recovery wants
+travel together), else a per-checker file under the system temp dir.
+Every dump emits a ``recorder_dump`` trace event naming the path and
+counts (the event itself is recorded first, so the artifact
+self-describes), and increments the ``recorder_dumps`` metric.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+from collections import deque
+from typing import Any, Dict, List
+
+#: default ring size (events); tpu_options(flight=N) overrides
+DEFAULT_LIMIT = 1024
+
+_DUMP_COUNTER = itertools.count()
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring buffer of trace-event dicts."""
+
+    __slots__ = ("limit", "recorded", "dropped", "_buf", "_lock")
+
+    def __init__(self, limit: int = DEFAULT_LIMIT):
+        self.limit = max(16, int(limit))
+        self.recorded = 0  # total events ever seen
+        self.dropped = 0   # events evicted by the bound
+        self._buf: deque = deque(maxlen=self.limit)
+        self._lock = threading.Lock()
+
+    def record(self, event: Dict[str, Any]) -> None:
+        """Append one event (called from ``RunTrace.emit`` under its
+        sink lock, but locked independently so ``dump`` from another
+        thread — the SSE backlog replay, a crashing engine — is safe).
+        """
+        with self._lock:
+            if len(self._buf) == self.limit:
+                self.dropped += 1
+            self._buf.append(event)
+            self.recorded += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """A copy of the ring's current contents, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    def dump(self, path) -> int:
+        """Write the ring as JSONL to ``path`` (overwrites — repeated
+        dumps of one run keep the most complete artifact at one stable
+        path); returns the number of events written."""
+        events = self.snapshot()
+        with open(os.fspath(path), "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev, separators=(",", ":"),
+                                   default=str) + "\n")
+        return len(events)
+
+
+def default_flight_path(tag: str = "run") -> str:
+    """The zero-config artifact location: a per-dump file under the
+    system temp dir (never the working directory — test suites crash
+    engines on purpose, and artifacts must not litter a repo)."""
+    name = (f"stateright-tpu-flight-{os.getpid()}-"
+            f"{next(_DUMP_COUNTER)}-{tag}.jsonl")
+    return os.path.join(tempfile.gettempdir(), name)

@@ -99,6 +99,11 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "partition": frozenset({"groups"}),
     "ops": frozenset({"op_invoke", "op_return", "op_timeouts"}),
     "soak_done": frozenset({"ops", "history_ok"}),
+    # the flight recorder (obs/recorder.py) wrote its ring as a JSONL
+    # artifact (on error / watchdog expiry / exhausted retries / a
+    # degradation rung); optional fields: `reason`, `dropped` (events
+    # evicted by the ring bound before the dump)
+    "recorder_dump": frozenset({"path", "events"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})
@@ -147,13 +152,23 @@ NULL_TRACE = NullTrace()
 
 
 class RunTrace:
-    """A live JSONL event stream plus in-process subscribers."""
+    """A live JSONL event stream plus in-process subscribers.
 
-    def __init__(self, sink: Any = None, engine: str = "?"):
+    Thread-safety contract: sink writes and the flight-recorder append
+    run under ``_lock`` (so two engines sharing one file sink
+    interleave whole lines); subscriber callbacks run **outside** it on
+    a snapshot of the subscriber list, so one slow subscriber (an SSE
+    client, a rendering console) can never block an engine writer, and
+    ``subscribe`` on a live raced run can never corrupt the iteration
+    (the list is replaced, not mutated, under the lock)."""
+
+    def __init__(self, sink: Any = None, engine: str = "?",
+                 recorder=None):
         self._engine = engine
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
         self._subs: List[Callable[[Dict[str, Any]], None]] = []
+        self._recorder = recorder
         self._write: Optional[Callable[[str], None]] = None
         self._append: Optional[Callable[[Dict[str, Any]], None]] = None
         self._fh = None
@@ -177,7 +192,7 @@ class RunTrace:
 
     def __bool__(self) -> bool:
         return (self._write is not None or self._append is not None
-                or bool(self._subs))
+                or self._recorder is not None or bool(self._subs))
 
     @property
     def enabled(self) -> bool:
@@ -186,8 +201,18 @@ class RunTrace:
     def subscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
         """Register a progress callback invoked with every event dict
         (after the sink write). Callbacks run on the emitting engine's
-        thread and must be fast and exception-free."""
-        self._subs.append(fn)
+        thread, outside the sink lock — they may be slow without
+        blocking the engine, but must be exception-free."""
+        with self._lock:
+            # copy-on-write: emit() iterates a snapshot reference, so
+            # the list object it captured is never mutated under it
+            self._subs = self._subs + [fn]
+
+    def unsubscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Remove a subscriber (no-op if absent) — disconnecting SSE
+        clients and finished consoles detach this way."""
+        with self._lock:
+            self._subs = [s for s in self._subs if s is not fn]
 
     def emit(self, ev: str, **fields) -> None:
         if not self:
@@ -202,8 +227,11 @@ class RunTrace:
                             + "\n")
             if self._append is not None:
                 self._append(event)
-            for fn in self._subs:
-                fn(event)
+            if self._recorder is not None:
+                self._recorder.record(event)
+            subs = self._subs
+        for fn in subs:
+            fn(event)
 
     def close(self) -> None:
         if self._fh is not None:
@@ -212,18 +240,24 @@ class RunTrace:
             self._write = None
 
 
-def make_trace(sink: Any, engine: str) -> "RunTrace | NullTrace":
+def make_trace(sink: Any, engine: str,
+               recorder=None) -> "RunTrace | NullTrace":
     """Build the engine's trace from a ``tpu_options(trace=...)`` value
-    (``None`` -> the shared :data:`NULL_TRACE`). An existing
-    ``RunTrace`` passes through re-tagged with this engine's name."""
-    if sink is None:
+    (``None`` with no recorder -> the shared :data:`NULL_TRACE`). An
+    existing ``RunTrace`` passes through re-tagged with this engine's
+    name. A ``recorder`` (the always-on flight recorder,
+    `obs/recorder.py`) makes the trace truthy even sink-less, so the
+    engines' one-branch ``if trace:`` guard covers it."""
+    if sink is None and recorder is None:
         return NULL_TRACE
     if isinstance(sink, NullTrace):
         return sink
     if isinstance(sink, RunTrace):
         sink._engine = engine
+        if recorder is not None and sink._recorder is None:
+            sink._recorder = recorder
         return sink
-    return RunTrace(sink, engine=engine)
+    return RunTrace(sink, engine=engine, recorder=recorder)
 
 
 def fault_info(model) -> Optional[Dict[str, Any]]:

@@ -310,14 +310,22 @@ class ShardedTpuChecker(TpuChecker):
             if fused_on:
                 self._metrics.inc("fused_chunks")
             inflight.append((int(self._metrics.get("chunks")), stats_d,
-                             int(grow_limit)))
+                             int(grow_limit), time.perf_counter()))
 
-        def process(ordinal: int, stats_d, grow_limit: int) -> set:
+        def process(ordinal: int, stats_d, grow_limit: int,
+                    t_disp: float) -> set:
             nonlocal fault_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # — routed through the fault hook + watchdog deadline
-                stats = self._materialize_stats(stats_d, ordinal)
+                stats = self._materialize_stats(stats_d, ordinal,
+                                                t_disp=t_disp)
+            # device-time attribution (checker/tpu.py
+            # _materialize_stats): dispatch->ready vs ready->pulled
+            timing = self._pull_timing
+            if timing is not None:
+                self._metrics.add_time("device_s", timing[0])
+                self._metrics.add_time("xfer_s", timing[1])
             # a successful sync proves the backend is alive; the retry
             # budget (and the per-device blame streak) bounds
             # CONSECUTIVE faults
@@ -429,7 +437,9 @@ class ShardedTpuChecker(TpuChecker):
                     # owner shard inserted this chunk, plus its live
                     # queue depth
                     shard_new=[int(x) for x in shard_new],
-                    shard_q=[int(x) for x in (q_tail - q_head)])
+                    shard_q=[int(x) for x in (q_tail - q_head)],
+                    device_s=(round(timing[0], 6) if timing else None),
+                    xfer_s=(round(timing[1], 6) if timing else None))
             disc_fps = _combine64(disc_hi, disc_lo)
             for i, prop in enumerate(properties):
                 if i in host_prop_idx:
@@ -638,6 +648,10 @@ class ShardedTpuChecker(TpuChecker):
                     "degrade", from_shards=D, to_shards=new_d,
                     device=blamed,
                     error=f"{type(exc).__name__}: {exc}")
+            # each rung is a postmortem-worthy incident even though the
+            # run survives it: land the ring (the final error dump, if
+            # the ladder too fails, overwrites this with a superset)
+            self._flight_dump("degrade")
             attributor.clear()
             if new_d == 1:
                 # final rung: the plain single-chip loop adopts the

@@ -53,6 +53,31 @@ class TestMetrics:
         assert a.get("chunks") == 5  # counters add
         assert a.get("vmax") == 9  # maxima take max
 
+    def test_merge_gauges_last_writer_wins(self):
+        # gauges were SUMMED on merge: a raced-run fold could report
+        # fused=2 or a mesh width no mesh ever had — pinned here
+        from stateright_tpu.obs import GAUGES, GLOSSARY
+        assert GAUGES <= set(GLOSSARY)
+        a, b = Metrics(), Metrics()
+        a.set("fused", 1)
+        a.set("mesh_shards", 4)
+        a.set("shard_balance", 0.9)
+        a.set("fault_device", 3)
+        b.set("fused", 1)
+        b.set("mesh_shards", 2)
+        b.set("history_ok", 1)
+        a.merge(b)
+        assert a.get("fused") == 1  # NOT 2
+        assert a.get("mesh_shards") == 2  # the incoming width, not 6
+        assert a.get("shard_balance") == 0.9  # absent in b: untouched
+        assert a.get("fault_device") == 3
+        assert a.get("history_ok") == 1
+        # non-gauges still accumulate alongside
+        b2 = Metrics()
+        b2.inc("retries", 2)
+        a.merge(b2)
+        assert a.get("retries") == 2
+
     def test_glossary_covers_engine_keys(self):
         # the canonical keys every engine emits must stay documented
         for key in ("dispatch", "sync_stall", "host_overlap", "grow",
@@ -109,6 +134,74 @@ class TestRunTrace:
         assert tr  # a subscriber enables it
         tr.emit("compile", reason="x")
         assert events[0]["reason"] == "x"
+
+    def test_subscriber_runs_outside_lock(self):
+        """Callbacks fire OUTSIDE the sink lock: a subscriber that
+        itself emits (the SSE relay shape) must not deadlock on the
+        non-reentrant lock, and a slow subscriber must not block
+        another thread's writer. The old code held the lock across
+        callbacks — this test hung under it."""
+        import threading
+
+        events = []
+        tr = RunTrace(events, engine="E")
+
+        def reentrant(ev):
+            if ev["ev"] == "compile":
+                tr.emit("grow", capacity=1)  # deadlocks if lock is held
+
+        tr.subscribe(reentrant)
+        t = threading.Thread(target=lambda: tr.emit("compile",
+                                                    reason="x"),
+                             daemon=True)
+        t.start()
+        t.join(5.0)
+        assert not t.is_alive(), "emit deadlocked on its own subscriber"
+        assert [e["ev"] for e in events] == ["compile", "grow"]
+
+    def test_subscribe_during_live_emit_is_safe(self):
+        """Subscribing to a live raced run raced the un-locked list
+        append against emit's iteration; now appends happen under the
+        lock onto a fresh list (copy-on-write) while emits iterate a
+        snapshot — hammer both sides concurrently."""
+        import threading
+
+        tr = RunTrace([], engine="E")
+        tr.subscribe(lambda ev: None)  # keep it truthy throughout
+        stop = threading.Event()
+        errors = []
+
+        def emitter():
+            try:
+                while not stop.is_set():
+                    tr.emit("compile", reason="x")
+            except BaseException as exc:  # pragma: no cover
+                errors.append(exc)
+
+        t = threading.Thread(target=emitter, daemon=True)
+        t.start()
+        got = []
+        for _ in range(200):
+            fn = got.append
+            tr.subscribe(fn)
+            tr.unsubscribe(fn)
+        stop.set()
+        t.join(5.0)
+        assert not errors
+        assert not t.is_alive()
+
+    def test_unsubscribe(self):
+        got = []
+        tr = RunTrace([], engine="E")
+        tr.subscribe(got.append)
+        tr.emit("compile", reason="a")
+        tr.unsubscribe(got.append)  # different bound object: no-op...
+        assert len(got) == 1
+        fn = got.append
+        tr.subscribe(fn)
+        tr.unsubscribe(fn)
+        tr.emit("compile", reason="b")
+        assert len(got) == 2  # only the still-subscribed first append
 
     def test_validate_rejects_bad_events(self):
         with pytest.raises(ValueError, match="unknown trace event"):
@@ -262,6 +355,56 @@ class TestOverhead:
         t0 = time.perf_counter()
         fn()
         return time.perf_counter() - t0
+
+
+# --- schema drift lint -----------------------------------------------------
+
+class TestSchemaDriftLint:
+    """New instrumentation cannot silently bypass the canonical
+    registries: every literal ``trace.emit("<ev>", ...)`` event name in
+    the source tree must be in EVENT_SCHEMA, and every literal metrics
+    key (``inc``/``set``/``observe_max``/``add_time``/``timed``) must
+    be in GLOSSARY. This is the check that kept PR 3's unification from
+    rotting — a drive-by `self._metrics.inc("my_counter")` fails here,
+    not in a code review six rounds later."""
+
+    def _sources(self):
+        import glob
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        files = glob.glob(os.path.join(root, "stateright_tpu", "**",
+                                       "*.py"), recursive=True)
+        files += glob.glob(os.path.join(root, "tools", "*.py"))
+        files.append(os.path.join(root, "bench.py"))
+        assert len(files) > 40, "source scan found too few files"
+        for path in files:
+            with open(path) as f:
+                yield path, f.read()
+
+    def test_emitted_event_names_are_in_schema(self):
+        import re
+        emit_re = re.compile(r'\.emit\(\s*[\'"]([a-z_0-9]+)[\'"]')
+        bad = [(path, name)
+               for path, src in self._sources()
+               for name in emit_re.findall(src)
+               if name not in EVENT_SCHEMA]
+        assert not bad, f"trace events missing from EVENT_SCHEMA: {bad}"
+
+    def test_metrics_keys_are_in_glossary(self):
+        import re
+        key_res = (
+            re.compile(r'(?:metrics|_metrics)\.'
+                       r'(?:inc|set|observe_max|add_time|timed)'
+                       r'\(\s*[\'"]([a-z_0-9]+)[\'"]'),
+            re.compile(r'self\._timed\(\s*[\'"]([a-z_0-9]+)[\'"]'),
+        )
+        bad = [(path, key)
+               for path, src in self._sources()
+               for rx in key_res
+               for key in rx.findall(src)
+               if key not in GLOSSARY]
+        assert not bad, f"metrics keys missing from GLOSSARY: {bad}"
 
 
 # --- consumers -------------------------------------------------------------

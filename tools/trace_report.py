@@ -13,9 +13,9 @@ found in the trace:
     hit-rate, table load factor, queue depth — the view that makes a
     pipeline stall or a growth storm visible after the fact;
   * interventions (grow/hgrow/egrow/kovf/compile, the resilience
-    layer's retry/watchdog/autosave/failover/degrade events, and the
-    soak harness's live crash/restart/partition injections) with
-    timestamps — on a flaky round this table says *where* the tunnel
+    layer's retry/watchdog/autosave/failover/degrade events,
+    flight-recorder dumps, and the soak harness's live
+    crash/restart/partition injections) with timestamps — on a flaky round this table says *where* the tunnel
     dropped, what the engine did about it, and whether an autosave
     landed;
   * a soak summary line (ops, op timeouts, fault-injection counts,
@@ -146,7 +146,7 @@ def report(events, out=None):
         inters = [e for e in evs if e["ev"] in
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
-                   "degrade", "fused_fallback",
+                   "degrade", "fused_fallback", "recorder_dump",
                    "crash", "restart", "partition")]
         if inters:
             out.write("\ninterventions:\n")
