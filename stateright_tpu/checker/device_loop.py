@@ -793,11 +793,16 @@ _SEED_CACHE = LruCache()
 
 def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                steps: int = 0, symmetry: bool = False, hcap: int = 0,
-               init_fps=None, table_plan=None, ecap: int = 0):
+               init_fps=None, table_plan=None, ecap: int = 0,
+               table=None):
     """Host-side construction of the initial carry (init states enqueued;
     the caller bulk-inserts their fingerprints into the table).
     ``full_ebits`` is a scalar for fresh runs or a per-row array when
-    resuming from a checkpointed frontier.
+    resuming from a checkpointed frontier. ``table`` (a bucket-major
+    ``(key_hi, key_lo)`` pair) adopts an EXISTING visited table instead
+    of allocating zeros — the spill path re-seeds a fresh epoch around
+    the in-place-evicted table without ever pulling its keys to the
+    host (mutually exclusive with ``table_plan``).
 
     The whole construction is ONE jitted dispatch (a dozen separate
     zeros/update dispatches each paid a tunneled-host round trip). The
@@ -811,20 +816,33 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     width = model.packed_width
     prop_count = len(model.properties())
     k = len(init_rows)
+    assert table is None or table_plan is None, \
+        "seed_carry: table= and table_plan= are mutually exclusive"
     kt = 0 if table_plan is None else 1 << max(
         (len(table_plan[1]) - 1).bit_length(), 0)
+    adopt = table is not None
     key = (qcap, capacity, width, prop_count, symmetry, k, hcap, kt,
-           ecap)
+           ecap, adopt)
     fn = _SEED_CACHE.get(key)
     if fn is None:
         logcap = capacity
 
-        def build(seed_block, t_idx, t_hi, t_lo, steps_s):
+        # NOTE: the adopt=False program keeps the original 5-parameter
+        # signature — threading the (unused) table halves through it
+        # would change every seed program's HLO and invalidate the
+        # persistent compile cache for the whole non-spill test matrix
+        def _build(seed_block, t_idx, t_hi, t_lo, steps_s, khi_in,
+                   klo_in):
             q = jnp.zeros((qcap, width + 3), jnp.uint32)
             if k:
                 q = jax.lax.dynamic_update_slice(q, seed_block, (0, 0))
-            key_hi = jnp.zeros((capacity // _BUCKET, _BUCKET), jnp.uint32)
-            key_lo = jnp.zeros((capacity // _BUCKET, _BUCKET), jnp.uint32)
+            if adopt:
+                key_hi, key_lo = khi_in, klo_in
+            else:
+                key_hi = jnp.zeros((capacity // _BUCKET, _BUCKET),
+                                   jnp.uint32)
+                key_lo = jnp.zeros((capacity // _BUCKET, _BUCKET),
+                                   jnp.uint32)
             if kt:
                 # seed the visited table from the host placement plan —
                 # part of this single program, no separate dispatch
@@ -856,7 +874,14 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
                 rmax=jnp.int32(0),
                 pdh=jnp.int32(0), prb=jnp.int32(0))
 
-        fn = jax.jit(build)
+        if adopt:
+            fn = jax.jit(_build)
+        else:
+            def build5(seed_block, t_idx, t_hi, t_lo, steps_s):
+                return _build(seed_block, t_idx, t_hi, t_lo, steps_s,
+                              None, None)
+
+            fn = jax.jit(build5)
         _SEED_CACHE[key] = fn
     if k:
         init_arr = np.stack(init_rows).astype(np.uint32)
@@ -882,4 +907,7 @@ def seed_carry(model, qcap: int, capacity: int, init_rows, full_ebits,
     else:
         t_idx = np.zeros((0,), np.int32)
         t_hi = t_lo = np.zeros((0,), np.uint32)
+    if adopt:
+        return fn(seed_block, t_idx, t_hi, t_lo, jnp.int32(steps),
+                  table[0], table[1])
     return fn(seed_block, t_idx, t_hi, t_lo, jnp.int32(steps))

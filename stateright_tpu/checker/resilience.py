@@ -100,7 +100,8 @@ TRANSIENT_MARKERS = (
 
 #: lowercase substrings marking a capacity/model error — retrying
 #: reproduces these; the fix is a bigger bound (tpu_options(capacity=),
-#: hcap=, net_capacity, ...).
+#: hcap=, net_capacity, ...) — or, for the table/allocation subset
+#: (SPILLABLE_MARKERS), a spill of the visited set into the host tier.
 CAPACITY_MARKERS = (
     "resource_exhausted",
     "resource exhausted",
@@ -111,11 +112,33 @@ CAPACITY_MARKERS = (
     "table overflow",
 )
 
+#: the capacity subset a visited-set spill can actually relieve: table
+#: and allocation pressure. "capacity overflow" is deliberately absent —
+#: that is the PACKED-STATE encoding bound (net_capacity and friends;
+#: `checker/tpu.py` ``_XOVF_MESSAGE``), which no amount of host-tiering
+#: fixes, so it stays terminal.
+SPILLABLE_MARKERS = tuple(m for m in CAPACITY_MARKERS
+                          if m != "capacity overflow")
+
 
 class ChunkDeadlineError(RuntimeError):
     """A chunk sync outran ``tpu_options(chunk_deadline=s)`` — a hung
     dispatch reclassified as a transient fault instead of an eternal
     hang (the watchdog; classified TRANSIENT by construction)."""
+
+
+class CandidateOverflowError(RuntimeError):
+    """A wedged ``kovf`` protocol: the candidate-buffer resize made no
+    progress (the fused/sharded pre-mutation abort would rebuild the
+    identical program and abort forever). The message carries a
+    :data:`CAPACITY_MARKERS` phrase so :func:`classify_error` reports
+    CAPACITY, and the retry envelope recovers by growing the k-buffer
+    to its bound and re-seeding, instead of surfacing to the user."""
+
+    def __init__(self, msg: str, vmax: int = 0, dmax: int = 0,
+                 bmax: int = 0):
+        super().__init__(msg)
+        self.vmax, self.dmax, self.bmax = vmax, dmax, bmax
 
 
 def classify_error(exc: BaseException) -> FaultKind:
@@ -136,6 +159,44 @@ def classify_error(exc: BaseException) -> FaultKind:
             return FaultKind.CAPACITY
         e = e.__cause__ if e.__cause__ is not None else e.__context__
     return FaultKind.PROGRAMMING
+
+
+def find_candidate_overflow(
+        exc: BaseException) -> "Optional[CandidateOverflowError]":
+    """The :class:`CandidateOverflowError` in ``exc``'s cause chain, if
+    any — the retry envelope's capacity branch recovers from one by
+    growing the k-buffer instead of evicting table ranges."""
+    seen: set = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, CandidateOverflowError):
+            return e
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return None
+
+
+def spill_eligible(exc: BaseException) -> bool:
+    """Whether a capacity-classified fault is one a visited-set spill
+    (HBM -> host tier) can relieve: table/allocation pressure
+    (:data:`SPILLABLE_MARKERS`) or a wedged candidate-buffer protocol
+    (:class:`CandidateOverflowError`). Packed-state encoding overflows
+    (``xovf``) are capacity faults too, but tiering cannot fix a model
+    bound — they stay terminal. Walks the cause chain like
+    :func:`classify_error`."""
+    seen: set = set()
+    e: Optional[BaseException] = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, CandidateOverflowError):
+            return True
+        msg = f"{type(e).__name__}: {e}".lower()
+        if "packed-state capacity overflow" in msg:
+            return False
+        if any(m in msg for m in SPILLABLE_MARKERS):
+            return True
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return False
 
 
 # ----------------------------------------------------------------------
@@ -297,6 +358,60 @@ class DegradePolicy:
                    blame_after=int(opts.get("blame_after", 2)))
 
 
+class SpillPolicy:
+    """Visited-set tiering HBM -> host RAM (README § Memory tiering).
+
+    The device table growth protocol quadruples capacity until the
+    state space fits; ``tpu_options(max_capacity=N)`` caps that at the
+    HBM budget. Once growth would exceed the cap — or an allocation
+    raises a spill-eligible capacity fault inside the retry envelope —
+    the engines drain the pipeline, evict the coldest
+    fingerprint-prefix ranges from the device table into the host tier
+    (:class:`HostShadow` already holds every key; eviction just shrinks
+    the device-resident hot set), re-seed and resume. Rediscoveries of
+    evicted keys are filtered against the host tier during the
+    pipeline's host-side process stage, so a capped run enumerates the
+    same fingerprint set as an uncapped one.
+
+    ``spill`` (default True) gates eligibility; ``spill_frac`` is the
+    fraction of resident keys each spill targets for eviction;
+    ``max_spills`` bounds CONSECUTIVE fault-driven spills (reset by any
+    successful chunk sync) before the run takes the capacity-terminal
+    ending (checkpoint + flight dump + actionable raise)."""
+
+    __slots__ = ("enabled", "max_capacity", "frac", "max_spills")
+
+    def __init__(self, enabled: bool = True,
+                 max_capacity: Optional[int] = None, frac: float = 0.5,
+                 max_spills: int = 8):
+        if max_capacity is not None:
+            max_capacity = int(max_capacity)
+            if max_capacity < 4 or (max_capacity & (max_capacity - 1)):
+                raise ValueError(
+                    "tpu_options(max_capacity=...) must be a power of "
+                    "two >= 4 (the table quadruples up to it)")
+        frac = float(frac)
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(
+                "tpu_options(spill_frac=...) must be in (0, 1]")
+        self.enabled = bool(enabled)
+        self.max_capacity = max_capacity
+        self.frac = frac
+        self.max_spills = max(1, int(max_spills))
+
+    @classmethod
+    def from_options(cls, opts: dict) -> "SpillPolicy":
+        return cls(enabled=bool(opts.get("spill", True)),
+                   max_capacity=opts.get("max_capacity"),
+                   frac=float(opts.get("spill_frac", 0.5)),
+                   max_spills=int(opts.get("max_spills", 8)))
+
+    def can_grow(self, capacity: int) -> bool:
+        """Whether quadrupling ``capacity`` stays inside the budget."""
+        return self.max_capacity is None \
+            or capacity * 4 <= self.max_capacity
+
+
 # ----------------------------------------------------------------------
 # watchdog
 # ----------------------------------------------------------------------
@@ -352,6 +467,21 @@ def pack_qrows(rows, ebits, fps, width: int) -> np.ndarray:
     return out
 
 
+#: fingerprint-prefix granularity of the host tier: eviction ranges are
+#: buckets of the dedup key's TOP 8 bits. Top bits compose with
+#: ``owner_of(fp, D)`` (also top-bit) routing: with D <= 256 every
+#: prefix bucket lies entirely inside one shard, so per-shard eviction
+#: ranges are owner-consistent by construction and survive mesh
+#: halving (adjacent shards merge, adjacent prefix sets merge).
+SPILL_PREFIX_BITS = 8
+
+
+def fp_prefix(fps) -> np.ndarray:
+    """The host-tier prefix bucket of each 64-bit dedup key."""
+    return (np.asarray(fps, np.uint64)
+            >> np.uint64(64 - SPILL_PREFIX_BITS)).astype(np.int64)
+
+
 _GATHER_JIT = None
 
 
@@ -402,6 +532,18 @@ class HostShadow:
     log row ``i`` of its shard), and growth passes preserve every
     shard-relative position — so per-chunk gathers of the new suffixes
     reconstruct the device state exactly.
+
+    With :class:`SpillPolicy` tiering active the shadow additionally IS
+    the host tier: it tracks which fingerprint-prefix ranges have been
+    evicted from the device table (``evicted_prefixes`` — top
+    :data:`SPILL_PREFIX_BITS` bits of the dedup key, so ranges compose
+    with ``owner_of``'s top-bit shard routing and survive
+    :meth:`reshard` down the degradation ladder), a per-prefix
+    last-touch clock that :meth:`spill_plan` uses to pick the COLDEST
+    ranges, and :meth:`probe_host` — the batched membership check the
+    engines run over each chunk's device-"fresh" keys so rediscoveries
+    of evicted keys are filtered (and never corrupt the parent mirror)
+    before their successors are counted.
     """
 
     def __init__(self, shards: int, width: int, generated: Dict,
@@ -412,6 +554,17 @@ class HostShadow:
         self._orig_of = orig_of
         self._translate = translate
         self._sound = sound
+        # --- memory tiering (SpillPolicy) -----------------------------
+        #: device-evicted fingerprint-prefix buckets (monotone: a prefix
+        #: stays evicted once spilled — re-promotion would need the
+        #: device table to re-absorb keys the budget just rejected)
+        self.evicted_prefixes: set = set()
+        #: keys resident ONLY in the host tier at the last spill
+        self.host_tier_keys = 0
+        #: cumulative rediscoveries filtered against the host tier
+        self.host_probe_hits = 0
+        self._heat = np.zeros((1 << SPILL_PREFIX_BITS,), np.int64)
+        self._clock = 0
         self._roots: List[int] = []   # first-epoch dedup keys (lasso)
         self._first_epoch = True
         # cumulative across epochs (the lasso sweep's inputs)
@@ -448,11 +601,16 @@ class HostShadow:
                         if self._sound else fp)
 
     def note_chunk(self, s: int, q_new: np.ndarray, log_new: np.ndarray,
-                   elog_new: Optional[np.ndarray], q_head: int) -> None:
+                   elog_new: Optional[np.ndarray], q_head: int) -> int:
         """Fold one chunk's per-shard appends in (queue rows and log
-        rows are the lockstep suffixes; counts must match)."""
+        rows are the lockstep suffixes; counts must match). Returns the
+        number of device-"fresh" keys the host tier recognized as
+        rediscoveries (0 while no ranges are evicted) — those keys'
+        mirror entries are left untouched, so a rediscovery can never
+        rewrite a parent chain into a cycle."""
         n = len(log_new)
         assert len(q_new) == n, (len(q_new), n)
+        hits = 0
         if n:
             q_new = np.asarray(q_new, np.uint32)
             log_new = np.asarray(log_new, np.uint32)
@@ -462,14 +620,106 @@ class HostShadow:
             self._inserts[s].append((log_new, q_new[:, self.width]))
             child = _combine64(log_new[:, 0], log_new[:, 1])
             parent = _combine64(log_new[:, 2], log_new[:, 3])
-            self._generated.update(zip(child.tolist(), parent.tolist()))
+            # per-prefix last-touch clock: newly inserted children mark
+            # their ranges hot, and so do the parents being expanded —
+            # the ranges dedup is currently hitting are the ones NOT to
+            # evict
+            self._clock += 1
+            self._heat[np.unique(np.concatenate(
+                (fp_prefix(child), fp_prefix(parent))))] = self._clock
+            pairs = zip(child.tolist(), parent.tolist())
+            if self.evicted_prefixes:
+                # host-tier re-probe: with eviction active a device-
+                # "fresh" key may be a rediscovery (its range was
+                # evicted, or bucket compaction opened an earlier slot);
+                # only genuinely fresh keys enter the mirror
+                g = self._generated
+                fresh = [(c, p) for c, p in pairs if c not in g]
+                hits = n - len(fresh)
+                self.host_probe_hits += hits
+                self.host_tier_keys = max(0, self.host_tier_keys - hits)
+                pairs = fresh
+                g.update(pairs)
+            else:
+                self._generated.update(pairs)
             if self._translate:
                 orig = _combine64(log_new[:, 4], log_new[:, 5])
-                self._orig_of.update(zip(child.tolist(), orig.tolist()))
+                if self.evicted_prefixes:
+                    keep = {c for c, _p in pairs}
+                    self._orig_of.update(
+                        (c, o) for c, o in zip(child.tolist(),
+                                               orig.tolist())
+                        if c in keep)
+                else:
+                    self._orig_of.update(zip(child.tolist(),
+                                             orig.tolist()))
         if elog_new is not None and len(elog_new):
             self._edges[s].append(np.asarray(elog_new, np.uint32))
             self.e_n[s] += len(elog_new)
         self._heads[s] = int(q_head)
+        return hits
+
+    # --- memory tiering (SpillPolicy) ---------------------------------
+    @property
+    def spill_active(self) -> bool:
+        return bool(self.evicted_prefixes)
+
+    def is_evicted(self, key: int) -> bool:
+        return (int(key) >> (64 - SPILL_PREFIX_BITS)) \
+            in self.evicted_prefixes
+
+    def hot_keys(self) -> List[int]:
+        """The device-resident hot set: every mirrored dedup key whose
+        prefix range has not been evicted — what a post-fault re-seed
+        (or a degradation rung) re-inserts into the device table."""
+        if not self.evicted_prefixes:
+            return list(self._generated.keys())
+        shift = 64 - SPILL_PREFIX_BITS
+        ev = self.evicted_prefixes
+        return [k for k in self._generated if (k >> shift) not in ev]
+
+    def probe_host(self, fps) -> np.ndarray:
+        """Batched host-tier membership: ``mask[i]`` is True when
+        ``fps[i]`` is already in the authoritative mirror (a duplicate
+        the device table could no longer see)."""
+        g = self._generated
+        return np.fromiter((int(f) in g for f in np.asarray(fps)),
+                           bool, len(fps))
+
+    def spill_plan(self, hot_budget: int):
+        """Pick the coldest not-yet-evicted prefix ranges until the
+        projected device-resident key count fits ``hot_budget``.
+
+        Returns ``(new_prefixes, hot_count, evicted_now)`` — the ranges
+        to evict now (possibly empty when everything over budget is
+        already evicted), the resulting hot-set size, and the number of
+        mirrored keys those new ranges move to the host tier — or
+        ``None`` when no plan can shrink the hot set below the budget
+        (host tier exhausted in the only sense that matters: eviction
+        cannot make more room)."""
+        keys = np.fromiter((int(k) for k in self._generated), np.uint64,
+                           len(self._generated))
+        counts = np.bincount(fp_prefix(keys),
+                             minlength=1 << SPILL_PREFIX_BITS)
+        resident = [p for p in range(1 << SPILL_PREFIX_BITS)
+                    if counts[p] and p not in self.evicted_prefixes]
+        hot = int(sum(counts[p] for p in resident))
+        new: List[int] = []
+        evicted_now = 0
+        # coldest first: oldest last-touch clock, prefix as tiebreak
+        for p in sorted(resident, key=lambda p: (self._heat[p], p)):
+            if hot <= hot_budget:
+                break
+            new.append(p)
+            hot -= int(counts[p])
+            evicted_now += int(counts[p])
+        if hot > hot_budget:
+            return None
+        self.evicted_prefixes.update(new)
+        self.host_tier_keys = int(
+            sum(int(counts[p]) for p in self.evicted_prefixes
+                if p < len(counts)))
+        return new, hot, evicted_now
 
     def reshard(self, shards: int) -> None:
         """Re-partition for a new mesh width (the degradation ladder).

@@ -154,6 +154,21 @@ def _insert_jit():
     return _INSERT_JIT
 
 
+_EVICT_JIT = None
+
+
+def _evict_jit():
+    """Process-wide jitted ``table_evict_prefix`` (the spill path's
+    in-place range eviction; shapes retrace within one wrapper)."""
+    global _EVICT_JIT
+    if _EVICT_JIT is None:
+        import jax
+
+        from ..ops.hashtable import table_evict_prefix
+        _EVICT_JIT = jax.jit(table_evict_prefix)
+    return _EVICT_JIT
+
+
 def build_level_fn(model, symmetry: bool = False):
     """Build the jitted single-chip BFS level step for a packed model.
 
@@ -336,9 +351,20 @@ class TpuChecker(HostChecker):
         self._host_fns = self._resolve_host_fns(
             getattr(model, "host_property_fns", None))
         # --- resilience knobs (checker/resilience.py) ------------------
-        from .resilience import DegradePolicy, RetryPolicy
+        from .resilience import DegradePolicy, RetryPolicy, SpillPolicy
         self._retry_policy = RetryPolicy.from_options(opts)
         self._degrade_policy = DegradePolicy.from_options(opts)
+        # memory tiering (README § Memory tiering): growth past the HBM
+        # budget — or a spill-eligible capacity fault in the retry
+        # envelope — evicts cold fingerprint-prefix ranges to the host
+        # tier instead of dying
+        self._spill_policy = SpillPolicy.from_options(opts)
+        if self._spill_policy.max_capacity is not None \
+                and self._spill_policy.max_capacity < self._capacity:
+            raise ValueError(
+                f"tpu_options(max_capacity={self._spill_policy.max_capacity}) "
+                f"is below capacity={self._capacity}; the budget caps "
+                "GROWTH, so it must be >= the starting capacity")
         self._fault_hook = opts.get("fault_hook")
         # legacy hooks take (chunk); two-parameter hooks also receive
         # the current mesh width, so an injected "permanent" device
@@ -393,6 +419,13 @@ class TpuChecker(HostChecker):
                 raise NotImplementedError(
                     "sound_eventually() with host-evaluated properties "
                     "is not supported on the TPU engine")
+            if self._spill_policy.max_capacity is not None:
+                raise NotImplementedError(
+                    "tpu_options(max_capacity=...) memory tiering is "
+                    "not supported with sound_eventually(): rediscovered"
+                    " node keys would re-enter the cross-edge records "
+                    "the lasso sweep treats as a faithful node graph. "
+                    "Raise tpu_options(capacity=...) instead.")
         # host-property history dedup (device engine): the history-key
         # table rides IN the chunk carry (device_loop.ChunkCarry.hkey_*);
         # hcap is its capacity, grown on occupancy pressure or hovf.
@@ -528,9 +561,13 @@ class TpuChecker(HostChecker):
         retry or autosave is on (``None`` otherwise — zero cost). A
         degraded-mesh handoff re-adopts the run-spanning shadow (its
         cumulative insert/edge records feed the sound-mode lasso sweep
-        across every epoch and rung) instead of starting a fresh one."""
+        across every epoch and rung) instead of starting a fresh one.
+        An HBM budget (``max_capacity``) also turns the shadow on — the
+        host tier IS the shadow, so tiering cannot run without it."""
         if not (self._retry_policy.enabled
-                or self._autosave_path is not None):
+                or self._autosave_path is not None
+                or (self._spill_policy.enabled
+                    and self._spill_policy.max_capacity is not None)):
             return None
         adopted = self._handoff_shadow
         if adopted is not None:
@@ -674,6 +711,30 @@ class TpuChecker(HostChecker):
             f"({type(exc).__name__}: {exc}); set "
             "tpu_options(autosave=path) to checkpoint progress on "
             "exhausted retries") from exc
+
+    def _capacity_terminal(self, exc: BaseException, shadow,
+                           discoveries: Dict[str, object]) -> None:
+        """Capacity-class termination — spill disabled, ineligible, or
+        the host tier exhausted too: land the postmortem artifacts a
+        watchdog/retry exhaustion already gets (flight-recorder dump,
+        and an autosave checkpoint when configured) before raising ONE
+        actionable error naming both outs (a bigger bound, or resume)."""
+        self._flight_dump("capacity")
+        detail = f"{type(exc).__name__}: {exc}"
+        if self._autosave_path is not None and shadow is not None:
+            self._write_autosave(shadow, discoveries)
+            path = os.fspath(self._autosave_path)
+            raise RuntimeError(
+                f"capacity exhausted and not recoverable by spill "
+                f"({detail}); progress checkpointed to {path!r} — raise "
+                "tpu_options(capacity=...) (or max_capacity=...) and "
+                f"resume with model.checker().resume_from({path!r})"
+                ".spawn_tpu()") from exc
+        raise RuntimeError(
+            f"capacity exhausted and not recoverable by spill "
+            f"({detail}); raise tpu_options(capacity=...) (or "
+            "max_capacity=...), or set tpu_options(autosave=path) to "
+            "checkpoint progress at this point next time") from exc
 
     def _shadow_lasso_sweep(self, shadow, full_mask: int,
                             discoveries: Dict[str, object]) -> None:
@@ -898,18 +959,64 @@ class TpuChecker(HostChecker):
             # (bfs.rs:121-128)
             return
 
+        # --- resilience plumbing (checker/resilience.py), created
+        # BEFORE the seed: with memory tiering the shadow decides which
+        # keys are device-resident at all (a degraded-mesh handoff may
+        # arrive with ranges already evicted down the ladder)
+        from .resilience import (SPILL_PREFIX_BITS, FaultKind,
+                                 blamed_device, classify_error,
+                                 find_candidate_overflow, gather_rows,
+                                 pack_qrows, spill_eligible)
+
+        policy = self._retry_policy
+        spill_pol = self._spill_policy
+        spill_on = spill_pol.enabled and not self._sound
+        shadow = self._make_shadow(1)
+
         # one while_loop iteration inserts at most kmax new states (and at
         # most fa once kmax has grown to its bound); capacity must keep
         # that headroom below the growth exit. ``preload`` is the table
         # occupancy seeded before the first chunk (just the inits on a
         # fresh run, the WHOLE mirrored reached set on a resume or a
-        # post-fault re-seed) — the growth trigger compares the
-        # epoch-local device log count against the limit, so the limit
-        # must leave room for the preloaded keys
+        # post-fault re-seed — minus the host tier once ranges have been
+        # evicted) — the growth trigger compares the epoch-local device
+        # log count against the limit, so the limit must leave room for
+        # the preloaded keys
         headroom = fa
-        preload = len(generated)
-        while self._grow_at * self._capacity <= headroom + preload:
+        seed_keys = (shadow.hot_keys() if shadow is not None
+                     else list(generated.keys()))
+        preload = len(seed_keys)
+        while self._grow_at * self._capacity <= headroom + preload \
+                and spill_pol.can_grow(self._capacity):
             self._capacity *= 4
+        if self._grow_at * self._capacity <= headroom + preload:
+            # the preloaded set alone exceeds the HBM budget (a resumed
+            # mirror, or a handoff after heavy spilling): evict at seed
+            plan = (shadow.spill_plan(
+                int(self._grow_at * self._capacity) - headroom - 1)
+                if spill_on and shadow is not None else None)
+            if plan is None:
+                self._capacity_terminal(RuntimeError(
+                    f"device hash table budget (max_capacity="
+                    f"{spill_pol.max_capacity}) cannot hold the seeded "
+                    f"reached set ({preload} keys) with spill "
+                    "unavailable"), shadow, discoveries)
+            seed_keys = shadow.hot_keys()
+            preload = len(seed_keys)
+            self._metrics.inc("spills")
+            if plan[2]:
+                self._metrics.inc("evicted_keys", plan[2])
+            self._metrics.set("host_tier_keys", shadow.host_tier_keys)
+            if self._trace:
+                self._trace.emit("evict", prefixes=len(plan[0]),
+                                 keys=plan[2])
+                self._trace.emit("spill", capacity=self._capacity,
+                                 hot=preload, reason="seed",
+                                 host_tier_keys=shadow.host_tier_keys)
+
+        # growth re-inserts the seed-time keys the device log lacks: the
+        # HOT set only — re-promoting evicted ranges would undo a spill
+        self._base_fps = seed_keys
 
         # append-only queue: must hold every state enqueued before the next
         # growth point (n_init + grow_limit) plus one iteration of appends
@@ -937,8 +1044,9 @@ class TpuChecker(HostChecker):
             # tunneled device even for a handful of keys). Large seeds
             # (checkpoint resume mirrors the whole reached set) keep the
             # chunked device insert: the host plan's per-fingerprint
-            # Python loop would be the slow path there.
-            seed_keys = list(generated.keys())
+            # Python loop would be the slow path there. seed_keys is
+            # the device-resident HOT set (== the whole mirror until
+            # ranges have been evicted to the host tier).
             table_plan = None
             if len(seed_keys) <= (1 << 15):
                 from ..ops.hashtable import plan_insert_host
@@ -983,16 +1091,11 @@ class TpuChecker(HostChecker):
         chunk_fn = mk_chunk()
         pipeline = bool(opts.get("pipeline", True))
 
-        # --- resilience (checker/resilience.py) -------------------------
-        # with retry or autosave on, the host keeps the authoritative
-        # shadow (mirror + pending frontier + sound-mode edge records),
-        # updated per chunk; a transient backend fault re-seeds a fresh
-        # device incarnation from it and resumes
-        from .resilience import (FaultKind, blamed_device, classify_error,
-                                 gather_rows, pack_qrows)
-
-        policy = self._retry_policy
-        shadow = self._make_shadow(1)
+        # with retry, autosave or tiering on, the host keeps the
+        # authoritative shadow (mirror + pending frontier + sound-mode
+        # edge records + the host tier), updated per chunk; a transient
+        # backend fault re-seeds a fresh device incarnation from it and
+        # resumes, and a capacity fault spills before re-seeding
         if shadow is not None:
             shadow.seed_epoch([pack_qrows(init_rows, seed_ebits,
                                           cache_fps,
@@ -1065,7 +1168,7 @@ class TpuChecker(HostChecker):
                     hcap_d: int, t_disp: float) -> set:
             """Consume one chunk's stats vector; returns the host
             actions it demands (handled once the pipeline is drained)."""
-            nonlocal seed_ovf, fault_attempt
+            nonlocal seed_ovf, fault_attempt, spill_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # (scalars + the representative window when host props
@@ -1080,7 +1183,9 @@ class TpuChecker(HostChecker):
                 self._metrics.add_time("xfer_s", timing[1])
             # a successful sync proves the backend is alive: the retry
             # budget bounds CONSECUTIVE faults, not lifetime hiccups
+            # (and the spill budget CONSECUTIVE unproductive spills)
             fault_attempt = 0
+            spill_attempt = 0
             t0 = time.perf_counter()
             acts: set = set()
             (q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
@@ -1113,7 +1218,15 @@ class TpuChecker(HostChecker):
                     if ecap:
                         e_new = gather_rows(carry.elog, np.arange(
                             shadow.e_n[0], e_n, dtype=np.int32))
-                    shadow.note_chunk(0, q_new, log_new, e_new, q_head)
+                    hits = shadow.note_chunk(0, q_new, log_new, e_new,
+                                             q_head)
+                    if hits:
+                        # host-tier re-probe: device-"fresh" keys the
+                        # mirror already held (rediscoveries of evicted
+                        # ranges); excluded from the unique counts
+                        self._metrics.inc("host_probe_hits", hits)
+                        self._metrics.set("host_tier_keys",
+                                          shadow.host_tier_keys)
                 if (self._autosave_path is not None
                         and self._autosave_every > 0
                         and ordinal % self._autosave_every == 0):
@@ -1136,7 +1249,12 @@ class TpuChecker(HostChecker):
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen
-            self._unique_state_count = base_unique + log_n
+            # with the shadow on, len(generated) is authoritative — and
+            # past a spill it is the ONLY correct count (the device log
+            # includes host-filtered rediscoveries)
+            self._unique_state_count = (len(generated)
+                                        if shadow is not None
+                                        else base_unique + log_n)
             trace = self._trace
             if trace:
                 trace.emit(
@@ -1278,6 +1396,7 @@ class TpuChecker(HostChecker):
             # kraw), dmax = post-dedup max (sizes kmax).
             nonlocal carry, chunk_fn, kraw, kmax, hint_eff
             vmax, dmax, rmax = kovf_pend
+            before = (kraw, kmax, hint_eff)
             grew = False
             if hint_eff and rmax > hint_eff:
                 hint_eff = max(hint_eff + 1, rmax + rmax // 4)
@@ -1296,6 +1415,17 @@ class TpuChecker(HostChecker):
                            else fmax * hint_eff)
             kmax = min(kmax, kraw if not hint_eff
                        else fmax * hint_eff)
+            if (kraw, kmax, hint_eff) == before:
+                # wedged: rebuilding the identical program would abort
+                # forever — reclassify as a capacity fault; the retry
+                # envelope recovers with a k-buffer grown to its bound
+                # (a pre-mutation abort lost no data)
+                from .resilience import CandidateOverflowError
+                raise CandidateOverflowError(
+                    "candidate-buffer capacity overflow (kovf) wedged "
+                    f"at kraw={kraw} kmax={kmax} hint={hint_eff} "
+                    f"(observed vmax={vmax} dmax={dmax} rmax={rmax})",
+                    vmax=vmax, dmax=dmax)
             self._metrics.inc("kovfs")
             if self._trace:
                 self._trace.emit("kovf", kraw=kraw, kmax=kmax,
@@ -1329,6 +1459,102 @@ class TpuChecker(HostChecker):
                                  qcap=qcap)
             chunk_fn = mk_chunk("grow")
 
+        spill_warned = [False]
+
+        def warn_spill_eventually() -> None:
+            # unsound EVENTUALLY + spill: a rediscovered duplicate
+            # re-enqueues with its rediscovery path's pending bits, so
+            # eventually verdicts may differ from an uncapped run — the
+            # same path-dependence the unsound engine already documents,
+            # but worth a one-time flag. sound_eventually() rejects
+            # tiering up front instead.
+            if spill_warned[0] or self._sound:
+                return
+            if any(p.expectation == Expectation.EVENTUALLY
+                   for p in properties):
+                import warnings
+                warnings.warn(
+                    "memory tiering with (unsound) eventually "
+                    "properties: rediscovered duplicates re-enter the "
+                    "frontier with rediscovery-path pending bits, so "
+                    "eventually verdicts may differ from an uncapped "
+                    "run (safety properties and fingerprint sets are "
+                    "unaffected)", RuntimeWarning, stacklevel=2)
+            spill_warned[0] = True
+
+        def handle_spill(reason: str = "budget") -> None:
+            # the memory wall, survived: growth would exceed the HBM
+            # budget, so drain (the caller already did), evict the
+            # coldest fingerprint-prefix ranges from the device table
+            # IN PLACE (ops/hashtable.py table_evict_prefix — the host
+            # tier already holds every key), and re-seed a fresh epoch
+            # around the evicted table: the queue/log reset bounds the
+            # epoch buffers, and the growth limit's preload term drops
+            # by the evicted occupancy, making room to keep checking.
+            nonlocal carry, chunk_fn, qcap, hcap, n_init, base_unique, \
+                preload
+            if int(min(self._grow_at * self._capacity,
+                       self._capacity - headroom)) <= 0:
+                # even an empty table cannot fit one iteration's
+                # headroom under this budget: spilling again would spin
+                # forever at zero progress
+                self._capacity_terminal(RuntimeError(
+                    f"device table budget (capacity {self._capacity}) "
+                    f"cannot fit one iteration's headroom ({headroom}) "
+                    "— raise tpu_options(max_capacity=...) or shrink "
+                    "fmax/kmax"), shadow, discoveries)
+            occupancy = preload + cur["log_n"]
+            hot_budget = max(0, min(
+                int((1.0 - spill_pol.frac) * occupancy),
+                int(self._grow_at * self._capacity) - headroom - 1))
+            plan = shadow.spill_plan(hot_budget)
+            if plan is None:
+                self._capacity_terminal(RuntimeError(
+                    "host tier exhausted: range eviction cannot bring "
+                    f"the device table (capacity {self._capacity}) "
+                    "under its growth budget"), shadow, discoveries)
+            warn_spill_eventually()
+            with self._timed("spill"):
+                mask = np.zeros((1 << SPILL_PREFIX_BITS,), bool)
+                mask[sorted(shadow.evicted_prefixes)] = True
+                khi, klo, ecount_d = _evict_jit()(
+                    carry.key_hi, carry.key_lo, jnp.asarray(mask))
+                ecount = int(jax.device_get(ecount_d))
+                rows, ebs, fps = shadow.pending()
+                init_rows2 = [rows[i] for i in range(rows.shape[0])]
+                n_init = len(init_rows2)
+                self._h_pulled = 0
+                self._hscan_tail = n_init
+                self._base_fps = shadow.hot_keys()
+                base_unique = len(generated)
+                preload = max(occupancy - ecount, 0)
+                qcap = self._device_qcap(n_init, headroom)
+                hcap = (self._posthoc_cap
+                        if self._host_props and want_reps_now() else 0)
+                with self._timed("seed"):
+                    carry = seed_carry(
+                        model, qcap, self._capacity, init_rows2,
+                        np.asarray(ebs, np.uint32),
+                        symmetry=self._symmetry or self._sound,
+                        hcap=hcap, init_fps=[int(f) for f in fps],
+                        ecap=ecap, table=(khi, klo))
+                shadow.seed_epoch([pack_qrows(init_rows2, ebs, fps,
+                                              model.packed_width)])
+            cur.update(q_size=n_init, q_tail=n_init, log_n=0, e_n=0)
+            hgrow_pend.update(on=False, hovf=False, h_n=0)
+            kovf_pend[:] = [0, 0, 0]
+            self._metrics.inc("spills")
+            if ecount:
+                self._metrics.inc("evicted_keys", ecount)
+            self._metrics.set("host_tier_keys", shadow.host_tier_keys)
+            if self._trace:
+                self._trace.emit("evict", prefixes=len(plan[0]),
+                                 keys=ecount)
+                self._trace.emit("spill", capacity=self._capacity,
+                                 hot=preload, reason=reason,
+                                 host_tier_keys=shadow.host_tier_keys)
+            chunk_fn = mk_chunk("spill")
+
         def reseed() -> None:
             # post-fault recovery: rebuild the device state from the
             # shadow — a fresh carry seeded with the pending frontier,
@@ -1344,11 +1570,40 @@ class TpuChecker(HostChecker):
             n_init = len(init_rows2)
             self._h_pulled = 0
             self._hscan_tail = n_init
-            self._base_fps = list(generated.keys())
+            # the device table re-seeds with the HOT set only (== the
+            # whole mirror until ranges have been evicted): a recovery
+            # must not re-promote what a spill just moved host-side
+            hot = shadow.hot_keys()
+            self._base_fps = hot
             base_unique = len(generated)
-            preload = len(generated)
-            while self._grow_at * self._capacity <= headroom + preload:
+            preload = len(hot)
+            while self._grow_at * self._capacity <= headroom + preload \
+                    and spill_pol.can_grow(self._capacity):
                 self._capacity *= 4
+            if self._grow_at * self._capacity <= headroom + preload:
+                plan = (shadow.spill_plan(
+                    int(self._grow_at * self._capacity) - headroom - 1)
+                    if spill_on else None)
+                if plan is None:
+                    self._capacity_terminal(RuntimeError(
+                        "device hash table budget (max_capacity="
+                        f"{spill_pol.max_capacity}) cannot hold the "
+                        f"re-seeded hot set ({preload} keys)"),
+                        shadow, discoveries)
+                hot = shadow.hot_keys()
+                self._base_fps = hot
+                preload = len(hot)
+                self._metrics.inc("spills")
+                if plan[2]:
+                    self._metrics.inc("evicted_keys", plan[2])
+                self._metrics.set("host_tier_keys",
+                                  shadow.host_tier_keys)
+                if self._trace:
+                    self._trace.emit("evict", prefixes=len(plan[0]),
+                                     keys=plan[2])
+                    self._trace.emit("spill", capacity=self._capacity,
+                                     hot=preload, reason="reseed",
+                                     host_tier_keys=shadow.host_tier_keys)
             qcap = self._device_qcap(n_init, headroom)
             hcap = (self._posthoc_cap
                     if self._host_props and want_reps_now() else 0)
@@ -1361,8 +1616,7 @@ class TpuChecker(HostChecker):
                     symmetry=self._symmetry or self._sound, hcap=hcap,
                     init_fps=[int(f) for f in fps], ecap=ecap)
                 key_hi, key_lo, seed_ovf = self._bulk_insert_async(
-                    insert_fn, carry.key_hi, carry.key_lo,
-                    list(generated.keys()))
+                    insert_fn, carry.key_hi, carry.key_lo, hot)
                 carry = carry._replace(key_hi=key_hi, key_lo=key_lo)
             shadow.seed_epoch([pack_qrows(init_rows2, ebs, fps,
                                           model.packed_width)])
@@ -1372,6 +1626,7 @@ class TpuChecker(HostChecker):
             chunk_fn = mk_chunk("retry")
 
         fault_attempt = 0
+        spill_attempt = 0
         recover_delay: "Optional[float]" = None
         while True:
             try:
@@ -1411,18 +1666,92 @@ class TpuChecker(HostChecker):
                     elif "egrow" in acts:
                         handle_egrow()
                     elif "grow" in acts:
-                        handle_grow()
+                        # budget-aware growth: quadruple while the HBM
+                        # budget allows, spill to the host tier once it
+                        # does not (capacity-terminal only when tiering
+                        # is off)
+                        if spill_pol.can_grow(self._capacity):
+                            handle_grow()
+                        elif spill_on and shadow is not None:
+                            handle_spill("budget")
+                        else:
+                            self._capacity_terminal(RuntimeError(
+                                "device table growth past tpu_options("
+                                f"max_capacity={spill_pol.max_capacity})"
+                                " needed and spill is disabled"),
+                                shadow, discoveries)
                     dispatch()
                 break
             except BaseException as exc:
-                if (shadow is None
-                        or classify_error(exc) is not FaultKind.TRANSIENT):
+                if shadow is None:
+                    raise
+                kind = classify_error(exc)
+                if kind is FaultKind.CAPACITY:
+                    # a capacity fault inside the retry envelope: a
+                    # spill-eligible one (RESOURCE_EXHAUSTED, table
+                    # pressure, a wedged kovf) recovers by shrinking the
+                    # device-resident set (or growing the k-buffer) and
+                    # re-seeding; everything else — and an exhausted
+                    # spill budget — takes the capacity-terminal ending
+                    # (checkpoint + flight dump + actionable raise)
+                    if not (spill_on and spill_eligible(exc)):
+                        self._capacity_terminal(exc, shadow, discoveries)
+                    inflight.clear()
+                    spill_attempt += 1
+                    if spill_attempt > spill_pol.max_spills:
+                        self._capacity_terminal(exc, shadow, discoveries)
+                    cand = find_candidate_overflow(exc)
+                    if cand is not None:
+                        # satellite: the fused/sharded-style kovf abort
+                        # re-routes through the envelope with a GROWN
+                        # k-buffer instead of raising to the user
+                        kraw = fa
+                        hint_eff = 0
+                        kmax = min(max(kmax * 2, cand.dmax
+                                       + cand.dmax // 4), fa)
+                        self._metrics.inc("kovfs")
+                        if self._trace:
+                            self._trace.emit("kovf", kraw=kraw,
+                                             kmax=kmax, recovered=True)
+                    else:
+                        # a real allocation/table fault names the HBM
+                        # budget better than any option could: clamp
+                        # growth at the current capacity and spill
+                        if spill_pol.max_capacity is None \
+                                or spill_pol.max_capacity > self._capacity:
+                            spill_pol.max_capacity = self._capacity
+                        plan = shadow.spill_plan(max(0, min(
+                            int((1.0 - spill_pol.frac)
+                                * self._grow_at * self._capacity),
+                            int(self._grow_at * self._capacity)
+                            - headroom - 1)))
+                        if plan is None:
+                            self._capacity_terminal(exc, shadow,
+                                                    discoveries)
+                        warn_spill_eventually()
+                        self._metrics.inc("spills")
+                        if plan[2]:
+                            self._metrics.inc("evicted_keys", plan[2])
+                        self._metrics.set("host_tier_keys",
+                                          shadow.host_tier_keys)
+                        if self._trace:
+                            self._trace.emit("evict",
+                                             prefixes=len(plan[0]),
+                                             keys=plan[2])
+                            self._trace.emit(
+                                "spill", capacity=self._capacity,
+                                hot=plan[1], reason="fault",
+                                host_tier_keys=shadow.host_tier_keys,
+                                error=f"{type(exc).__name__}: {exc}")
+                    recover_delay = 0.0
+                    continue
+                if kind is not FaultKind.TRANSIENT:
                     raise
                 # transient backend fault: the in-flight futures are
                 # poisoned (or superseded — their un-consumed work
                 # replays from the shadow); drop them, back off,
-                # re-seed, resume. Capacity and programming errors
-                # re-raise above: retrying reproduces them.
+                # re-seed, resume. Programming errors re-raise:
+                # retrying reproduces them.
                 inflight.clear()
                 blamed = blamed_device(exc)
                 if blamed is not None:

@@ -55,7 +55,11 @@ GLOSSARY: Dict[str, str] = {
     "visit": "post-hoc CheckerVisitor replay over the reached set",
     "shadow": "maintaining the host-side authoritative state "
               "(checker/resilience.py) — per-chunk queue/log suffix "
-              "gathers while retry/autosave is enabled",
+              "gathers while retry/autosave/tiering is enabled",
+    "spill": "visited-set spill passes (drain + cold-range eviction + "
+             "epoch re-seed) taken when table growth would exceed the "
+             "HBM budget (tpu_options(max_capacity=...)); includes "
+             "the embedded re-seed time",
     # --- counters ----------------------------------------------------
     "chunks": "completed chunk dispatches (each up to chunk_steps "
               "frontier levels)",
@@ -79,7 +83,21 @@ GLOSSARY: Dict[str, str] = {
                 "(tpu_options(degrade=, min_mesh=))",
     "autosaves": "resilience checkpoints written (periodic "
                  "tpu_options(autosave=...) snapshots plus the "
-                 "exhausted-retries write)",
+                 "exhausted-retries and capacity-terminal writes)",
+    "spills": "visited-set spills taken (HBM -> host tiering, README "
+              "§ Memory tiering): growth past the "
+              "tpu_options(max_capacity=...) budget — or a "
+              "spill-eligible capacity fault in the retry envelope — "
+              "evicted cold fingerprint-prefix ranges to the host "
+              "tier and resumed instead of dying",
+    "evicted_keys": "fingerprints evicted from the device table into "
+                    "the host tier across the run's spills (the "
+                    "shadow mirror holds them; rediscoveries are "
+                    "filtered by the host re-probe)",
+    "host_probe_hits": "device-'fresh' keys the host tier recognized "
+                       "as rediscoveries of evicted ranges and "
+                       "filtered out of the mirror and unique counts "
+                       "(their re-expansion is the price of tiering)",
     "fused_chunks": "chunks dispatched through the fused Pallas "
                     "expand→fingerprint→dedup kernel (ops/fused.py; "
                     "tpu_options(fused=...))",
@@ -146,6 +164,10 @@ GLOSSARY: Dict[str, str] = {
     "fused": "1 when the run's chunk program took the fused Pallas "
              "path, 0 when staged (bench tags its contract lines from "
              "this so the perf trajectory can't silently mix paths)",
+    "host_tier_keys": "keys resident ONLY in the host tier after the "
+                      "most recent spill (decremented as evicted keys "
+                      "are rediscovered and re-promoted); 0 until the "
+                      "run hits its HBM budget",
     # --- host search timers -------------------------------------------
     "search": "host-engine search loop wall time",
     # --- device-time attribution (chunk loops) ------------------------
@@ -172,7 +194,7 @@ GLOSSARY: Dict[str, str] = {
 #: values (``fused=2``, a ``mesh_shards`` no mesh ever had).
 GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
-    "shard_balance",
+    "shard_balance", "host_tier_keys",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

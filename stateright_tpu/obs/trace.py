@@ -83,6 +83,15 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # a degradation-ladder rung: the mesh halved onto the surviving
     # device subset (optional fields: the blamed device, the error)
     "degrade": frozenset({"from_shards", "to_shards"}),
+    # memory tiering (checker/resilience.py SpillPolicy): `evict`
+    # records the range selection (how many fingerprint-prefix ranges
+    # were newly evicted and how many keys they held), `spill` the
+    # recovery it enabled — the capacity the run stays within, the
+    # device-resident hot-set size it re-seeded with, and the host-tier
+    # population; optional fields: `reason` (budget / fault / seed /
+    # reseed) and `error` (the capacity fault that forced it)
+    "evict": frozenset({"prefixes", "keys"}),
+    "spill": frozenset({"capacity", "hot", "host_tier_keys"}),
     # tpu_options(fused='auto') attempted the Pallas build and fell
     # back to the staged path; `cause` is the resilience taxonomy's
     # classification of the build failure (transient / capacity /

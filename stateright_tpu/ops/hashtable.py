@@ -254,6 +254,59 @@ def table_insert(key_hi, key_lo, fhi, flo, valid, max_rounds: int = 4096,
     return out
 
 
+def table_evict_prefix(key_hi, key_lo, evict_pref):
+    """Evict every key whose fingerprint falls in a marked prefix range
+    and compact each 4-slot bucket (survivors move to the bucket front,
+    order preserved) — the device half of the HBM -> host visited-set
+    tiering (``checker/resilience.py`` ``SpillPolicy``): the host tier
+    (``HostShadow``) already holds every key, so eviction is one
+    in-place pass over the table, no host round trip.
+
+    Args:
+      key_hi, key_lo: the table halves, flat uint32[C] or bucket-major
+        uint32[C/4, 4] (the chunk carries' layout); returned unchanged
+        in layout.
+      evict_pref: bool[256] — ``evict_pref[p]`` marks the prefix bucket
+        of the fingerprint's top 8 bits (``resilience.fp_prefix``) for
+        eviction.
+
+    Returns:
+      (key_hi, key_lo, evicted_count int32[]).
+
+    Caveat (by design): compaction can open an empty slot in a bucket a
+    SURVIVING key once probed past while full, so a later insert of that
+    key may claim the earlier slot and report "fresh" again. That is
+    the same maybe-fresh outcome as rediscovering an evicted key, and
+    the same filter covers both: with tiering active the engines
+    re-probe every device-fresh key against the host tier before it
+    enters the mirror or the unique counts.
+    """
+    two_d = key_hi.ndim == 2
+    if two_d:
+        khi2, klo2 = key_hi, key_lo
+    else:
+        khi2 = key_hi.reshape(-1, _BUCKET)
+        klo2 = key_lo.reshape(-1, _BUCKET)
+    nonempty = (khi2 != 0) | (klo2 != 0)
+    # top 8 bits of the 64-bit fingerprint = top 8 bits of the hi half
+    pref = (khi2 >> jnp.uint32(24)).astype(jnp.int32)
+    drop = nonempty & evict_pref[pref]
+    keep = nonempty & ~drop
+    # stable per-bucket compaction: argsort(False-first) moves kept
+    # slots to the front without reordering them — the first-empty-slot
+    # insert invariant needs every bucket's occupancy to be a prefix
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    khi2 = jnp.take_along_axis(jnp.where(keep, khi2, jnp.uint32(0)),
+                               order, axis=1)
+    klo2 = jnp.take_along_axis(jnp.where(keep, klo2, jnp.uint32(0)),
+                               order, axis=1)
+    count = drop.sum(dtype=jnp.int32)
+    if not two_d:
+        return (khi2.reshape(key_hi.shape), klo2.reshape(key_lo.shape),
+                count)
+    return khi2, klo2, count
+
+
 def plan_insert_host(fps, capacity: int):
     """Host-side placement plan for seeding an EMPTY table.
 

@@ -113,11 +113,36 @@ class ShardedTpuChecker(TpuChecker):
             seed_ebits = full_ebits
             frontier_fps = list(generated.keys())
             resume_cache_fps = None
-        table_fps = list(generated.keys())
         base_unique = len(generated)
         n_init = len(init_rows)
         if prop_count == 0:
             return  # vacuously done (bfs.rs:121-128)
+
+        # --- resilience plumbing (checker/resilience.py), created
+        # BEFORE the seed: with memory tiering the shadow decides which
+        # keys are device-resident at all. Identical contract to the
+        # single-chip engine — with retry/autosave/tiering on, the host
+        # shadow is maintained per chunk (per shard); a transient fault
+        # re-seeds a fresh sharded carry from it (re-routing the
+        # pending frontier by owner exactly like a checkpoint resume),
+        # a capacity fault spills cold prefix ranges to the host tier
+        # first, and past the retry budget the DEGRADATION LADDER takes
+        # over (degrade_step below) — a rung inherits the survivor
+        # shards' spill state through HostShadow.reshard.
+        from ..checker.resilience import (FaultAttributor, FaultKind,
+                                          blamed_device, classify_error,
+                                          find_candidate_overflow,
+                                          gather_rows, pack_qrows,
+                                          spill_eligible)
+
+        policy = self._retry_policy
+        ladder = self._degrade_policy
+        spill_pol = self._spill_policy
+        spill_on = spill_pol.enabled and not self._sound
+        attributor = FaultAttributor(ladder.blame_after)
+        shadow = self._make_shadow(D)
+        table_fps = (shadow.hot_keys() if shadow is not None
+                     else list(generated.keys()))
 
         # two-stage candidate widths, exactly like the single-chip
         # engine: kraw (hash/dedup width) and kmax (ring/probe/append
@@ -157,8 +182,35 @@ class ShardedTpuChecker(TpuChecker):
         # fault-recovered runs
         preload = len(table_fps)
         while self._grow_at * (self._capacity // D) \
-                <= headroom + preload:
+                <= headroom + preload \
+                and spill_pol.can_grow(self._capacity):
             self._capacity *= 4
+        if self._grow_at * (self._capacity // D) <= headroom + preload:
+            # the preloaded set alone exceeds the HBM budget: evict at
+            # seed (the single-chip engine's seed-spill, per shard by
+            # construction — prefix ranges are owner-consistent)
+            plan = (shadow.spill_plan(
+                int(self._grow_at * (self._capacity // D))
+                - headroom - 1)
+                if spill_on and shadow is not None else None)
+            if plan is None:
+                self._capacity_terminal(RuntimeError(
+                    f"sharded table budget (max_capacity="
+                    f"{spill_pol.max_capacity}) cannot hold the seeded "
+                    f"reached set ({preload} keys) with spill "
+                    "unavailable"), shadow, discoveries)
+            table_fps = shadow.hot_keys()
+            preload = len(table_fps)
+            self._metrics.inc("spills")
+            if plan[2]:
+                self._metrics.inc("evicted_keys", plan[2])
+            self._metrics.set("host_tier_keys", shadow.host_tier_keys)
+            if self._trace:
+                self._trace.emit("evict", prefixes=len(plan[0]),
+                                 keys=plan[2])
+                self._trace.emit("spill", capacity=self._capacity,
+                                 hot=preload, reason="seed",
+                                 host_tier_keys=shadow.host_tier_keys)
         # per-shard init fps in queue order (post-hoc witness mapping);
         # the queue slices are sized from the per-shard split, not the
         # total frontier (a resumed frontier routes ~1/D to each shard)
@@ -230,22 +282,6 @@ class ShardedTpuChecker(TpuChecker):
 
         host_prop_idx = {i for i, _p in self._host_props}
 
-        # --- resilience (checker/resilience.py) -------------------------
-        # identical contract to the single-chip engine: with retry or
-        # autosave on, the host shadow is maintained per chunk (per
-        # shard), and a transient fault re-seeds a fresh sharded carry
-        # from it, re-routing the pending frontier by owner exactly
-        # like a checkpoint resume. Past the retry budget the
-        # DEGRADATION LADDER takes over (degrade_step below): the mesh
-        # halves onto the surviving device subset instead of dying.
-        from ..checker.resilience import (FaultAttributor, FaultKind,
-                                          blamed_device, classify_error,
-                                          gather_rows, pack_qrows)
-
-        policy = self._retry_policy
-        ladder = self._degrade_policy
-        attributor = FaultAttributor(ladder.blame_after)
-        shadow = self._make_shadow(D)
         self._fault_shards = D
         self._metrics.set("mesh_shards", D)
 
@@ -314,7 +350,7 @@ class ShardedTpuChecker(TpuChecker):
 
         def process(ordinal: int, stats_d, grow_limit: int,
                     t_disp: float) -> set:
-            nonlocal fault_attempt
+            nonlocal fault_attempt, spill_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # — routed through the fault hook + watchdog deadline
@@ -328,8 +364,9 @@ class ShardedTpuChecker(TpuChecker):
                 self._metrics.add_time("xfer_s", timing[1])
             # a successful sync proves the backend is alive; the retry
             # budget (and the per-device blame streak) bounds
-            # CONSECUTIVE faults
+            # CONSECUTIVE faults, the spill budget CONSECUTIVE spills
             fault_attempt = 0
+            spill_attempt = 0
             attributor.clear()
             t0 = time.perf_counter()
             acts: set = set()
@@ -393,14 +430,21 @@ class ShardedTpuChecker(TpuChecker):
                         np.concatenate(e_idx) if e_idx else empty)
                         if eloc else None)
                     qo = eo = 0
+                    hits = 0
                     for s in range(D):
                         nn, ne = q_cnt[s], e_cnt[s]
-                        shadow.note_chunk(
+                        hits += shadow.note_chunk(
                             s, q_new[qo:qo + nn], l_new[qo:qo + nn],
                             (e_new[eo:eo + ne] if eloc else None),
                             int(q_head[s]))
                         qo += nn
                         eo += ne
+                    if hits:
+                        # host-tier re-probe hits: rediscoveries of
+                        # evicted ranges, excluded from unique counts
+                        self._metrics.inc("host_probe_hits", hits)
+                        self._metrics.set("host_tier_keys",
+                                          shadow.host_tier_keys)
                 if (self._autosave_path is not None
                         and self._autosave_every > 0
                         and ordinal % self._autosave_every == 0):
@@ -420,7 +464,13 @@ class ShardedTpuChecker(TpuChecker):
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen
-            self._unique_state_count = base_unique + int(log_n.sum())
+            # with the shadow on, len(generated) is authoritative (and
+            # past a spill the per-shard logs include host-filtered
+            # rediscoveries, so the sum would over-count)
+            self._unique_state_count = (len(generated)
+                                        if shadow is not None
+                                        else base_unique
+                                        + int(log_n.sum()))
             trace = self._trace
             if trace:
                 new = int(shard_new.sum())
@@ -497,7 +547,15 @@ class ShardedTpuChecker(TpuChecker):
             # bucketed exchange's kb) and resume
             nonlocal carry, chunk_fn, kraw, kmax, kb, headroom
             vmax, dmax, bmax = kovf_pend
+            before = (kraw, kmax, kb)
             grew = False
+            if fused_on and kraw < fa:
+                # the fused step subsumes the kraw staging (the kernel
+                # dedups in-register at full F*A width), so a memo-
+                # tightened kraw must never clamp the kmax resize below
+                # what the abort actually observed
+                kraw = fa
+                grew = True
             if vmax > kraw:
                 kraw = min(max(kraw * 2,
                                -(-(vmax + vmax // 4) // 256) * 256),
@@ -516,6 +574,18 @@ class ShardedTpuChecker(TpuChecker):
                            kraw)
             kmax = min(kmax, kraw)
             headroom = max(D * kmax, fmax)
+            if (kraw, kmax, kb) == before:
+                # wedged pre-mutation abort: rebuilding the identical
+                # program would abort forever — reclassify as a
+                # capacity fault; the retry envelope recovers with a
+                # k-buffer grown to its bound (satellite: the fused/
+                # sharded kovf abort no longer surfaces to the user)
+                from ..checker.resilience import CandidateOverflowError
+                raise CandidateOverflowError(
+                    "candidate-buffer capacity overflow (kovf) wedged "
+                    f"at kraw={kraw} kmax={kmax} kb={kb} (observed "
+                    f"vmax={vmax} dmax={dmax} bmax={bmax})",
+                    vmax=vmax, dmax=dmax, bmax=bmax)
             self._metrics.inc("kovfs")
             if self._trace:
                 self._trace.emit("kovf", kraw=kraw, kmax=kmax, kb=kb,
@@ -583,12 +653,41 @@ class ShardedTpuChecker(TpuChecker):
             else:
                 frontier2 = cache2
             n_init = len(init_rows2)
-            table_fps = list(generated.keys())
+            # the device tables re-seed with the HOT set only (== the
+            # whole mirror until ranges have been evicted): a recovery
+            # must not re-promote what a spill moved to the host tier
+            table_fps = shadow.hot_keys()
             base_unique = len(generated)
             preload = len(table_fps)
             while self._grow_at * (self._capacity // D) \
-                    <= headroom + preload:
+                    <= headroom + preload \
+                    and spill_pol.can_grow(self._capacity):
                 self._capacity *= 4
+            if self._grow_at * (self._capacity // D) \
+                    <= headroom + preload:
+                plan = (shadow.spill_plan(
+                    int(self._grow_at * (self._capacity // D))
+                    - headroom - 1) if spill_on else None)
+                if plan is None:
+                    self._capacity_terminal(RuntimeError(
+                        "sharded table budget (max_capacity="
+                        f"{spill_pol.max_capacity}) cannot hold the "
+                        f"re-seeded hot set ({preload} keys)"),
+                        shadow, discoveries)
+                table_fps = shadow.hot_keys()
+                preload = len(table_fps)
+                self._metrics.inc("spills")
+                if plan[2]:
+                    self._metrics.inc("evicted_keys", plan[2])
+                self._metrics.set("host_tier_keys",
+                                  shadow.host_tier_keys)
+                if self._trace:
+                    self._trace.emit("evict", prefixes=len(plan[0]),
+                                     keys=plan[2])
+                    self._trace.emit(
+                        "spill", capacity=self._capacity,
+                        hot=preload, reason="reseed",
+                        host_tier_keys=shadow.host_tier_keys)
             init_by_shard2: List[List[int]] = [[] for _ in range(D)]
             for fp in frontier2:
                 init_by_shard2[owner_of(fp, D)].append(fp)
@@ -617,6 +716,66 @@ class ShardedTpuChecker(TpuChecker):
                        e_n=np.zeros(D, np.int64))
             kovf_pend[:] = [0, 0, 0]
             chunk_fn = rebuild_chunk(recover_reason)
+
+        spill_warned = [False]
+
+        def warn_spill_eventually() -> None:
+            # see the single-chip twin (checker/tpu.py): unsound
+            # EVENTUALLY verdicts are path-dependent across a spill
+            if spill_warned[0] or self._sound:
+                return
+            from ..core import Expectation
+            if any(p.expectation == Expectation.EVENTUALLY
+                   for p in properties):
+                import warnings
+                warnings.warn(
+                    "memory tiering with (unsound) eventually "
+                    "properties: rediscovered duplicates re-enter the "
+                    "frontier with rediscovery-path pending bits, so "
+                    "eventually verdicts may differ from an uncapped "
+                    "run", RuntimeWarning, stacklevel=2)
+            spill_warned[0] = True
+
+        def handle_spill(reason: str = "budget") -> None:
+            # growth would exceed the HBM budget: evict the coldest
+            # prefix ranges (owner-consistent by construction — top-bit
+            # prefixes nest inside top-bit shard ownership) and re-seed
+            # each shard's table with its share of the hot set; the
+            # pending frontier re-routes exactly like a recovery
+            nonlocal recover_reason
+            occupancy = preload + int(cur["log_n"].sum())
+            closc = self._capacity // D
+            if int(min(self._grow_at * closc, closc - headroom)) <= 0:
+                # even empty shard tables cannot fit one iteration's
+                # headroom under this budget: spilling would spin
+                self._capacity_terminal(RuntimeError(
+                    f"sharded table budget (per-shard {closc}) cannot "
+                    f"fit one iteration's headroom ({headroom}) — "
+                    "raise tpu_options(max_capacity=...) or shrink "
+                    "fmax/kmax"), shadow, discoveries)
+            hot_budget = max(0, min(
+                int((1.0 - spill_pol.frac) * occupancy),
+                int(self._grow_at * closc) - headroom - 1))
+            plan = shadow.spill_plan(hot_budget)
+            if plan is None:
+                self._capacity_terminal(RuntimeError(
+                    "host tier exhausted: range eviction cannot bring "
+                    f"the sharded table (capacity {self._capacity}) "
+                    "under its growth budget"), shadow, discoveries)
+            warn_spill_eventually()
+            self._metrics.inc("spills")
+            if plan[2]:
+                self._metrics.inc("evicted_keys", plan[2])
+            self._metrics.set("host_tier_keys", shadow.host_tier_keys)
+            if self._trace:
+                self._trace.emit("evict", prefixes=len(plan[0]),
+                                 keys=plan[2])
+                self._trace.emit("spill", capacity=self._capacity,
+                                 hot=plan[1], reason=reason,
+                                 host_tier_keys=shadow.host_tier_keys)
+            recover_reason = "spill"
+            with self._timed("spill"):
+                reseed()
 
         def degrade_step(blamed, exc) -> bool:
             # one ladder rung (checker/resilience.py DegradePolicy):
@@ -678,6 +837,7 @@ class ShardedTpuChecker(TpuChecker):
             return False
 
         fault_attempt = 0
+        spill_attempt = 0
         recover_delay = None
         recover_reason = "retry"
         handoff_rung = False
@@ -712,14 +872,91 @@ class ShardedTpuChecker(TpuChecker):
                     elif "done" in acts:
                         break
                     elif "grow" in acts:
-                        handle_grow()
+                        # budget-aware growth: grow while the HBM
+                        # budget allows, spill to the host tier once
+                        # it does not
+                        if spill_pol.can_grow(self._capacity):
+                            handle_grow()
+                        elif spill_on and shadow is not None:
+                            handle_spill("budget")
+                        else:
+                            self._capacity_terminal(RuntimeError(
+                                "sharded table growth past tpu_options("
+                                f"max_capacity={spill_pol.max_capacity})"
+                                " needed and spill is disabled"),
+                                shadow, discoveries)
                     elif "egrow" in acts:
                         handle_egrow()
                     dispatch()
                 break
             except BaseException as exc:
-                if (shadow is None
-                        or classify_error(exc) is not FaultKind.TRANSIENT):
+                if shadow is None:
+                    raise
+                kind = classify_error(exc)
+                if kind is FaultKind.CAPACITY:
+                    # capacity fault in the retry envelope: spill (or
+                    # grow the k-buffer for a wedged kovf) and re-seed;
+                    # ineligible faults and an exhausted spill budget
+                    # take the capacity-terminal ending
+                    if not (spill_on and spill_eligible(exc)):
+                        self._capacity_terminal(exc, shadow, discoveries)
+                    inflight.clear()
+                    spill_attempt += 1
+                    if spill_attempt > spill_pol.max_spills:
+                        self._capacity_terminal(exc, shadow, discoveries)
+                    cand = find_candidate_overflow(exc)
+                    if cand is not None:
+                        # the fused/sharded kovf pre-mutation abort
+                        # re-routes here with a GROWN k-buffer instead
+                        # of raising to the user
+                        kraw = fa
+                        kmax = min(max(kmax * 2, cand.dmax
+                                       + cand.dmax // 4), fa)
+                        if exchange == "bucket" and cand.bmax:
+                            kb = min(kmax, max(
+                                effective_kb(kmax, D, kb),
+                                cand.bmax + cand.bmax // 4))
+                        headroom = max(D * kmax, fmax)
+                        self._metrics.inc("kovfs")
+                        if self._trace:
+                            self._trace.emit("kovf", kraw=kraw,
+                                             kmax=kmax, kb=kb,
+                                             recovered=True)
+                        recover_reason = "kovf"
+                    else:
+                        # the backend named the budget: clamp growth at
+                        # the current capacity and spill
+                        if spill_pol.max_capacity is None \
+                                or spill_pol.max_capacity > self._capacity:
+                            spill_pol.max_capacity = self._capacity
+                        closc = self._capacity // D
+                        plan = shadow.spill_plan(max(0, min(
+                            int((1.0 - spill_pol.frac)
+                                * self._grow_at * closc),
+                            int(self._grow_at * closc)
+                            - headroom - 1)))
+                        if plan is None:
+                            self._capacity_terminal(exc, shadow,
+                                                    discoveries)
+                        warn_spill_eventually()
+                        self._metrics.inc("spills")
+                        if plan[2]:
+                            self._metrics.inc("evicted_keys", plan[2])
+                        self._metrics.set("host_tier_keys",
+                                          shadow.host_tier_keys)
+                        if self._trace:
+                            self._trace.emit("evict",
+                                             prefixes=len(plan[0]),
+                                             keys=plan[2])
+                            self._trace.emit(
+                                "spill", capacity=self._capacity,
+                                hot=plan[1], reason="fault",
+                                host_tier_keys=shadow.host_tier_keys,
+                                error=f"{type(exc).__name__}: {exc}")
+                        recover_reason = "spill"
+                    recover_delay = 0.0
+                    continue
+                if kind is not FaultKind.TRANSIENT:
                     raise
                 inflight.clear()
                 blamed = blamed_device(exc)

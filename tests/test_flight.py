@@ -284,6 +284,54 @@ class TestBenchHistory:
                        for f in report["flags"]
                        if f["kind"] == "regression")
 
+    def test_committed_artifacts_pass_gate(self, capsys):
+        # THE tier-1 bench-trend gate (CI/tooling satellite): the
+        # committed BENCH_*.json set must be clean apart from the
+        # ACKNOWLEDGED r05 empty artifact (the round-5 rc=1 hole this
+        # tool exists to catch). A new empty/partial/regressed artifact
+        # in a future round fails the suite right here.
+        bench_history = _tool("bench_history")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        assert bench_history.main(
+            [root, "--check", "--allow", "empty_artifact:r05"]) == 0
+        out = capsys.readouterr().out
+        assert "(allowed)" in out  # still reported, just not fatal
+
+    def test_allow_does_not_mask_new_flags(self, tmp_path, capsys):
+        bench_history = _tool("bench_history")
+        (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+            {"n": 1, "rc": 1, "tail": "", "parsed": None}))
+        (tmp_path / "BENCH_r07.json").write_text(json.dumps(
+            {"n": 1, "rc": 1, "tail": "", "parsed": None}))
+        # the acknowledged r05 alone passes; a NEW empty r07 still fails
+        assert bench_history.main(
+            [str(tmp_path), "--check", "--allow",
+             "empty_artifact:r05,empty_artifact:r06"]) == 1
+        capsys.readouterr()
+
+    def test_spilled_tag_rides_trend_and_flags(self, tmp_path):
+        # a primary metric that survived its HBM budget via host-tier
+        # spills must surface in the trend table tags and as a flag —
+        # a spilled rate is not comparable to an all-HBM rate
+        bench_history = _tool("bench_history")
+        row = {"workload": "tpu 2pc7 full 296448", "best": 900.0,
+               "unit": "uniq/s", "uniq": 1, "gen": 2,
+               "gen_per_uniq": 2.0, "fused": False, "spilled": True,
+               "metrics": {"spills": 3, "host_tier_keys": 123}}
+        (tmp_path / "BENCH_r09.json").write_text(json.dumps({
+            "n": 1, "rc": 0, "tail": json.dumps(row),
+            "parsed": {"metric": "m", "value": 100.0, "unit": "uniq/s",
+                       "backend": "tpu", "spilled": True,
+                       "host_tier_keys": 123}}))
+        report = bench_history.build_report(
+            [str(tmp_path / "BENCH_r09.json")])
+        wl = report["rounds"][0]["workloads"]
+        assert "spilled" in wl["tpu 2pc7"]["tags"]
+        assert "spilled" in wl[bench_history.CONTRACT]["tags"]
+        spilled = [f for f in report["flags"] if f["kind"] == "spilled"]
+        assert spilled and "123" in spilled[0]["detail"]
+
     def test_normalization_keeps_model_sizes(self):
         bench_history = _tool("bench_history")
         norm = bench_history.normalize_workload

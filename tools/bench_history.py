@@ -3,7 +3,7 @@
 Usage:
     python tools/bench_history.py [DIR | FILES...]
         [--json PATH|-] [--markdown PATH|-]
-        [--threshold 0.25] [--check]
+        [--threshold 0.25] [--check] [--allow kind:round[,kind:round]]
 
 The perf trajectory lives in per-round artifacts (``BENCH_r01.json``,
 ``BENCH_r02.json``, ...) that nothing aggregated: BENCH_r05 shipped
@@ -25,6 +25,10 @@ human reviewer noticed. This tool is the machine that notices:
 * **outputs** — a markdown report (default: stdout) and a JSON
   document (``--json -`` for stdout, ``--json PATH`` to write); with
   ``--check`` the exit code is 1 when any flag fired — the CI gate.
+  ``--allow kind:round`` (repeatable, comma-separable) acknowledges a
+  KNOWN-bad artifact (e.g. ``--allow empty_artifact:r05`` for the
+  round-5 rc=1 hole) so the gate stays red only for NEW problems; the
+  allowed flags are still reported, marked ``(allowed)``.
 
 The contract line itself rides the table as workload ``<contract>``.
 This output is the single source of truth for trajectory numbers —
@@ -117,6 +121,8 @@ def parse_round(path: str) -> Dict[str, Any]:
                     ("staged", row.get("fused") is False),
                     ("degraded", bool(metrics.get("degrades"))),
                     ("retried", bool(metrics.get("retries"))),
+                    ("spilled", bool(row.get("spilled"))
+                     or bool(metrics.get("spills"))),
                 ) if on),
         }
     contract = rnd["contract"]
@@ -125,6 +131,7 @@ def parse_round(path: str) -> Dict[str, Any]:
             t for t, on in (
                 ("partial", bool(contract.get("partial"))),
                 ("degraded", bool(contract.get("degraded"))),
+                ("spilled", bool(contract.get("spilled"))),
                 ("init_fallback", bool(contract.get("init_fallback"))),
                 ("cpu", contract.get("backend") == "cpu"),
             ) if on)
@@ -167,6 +174,14 @@ def compute_flags(rounds: List[Dict[str, Any]],
                 "kind": "degraded", "round": rnd["round"],
                 "detail": f"primary metric finished on "
                           f"{c.get('final_shards')} shard(s)"})
+        if c.get("spilled"):
+            flags.append({
+                "kind": "spilled", "round": rnd["round"],
+                "detail": "primary metric hit its HBM budget and "
+                          f"finished via host-tier spills "
+                          f"({c.get('host_tier_keys')} keys host-"
+                          "resident) — not comparable to all-HBM "
+                          "rounds"})
         for err in rnd["errors"]:
             flags.append({"kind": "workload_error",
                           "round": rnd["round"],
@@ -273,7 +288,13 @@ def render_markdown(report: Dict[str, Any], out) -> None:
         where = f.get("workload", "")
         out.write(f"* **{f['kind']}** {f['round']}"
                   + (f" `{where}`" if where else "")
-                  + f": {f['detail']}\n")
+                  + f": {f['detail']}"
+                  + (" (allowed)" if f.get("allowed") else "") + "\n")
+
+
+def allowed(flag: Dict[str, Any], allow: List[str]) -> bool:
+    """``kind:round`` acknowledgement match for one flag."""
+    return f"{flag.get('kind')}:{flag.get('round')}" in allow
 
 
 def main(argv) -> int:
@@ -287,8 +308,14 @@ def main(argv) -> int:
                if "--json" in argv else None)
     md_to = (argv[argv.index("--markdown") + 1]
              if "--markdown" in argv else None)
+    allow: List[str] = []
+    for i, a in enumerate(argv):
+        if a == "--allow":
+            allow.extend(argv[i + 1].split(","))
+    consumed = set(allow) | ({",".join(allow)} if allow else set())
     positional = [a for a in argv if not a.startswith("--")
-                  and a not in (str(threshold), json_to, md_to)]
+                  and a not in (str(threshold), json_to, md_to)
+                  and a not in consumed]
     if not positional:
         positional = ["."]
     paths: List[str] = []
@@ -302,6 +329,9 @@ def main(argv) -> int:
               file=sys.stderr)
         return 2
     report = build_report(paths, threshold)
+    for f in report["flags"]:
+        if allowed(f, allow):
+            f["allowed"] = True
     if json_to == "-":
         json.dump(report, sys.stdout, indent=1, default=str)
         sys.stdout.write("\n")
@@ -313,8 +343,10 @@ def main(argv) -> int:
             render_markdown(report, f)
     elif json_to is None or md_to == "-":
         render_markdown(report, sys.stdout)
-    if "--check" in argv and report["flags"]:
-        return 1
+    if "--check" in argv:
+        hard = [f for f in report["flags"] if not allowed(f, allow)]
+        if hard:
+            return 1
     return 0
 
 

@@ -13,11 +13,15 @@ found in the trace:
     hit-rate, table load factor, queue depth — the view that makes a
     pipeline stall or a growth storm visible after the fact;
   * interventions (grow/hgrow/egrow/kovf/compile, the resilience
-    layer's retry/watchdog/autosave/failover/degrade events,
-    flight-recorder dumps, and the soak harness's live
-    crash/restart/partition injections) with timestamps — on a flaky round this table says *where* the tunnel
+    layer's retry/watchdog/autosave/failover/degrade and the tiering
+    layer's spill/evict events, flight-recorder dumps, and the soak
+    harness's live crash/restart/partition injections) with
+    timestamps — on a flaky round this table says *where* the tunnel
     dropped, what the engine did about it, and whether an autosave
     landed;
+  * a memory-tiering summary line (spills, keys evicted to the host
+    tier, the tier population and hot-set size after the last spill)
+    when the run hit its HBM budget;
   * a soak summary line (ops, op timeouts, fault-injection counts,
     the history cross-check verdict) when the trace came from
     ``tools/soak.py``;
@@ -147,6 +151,7 @@ def report(events, out=None):
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
                    "degrade", "fused_fallback", "recorder_dump",
+                   "spill", "evict",
                    "crash", "restart", "partition")]
         if inters:
             out.write("\ninterventions:\n")
@@ -179,6 +184,20 @@ def report(events, out=None):
                 parts.append(
                     f"final_mesh={degrades[-1]['to_shards']}")
             out.write("\nresilience: " + " ".join(parts) + "\n")
+
+        # memory-tiering summary: how the run survived its HBM budget —
+        # spills taken, keys evicted to the host tier, and the tier
+        # population after the last spill (rediscoveries re-promote)
+        spills = [e for e in evs if e["ev"] == "spill"]
+        if spills:
+            evicts = [e for e in evs if e["ev"] == "evict"]
+            parts = [f"spills={len(spills)}",
+                     f"evicted_keys={sum(e.get('keys', 0) for e in evicts)}",
+                     f"host_tier_keys={spills[-1].get('host_tier_keys')}",
+                     f"hot={spills[-1].get('hot')}"]
+            reasons = sorted({e.get("reason", "?") for e in spills})
+            parts.append(f"reasons={reasons}")
+            out.write("\ntiering: " + " ".join(parts) + "\n")
 
         # soak summary: a chaos soak postmortem reads like a checker
         # postmortem — op throughput, the live faults injected, and
