@@ -54,7 +54,11 @@ forces every device workload to die with a fake transient backend error
 (pins the partial-contract shape end to end); ``--soak-smoke`` runs the
 chaos soak harness (tools/soak.py) against the real actor runtime and
 emits a soak contract line (ops/s, faults injected, ``history_ok``)
-under the same crash-proof contract — no device required.
+under the same crash-proof contract — no device required;
+``--service-smoke`` runs the job service (stateright_tpu/service) with
+two concurrent CPU jobs on disjoint device subsets and lands a
+``"service": true`` contract line with per-job uniq/s — no device
+required either.
 """
 
 from __future__ import annotations
@@ -306,12 +310,95 @@ def _soak_smoke() -> None:
         print(json.dumps(contract))
 
 
+def _service_smoke() -> None:
+    """``--service-smoke``: a seconds-scale proof of the job service
+    (stateright_tpu/service) under the crash-proof contract — two CPU
+    jobs submitted concurrently to a 2-device (CPU-forced) scheduler,
+    each granted a disjoint subset; the contract line reports per-job
+    uniq/s and is tagged ``"service": true`` (tools/bench_history.py
+    surfaces the tag). Emitted from a ``finally`` path with
+    ``"partial"``/``"failed"`` on any error; rc=0 regardless. Needs no
+    JAX devices beyond CPU."""
+    import os
+    import tempfile
+
+    contract = {
+        "metric": "service 2-job smoke (concurrent jobs on disjoint "
+                  "CPU subsets)",
+        "value": None,
+        "unit": "uniq/s",
+        "service": True,
+        "jobs": None,
+    }
+    try:
+        # force a 2-device CPU pool BEFORE jax initializes (and
+        # re-assert the config: a sitecustomize may override it)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from stateright_tpu.service import JobSpec, JobStore, Scheduler
+
+        root = tempfile.mkdtemp(prefix="stateright_service_smoke_")
+        sched = Scheduler(JobStore(root), devices=jax.devices()[:2])
+        opts = {"capacity": 1 << 12, "retries": 1, "backoff": 0.0}
+        submitted = [
+            sched.submit(JobSpec("twopc", args=[3], options=opts)),
+            sched.submit(JobSpec("twopc", args=[4], options=opts)),
+        ]
+        rows = []
+        total = 0.0
+        for job in submitted:
+            state = sched.wait(job.id, timeout=180.0)
+            row = {"job": job.id, "model": job.spec.model_name,
+                   "args": job.spec.args, "state": state}
+            result = job.read_result()
+            if state == "done" and result is not None:
+                secs = max(job.status.get("done_at", 0.0)
+                           - job.status.get("running_at", 0.0), 1e-9)
+                row["uniq"] = result["unique_state_count"]
+                row["secs"] = round(secs, 4)
+                row["rate"] = round(result["unique_state_count"]
+                                    / secs, 1)
+                total += row["rate"]
+            else:
+                FAILED.append(f"service-job-{job.id}")
+                row["error"] = job.status.get("error")
+            rows.append(row)
+            print(json.dumps({"workload": f"service {job.id}", **row}),
+                  file=sys.stderr)
+        contract["jobs"] = rows
+        if total:
+            contract["value"] = round(total, 1)
+        prof = sched.profile()
+        contract["jobs_done"] = int(prof.get("jobs_done", 0))
+        contract["jobs_failed"] = int(prof.get("jobs_failed", 0))
+        sched.shutdown()
+    except BaseException as exc:
+        print(json.dumps({"workload": "service", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("service")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
 def main() -> None:
     global N, SMOKE, INJECT_FAULT
     SMOKE = "--smoke" in sys.argv
     INJECT_FAULT = "--inject-fault" in sys.argv
     if "--soak-smoke" in sys.argv:
         _soak_smoke()
+        return
+    if "--service-smoke" in sys.argv:
+        _service_smoke()
         return
     if SMOKE:
         N = 1

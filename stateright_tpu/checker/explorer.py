@@ -45,6 +45,8 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from typing import NamedTuple
+
 from .path import Path
 from .visitor import CheckerVisitor
 
@@ -367,13 +369,46 @@ def _make_handler(checker, snapshot: Optional[Snapshot],
     return Handler
 
 
+class ServeHandle(NamedTuple):
+    """A non-blocking Explorer server: unpacks as the legacy
+    ``(checker, server)`` pair, and adds the clean-teardown surface
+    tests and the job service need — ``.port`` and ``.shutdown()``
+    (which also cancels the background checking run, so no engine
+    thread lingers past the test)."""
+
+    checker: object
+    server: object
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.server.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def shutdown(self, cancel: bool = True,
+                 timeout: float = 10.0) -> None:
+        """Stop serving and (by default) cancel the background run,
+        waiting briefly for its thread to exit."""
+        self.server.shutdown()
+        self.server.server_close()
+        if cancel:
+            self.checker.cancel()
+            thread = getattr(self.checker, "_thread", None)
+            if thread is not None:
+                thread.join(timeout)
+
+
 def serve(checker_builder, address: Tuple[str, int] | str,
           block: bool = True, engine: str = "bfs"):
     """Start checking in the background and serve the Explorer
     (`explorer.rs:71-89`). ``address`` is ``(host, port)`` or
-    ``"host:port"``. With ``block=False`` returns ``(checker, server)``
-    and serves on a daemon thread (used by tests and ``explore``
-    subcommands that poll).
+    ``"host:port"``. With ``block=False`` returns a :class:`ServeHandle`
+    — it unpacks as the legacy ``(checker, server)`` pair and adds
+    ``.port``/``.shutdown()`` — and serves on a daemon thread (used by
+    tests, ``explore`` subcommands that poll, and the job service).
 
     ``engine`` selects the background checker: ``"bfs"`` (the
     reference's fixed choice, `explorer.rs:85-88`), ``"dfs"``, or
@@ -425,4 +460,4 @@ def serve(checker_builder, address: Tuple[str, int] | str,
             server.server_close()
         return checker
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    return checker, server
+    return ServeHandle(checker, server)

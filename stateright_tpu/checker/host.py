@@ -18,8 +18,8 @@ from typing import Dict, Optional
 
 from ..core import Expectation, Model
 from ..fingerprint import fp64_node
-from ..obs import (FlightRecorder, Metrics, default_flight_path,
-                   fault_info, make_trace)
+from ..obs import (FlightRecorder, Metrics, apply_artifact_dir,
+                   default_flight_path, fault_info, make_trace)
 from .builder import Checker, CheckerBuilder
 
 
@@ -40,6 +40,22 @@ class HostChecker(Checker):
         self._thread: Optional[threading.Thread] = None
         self._start_lock = threading.Lock()
         self._cancel_event = threading.Event()
+        # pausable runs (the step-driver/job-service boundary): the
+        # device engines honor the pause event at their chunk-loop exit
+        # checks, drain the pipeline, and write a resume_from-loadable
+        # checkpoint to _pause_path before returning; engines without
+        # a checkpointable loop treat request_pause() as cancel()
+        self._pause_event = threading.Event()
+        self._pause_path = None
+        self._paused = False
+        # True once a StepDriver has claimed this run: the background
+        # thread must never start on top of an externally driven run
+        self._driven = False
+        # job-scoped artifacts: tpu_options(artifact_dir=dir) expands
+        # to autosave/flight_path/trace paths under one directory
+        # (explicit knobs win; obs/artifacts.py). Mutates the builder's
+        # dict so a race's twin checkers resolve identical paths.
+        apply_artifact_dir(builder.tpu_options_)
         # unified observability (obs/): every engine records into ONE
         # Metrics registry behind profile(), and emits structured
         # run-trace events when tpu_options(trace=...) names a sink.
@@ -148,6 +164,33 @@ class HostChecker(Checker):
     def cancelled(self) -> bool:
         return self._cancel_event.is_set()
 
+    def request_pause(self, path=None) -> None:
+        """Cooperatively pause the run at the next engine step: the
+        device engines drain their pipeline and write a
+        ``resume_from``-loadable checkpoint (to ``path``, defaulting to
+        the ``tpu_options(autosave=...)`` destination) before exiting
+        the loop; ``paused()`` then reports True. Resumption is a fresh
+        checker built with ``resume_from(path)`` — possibly on a
+        different mesh width, which is how the job scheduler preempts
+        runs onto smaller device subsets. Host engines (and the
+        per-level device mode) have no checkpointable loop: they stop
+        like ``cancel()`` and ``paused()`` stays False."""
+        self._pause_event.set()
+        # default: engines without a pause-aware loop stop at their
+        # cancel checks; TpuChecker overrides the pause semantics
+        self._cancel_event.set()
+
+    def paused(self) -> bool:
+        """True when the run exited via a pause checkpoint (the file
+        named by ``pause_path()`` resumes it)."""
+        return self._paused
+
+    def pause_path(self):
+        """Destination of the pause checkpoint (falls back to the
+        autosave path), or ``None`` when neither is configured."""
+        return self._pause_path if self._pause_path is not None \
+            else self._autosave_path
+
     def generated_fingerprints(self):
         """All visited STATE fingerprints (the dedup record, translated
         out of node-key space under ``sound_eventually``)."""
@@ -194,9 +237,35 @@ class HostChecker(Checker):
     def _run(self) -> None:  # pragma: no cover - overridden
         raise NotImplementedError
 
+    def _run_steps(self):
+        """Generator form of the engine loop — the step-driver surface
+        (``stateright_tpu.service.StepDriver``). Each ``yield`` is one
+        engine quantum (a processed chunk on the device engines); the
+        default implementation runs the whole blocking search as one
+        step, which is all a host engine can offer. The device engines
+        override this with chunk-granular yields, so a driven run can
+        be paused/stepped without a dedicated thread."""
+        self._run()
+        return
+        yield  # pragma: no cover — makes this function a generator
+
+    def _claim_driver(self) -> None:
+        """Claim this run for an external step driver: the background
+        thread must never start on top of it (and vice versa)."""
+        with self._start_lock:
+            if self._thread is not None:
+                raise RuntimeError(
+                    "checker is already running on its background "
+                    "thread; a StepDriver must claim the run before "
+                    "join()/report()/serve() start it")
+            if self._driven:
+                raise RuntimeError(
+                    "checker is already claimed by a step driver")
+            self._driven = True
+
     def _start_background(self) -> None:
         with self._start_lock:
-            if self._thread is None:
+            if self._thread is None and not self._driven:
                 self._thread = threading.Thread(target=self._run_wrapper,
                                                 daemon=True)
                 self._thread.start()
@@ -222,6 +291,18 @@ class HostChecker(Checker):
             return False
 
     def _run_wrapper(self) -> None:
+        for _ in self._step_wrapper():
+            pass
+
+    def _step_wrapper(self):
+        """Generator twin of the old blocking run wrapper: the SAME
+        lifecycle (run_start/fault_injection events, profiler capture,
+        error capture + flight dump, the terminal done event) around
+        ``_run_steps()``'s quanta. The background thread drives it to
+        exhaustion; a ``StepDriver`` advances it step by step from the
+        caller's thread. Errors land in ``error()`` (raised at
+        ``join()``), never out of the generator — matching the
+        background-thread contract."""
         trace = self._trace
         if trace:
             trace.emit("run_start", model=type(self._model).__name__,
@@ -233,7 +314,9 @@ class HostChecker(Checker):
         profiling = self._start_profiler()
         try:
             with self._metrics.timed("search"):
-                self._run()
+                yield from self._run_steps()
+        except GeneratorExit:  # an abandoned driver closing us
+            raise
         except BaseException as exc:  # re-raised at join()
             self._error = exc
             if trace:
@@ -254,6 +337,7 @@ class HostChecker(Checker):
                 trace.emit("done", gen=self._state_count,
                            unique=self._unique_state_count,
                            cancelled=self._cancel_event.is_set(),
+                           paused=self._paused,
                            discoveries=sorted(self._discovery_fps))
 
     def _init_ebits(self) -> frozenset:
@@ -304,7 +388,13 @@ class HostChecker(Checker):
 
     def join(self) -> "HostChecker":
         self._start_background()
-        self._thread.join()
+        if self._thread is not None:
+            self._thread.join()
+        else:
+            # externally driven (StepDriver): wait for the driver to
+            # finish the run instead of owning a thread
+            while not self._done:
+                time.sleep(0.005)
         if self._error is not None:
             raise self._error
         return self

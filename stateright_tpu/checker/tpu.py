@@ -768,8 +768,33 @@ class TpuChecker(HostChecker):
                 "lasso", nodes=len(node_mask),
                 edges=sum(len(v) for v in node_edges.values()))
 
+    # --- pausable runs (the step-driver/job-service boundary) ----------
+    def request_pause(self, path=None) -> None:
+        """Pause the device run at the next chunk boundary: the chunk
+        loop drains its pipeline and writes a ``resume_from``-loadable
+        checkpoint (complete mirror + pending frontier) to ``path``
+        (default: the ``tpu_options(autosave=...)`` destination — which
+        ``tpu_options(artifact_dir=...)`` always provides) before
+        exiting; ``paused()`` then reports True and the checkpoint
+        resumes on ANY mesh width (the scheduler's preemption-to-a-
+        smaller-subset primitive). The per-level engine mode has no
+        checkpointable loop and stops without a checkpoint."""
+        if path is not None:
+            self._pause_path = os.fspath(path)
+        if self.pause_path() is None:
+            raise ValueError(
+                "request_pause() needs a checkpoint destination: pass "
+                "request_pause(path=...) or configure "
+                "tpu_options(autosave=...) / tpu_options(artifact_dir"
+                "=...)")
+        self._pause_event.set()
+
     # ------------------------------------------------------------------
     def _run(self) -> None:
+        for _ in self._run_steps():
+            pass
+
+    def _run_steps(self):
         mode = str(self._tpu_options.get("mode", "auto"))
         if mode not in ("auto", "device", "level"):
             raise ValueError(
@@ -802,13 +827,26 @@ class TpuChecker(HostChecker):
                 "sound_eventually() requires the device engine; drop "
                 "tpu_options(mode='level')")
         if mode in ("auto", "device"):
-            self._run_device()
-            if self._visitor is not None:
+            yield from self._drive_device()
+            if self._visitor is not None and not self._paused:
                 with self._timed("visit"):
                     self._visit_reached()
         else:
             self._run_levels()
 
+    def _write_pause_checkpoint(self, rows, ebits, ffps,
+                                discoveries: Dict[str, object]) -> None:
+        """Land the pause checkpoint (complete mirror + the pending
+        frontier the caller gathered) and mark the run paused. Shared
+        by the single-chip and sharded chunk loops."""
+        path = self.pause_path()
+        with self._timed("pause"):
+            self._checkpoint_save(path, rows, ebits, ffps, discoveries)
+        self._paused = True
+        self._metrics.inc("pauses")
+        if self._trace:
+            self._trace.emit("pause", path=os.fspath(path),
+                             unique=len(self._generated))
 
     def _seed_inits(self) -> "List[np.ndarray]":
         """Filter/fingerprint/encode the initial states into the mirror and
@@ -861,9 +899,23 @@ class TpuChecker(HostChecker):
 
     # ------------------------------------------------------------------
     def _run_device(self) -> None:
+        """Blocking form of :meth:`_drive_device` (the degradation
+        ladder's single-chip handoff rung still calls it directly)."""
+        for _ in self._drive_device():
+            pass
+
+    def _drive_device(self):
         """Device-resident search: the whole multi-level loop is one XLA
         ``while_loop`` (see `device_loop.py`); the host syncs once per
-        K-level chunk and pulls the (child fp, parent fp) log at the end."""
+        K-level chunk and pulls the (child fp, parent fp) log at the end.
+
+        A GENERATOR since round 10: each ``yield`` is one chunk-loop
+        quantum (a processed chunk or a handled intervention), so the
+        run can be driven step-by-step by the job service's
+        ``StepDriver`` (start → step(budget) → … → finish) instead of
+        only as a blocking call; a pending ``request_pause()`` drains
+        the pipeline, writes the resume_from-loadable pause checkpoint
+        and exits the loop cleanly."""
         import jax
         import jax.numpy as jnp
 
@@ -1342,7 +1394,8 @@ class TpuChecker(HostChecker):
                     or len(discoveries) == prop_count
                     or (target is not None
                         and self._state_count >= target)
-                    or self._cancel_event.is_set()):
+                    or self._cancel_event.is_set()
+                    or self._pause_event.is_set()):
                 acts.add("done")
             elif ecap and e_n >= ecap - max(kmax, fmax):
                 acts.add("egrow")
@@ -1647,6 +1700,7 @@ class TpuChecker(HostChecker):
                     if not acts:
                         if not inflight:
                             dispatch()
+                        yield  # step boundary: one chunk consumed
                         continue
                     # a host intervention (or an exit) is due: drain the
                     # one speculative chunk first — under any
@@ -1681,6 +1735,7 @@ class TpuChecker(HostChecker):
                                 " needed and spill is disabled"),
                                 shadow, discoveries)
                     dispatch()
+                    yield  # step boundary: intervention handled
                 break
             except BaseException as exc:
                 if shadow is None:
@@ -1769,6 +1824,37 @@ class TpuChecker(HostChecker):
                         device=blamed)
         q_size = cur["q_size"]
         q_tail, log_n, e_n = cur["q_tail"], cur["log_n"], cur["e_n"]
+
+        if (self._pause_event.is_set()
+                and not self._cancel_event.is_set()
+                and q_size > 0
+                and len(discoveries) < prop_count
+                and not (target is not None
+                         and self._state_count >= target)):
+            # pause exit (the run did NOT finish): the pipeline drained
+            # above; gather the pending frontier — the shadow holds it
+            # when resilience is on, otherwise pull it from the live
+            # carry exactly like the resumable-frontier path — and land
+            # the resume_from-loadable pause checkpoint
+            if shadow is not None:
+                p_rows, p_ebs, p_fps = shadow.pending()
+            else:
+                # complete the host mirror from the device log first:
+                # the checkpoint needs the full (fp -> parent) record
+                self._mirror_carry = (carry.log, carry.log_n)
+                self._ensure_mirror()
+                head = int(jax.device_get(carry.q_head))
+                tail = int(jax.device_get(carry.q_tail))
+                width = model.packed_width
+                pend = np.asarray(jax.device_get(carry.q[head:tail]))
+                p_rows = pend[:, :width]
+                p_ebs = pend[:, width]
+                p_fps = _combine64(pend[:, width + 1],
+                                   pend[:, width + 2])
+            self._write_pause_checkpoint(p_rows, p_ebs, p_fps,
+                                         discoveries)
+            self._discovery_fps.update(discoveries)
+            return
 
         if self._sound and q_size == 0 and self._resume_path is not None:
             import warnings
@@ -2279,8 +2365,11 @@ class TpuChecker(HostChecker):
         while segments:
             if len(discoveries) == prop_count:
                 return
-            if self._cancel_event.is_set():
-                return  # raced loser (checker/race.py): stop promptly
+            if self._cancel_event.is_set() or self._pause_event.is_set():
+                # raced loser (checker/race.py) or a pause request:
+                # stop promptly (the per-level engine has no
+                # checkpointable loop, so a pause here is a plain stop)
+                return
             rows, ebs, start, length = segments.popleft()
             bucket = _bucket(length)
             if rows.shape[0] == bucket and start == 0:

@@ -18,12 +18,15 @@ See README.md § Observability for the trace format and how to read a
 stall; ``tools/trace_report.py`` renders a trace as a per-phase table.
 """
 
+from .artifacts import (ARTIFACT_NAMES, apply_artifact_dir,
+                        artifact_paths)
 from .metrics import GAUGES, GLOSSARY, MAXIMA, Metrics
 from .recorder import FlightRecorder, default_flight_path
 from .trace import (EVENT_SCHEMA, NULL_TRACE, NullTrace, RunTrace,
                     fault_info, make_trace, validate_event)
 
 __all__ = [
+    "ARTIFACT_NAMES",
     "EVENT_SCHEMA",
     "FlightRecorder",
     "GAUGES",
@@ -33,6 +36,8 @@ __all__ = [
     "NULL_TRACE",
     "NullTrace",
     "RunTrace",
+    "apply_artifact_dir",
+    "artifact_paths",
     "default_flight_path",
     "fault_info",
     "make_trace",

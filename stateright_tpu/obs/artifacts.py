@@ -1,0 +1,61 @@
+"""Job-scoped artifact layout: ONE directory per run, no shared paths.
+
+Before this helper, ``tpu_options(autosave=...)``, ``flight_path=...``
+and ``trace=...`` were independent knobs, so two checkers configured
+with the same literal paths silently clobbered each other's artifacts —
+exactly what happens once a service runs many jobs in one process. The
+canonical layout routes every artifact kind through one directory:
+
+* ``autosave.npz``  — the resilience/pause checkpoint
+  (``resume_from``-loadable);
+* ``flight.jsonl``  — the flight-recorder postmortem dump;
+* ``trace.jsonl``   — the structured run-trace JSONL stream;
+* ``result.json``   — the final result summary (written by the job
+  service; standalone runs are free to use it too).
+
+``tpu_options(artifact_dir=dir)`` expands to the first three engine
+knobs (explicit knobs win — the expansion only fills gaps), and the
+job service (``stateright_tpu/service``) uses the same helper for its
+per-job directories, so a job's artifacts and a standalone run's
+artifacts have the identical shape and ``tools/trace_report.py --job``
+can locate them by convention.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+#: artifact kind -> filename inside an artifact directory. The keys for
+#: the first three match the ``tpu_options`` knobs they default.
+ARTIFACT_NAMES: Dict[str, str] = {
+    "autosave": "autosave.npz",
+    "flight_path": "flight.jsonl",
+    "trace": "trace.jsonl",
+    "result": "result.json",
+}
+
+
+def artifact_paths(directory, create: bool = True) -> Dict[str, str]:
+    """The canonical artifact paths inside ``directory`` (created when
+    ``create``). Returns ``{kind: path}`` for every kind in
+    :data:`ARTIFACT_NAMES`."""
+    directory = os.fspath(directory)
+    if create:
+        os.makedirs(directory, exist_ok=True)
+    return {kind: os.path.join(directory, name)
+            for kind, name in ARTIFACT_NAMES.items()}
+
+
+def apply_artifact_dir(options: dict) -> dict:
+    """Expand ``options['artifact_dir']`` into the engine artifact
+    knobs IN PLACE (explicitly set knobs win; ``result`` is a service-
+    layer artifact and is never injected into engine options). Returns
+    ``options`` for chaining; a no-op without ``artifact_dir``."""
+    adir = options.get("artifact_dir")
+    if adir is None:
+        return options
+    paths = artifact_paths(adir)
+    for kind in ("autosave", "flight_path", "trace"):
+        options.setdefault(kind, paths[kind])
+    return options

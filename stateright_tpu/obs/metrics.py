@@ -186,6 +186,26 @@ GLOSSARY: Dict[str, str] = {
                       "watchdog expiry, exhausted retries, and "
                       "degradation rungs; see the recorder_dump trace "
                       "event for the path)",
+    # --- pausable runs + the job service (stateright_tpu/service) ------
+    "pause": "engine-level pause: draining the pipeline and writing "
+             "the resume_from-loadable pause checkpoint "
+             "(Checker.request_pause; the step-driver boundary)",
+    "pauses": "pause checkpoints written (a paused run exits its "
+              "engine loop cleanly; resumption is a fresh checker via "
+              "resume_from — possibly on a different mesh width, which "
+              "is how the scheduler preempts onto smaller subsets)",
+    "jobs_submitted": "checking jobs accepted by the scheduler "
+                      "(service/scheduler.py)",
+    "jobs_done": "jobs that ran to completion and landed a result "
+                 "artifact",
+    "jobs_failed": "jobs whose engine raised (the classified error "
+                   "rides the job's status artifact)",
+    "preemptions": "running jobs paused by the scheduler to free "
+                   "device subsets for higher-priority work (the "
+                   "victim re-queues and resumes from its pause "
+                   "checkpoint, typically on a smaller subset)",
+    "queue_depth": "jobs currently waiting for a device subset "
+                   "(gauge; sampled after every scheduling pass)",
 }
 
 #: keys that are point-in-time GAUGES, not accumulating counters:
@@ -194,7 +214,7 @@ GLOSSARY: Dict[str, str] = {
 #: values (``fused=2``, a ``mesh_shards`` no mesh ever had).
 GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
-    "shard_balance", "host_tier_keys",
+    "shard_balance", "host_tier_keys", "queue_depth",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

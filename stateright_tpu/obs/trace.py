@@ -113,6 +113,21 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # degradation rung); optional fields: `reason`, `dropped` (events
     # evicted by the ring bound before the dump)
     "recorder_dump": frozenset({"path", "events"}),
+    # pausable runs: the engine drained its pipeline and wrote a
+    # resume_from-loadable pause checkpoint (Checker.request_pause —
+    # the step-driver/job-service boundary)
+    "pause": frozenset({"path", "unique"}),
+    # the checking-as-a-service job lifecycle (stateright_tpu/service,
+    # engine="service"): submission, placement on a device subset
+    # (`width`), a pause (reason: "user" | "preempt" | "shutdown"),
+    # resumption (optionally on a different width), and the terminal
+    # transition (`state`: done / failed / cancelled; optional fields
+    # ride along — unique counts, error strings, the blamed job)
+    "job_submit": frozenset({"job", "model", "priority"}),
+    "job_start": frozenset({"job", "width"}),
+    "job_pause": frozenset({"job", "reason"}),
+    "job_resume": frozenset({"job", "width"}),
+    "job_done": frozenset({"job", "state"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

@@ -73,7 +73,12 @@ class ShardedTpuChecker(TpuChecker):
                 "spawn_tpu")
 
     # ------------------------------------------------------------------
-    def _run(self) -> None:
+    def _run_steps(self):
+        # generator form of the sharded chunk loop (each yield = one
+        # processed chunk / handled intervention), driven blocking by
+        # the inherited TpuChecker._run or stepwise by the job
+        # service's StepDriver; a pending request_pause() drains the
+        # pipeline and writes the resume_from-loadable checkpoint
         import jax
 
         mesh, axis = self._mesh, self._axis
@@ -529,7 +534,8 @@ class ShardedTpuChecker(TpuChecker):
                     or len(discoveries) == prop_count
                     or (target is not None
                         and self._state_count >= target)
-                    or self._cancel_event.is_set()):
+                    or self._cancel_event.is_set()
+                    or self._pause_event.is_set()):
                 acts.add("done")
                 return acts
             need_grow = (int(log_n.max()) >= grow_limit
@@ -859,6 +865,7 @@ class ShardedTpuChecker(TpuChecker):
                     if not acts:
                         if not inflight:
                             dispatch()
+                        yield  # step boundary: one chunk consumed
                         continue
                     # drain the speculative chunk before any host
                     # intervention: under a device-visible stop
@@ -888,6 +895,7 @@ class ShardedTpuChecker(TpuChecker):
                     elif "egrow" in acts:
                         handle_egrow()
                     dispatch()
+                    yield  # step boundary: intervention handled
                 break
             except BaseException as exc:
                 if shadow is None:
@@ -999,15 +1007,17 @@ class ShardedTpuChecker(TpuChecker):
             # loop (checker/tpu.py) on the surviving chip, seeded from
             # the shadow handoff. Its own retry envelope (and the
             # shadow-spanning lasso sweep / resumable-frontier /
-            # mirror post-passes) take over from here.
+            # mirror post-passes) take over from here — driven through
+            # the same generator so a stepped/paused run stays
+            # responsive across the handoff.
             import contextlib
             self._fault_shards = 1
             dev = self._handoff_device
             ctx = (jax.default_device(dev) if dev is not None
                    else contextlib.nullcontext())
             with ctx:
-                self._run_device()
-            if self._visitor is not None:
+                yield from self._drive_device()
+            if self._visitor is not None and not self._paused:
                 with self._timed("visit"):
                     self._visit_reached()
             return
@@ -1020,6 +1030,38 @@ class ShardedTpuChecker(TpuChecker):
             self._metrics.set(
                 "shard_balance",
                 round(float(int(log_n.min()) / int(log_n.max())), 4))
+
+        if (self._pause_event.is_set()
+                and not self._cancel_event.is_set()
+                and int((q_tail - q_head).sum()) > 0
+                and len(discoveries) < prop_count
+                and not (target is not None
+                         and self._state_count >= target)):
+            # pause exit (the run did NOT finish): the pipeline drained
+            # above; checkpoint the complete mirror + pending frontier
+            # in the shard-agnostic single-chip format, so the job
+            # resumes on ANY mesh width (preemption onto a smaller
+            # subset rides the same machinery as a cross-mesh resume)
+            if shadow is not None:
+                p_rows, p_ebs, p_fps = shadow.pending()
+            else:
+                self._finalize_sharded(carry)
+                self._ensure_mirror()
+                qloc = qcap // D
+                width = model.packed_width
+                q_h, qh, qt = jax.device_get(
+                    (carry.q, carry.q_head, carry.q_tail))
+                pend = np.concatenate(
+                    [q_h[s * qloc + int(qh[s]):s * qloc + int(qt[s])]
+                     for s in range(D)])
+                p_rows = pend[:, :width]
+                p_ebs = pend[:, width]
+                p_fps = _combine64(pend[:, width + 1],
+                                   pend[:, width + 2])
+            self._write_pause_checkpoint(p_rows, p_ebs, p_fps,
+                                         discoveries)
+            self._discovery_fps.update(discoveries)
+            return
 
         if (self._sound and int((q_tail - q_head).sum()) == 0
                 and self._resume_path is not None):

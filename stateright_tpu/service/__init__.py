@@ -1,0 +1,53 @@
+"""Checking-as-a-service: a multi-tenant job scheduler over the device mesh.
+
+The reference ships a long-running web service (the actix-web Explorer)
+around a blocking checker; this package composes the pieces this repo
+already grew — Explorer HTTP + ``/.metrics`` + SSE, ``RunTrace`` JSONL,
+autosave checkpoints resumable across mesh sizes, and the degradation
+ladder's power-of-two subset carving — into a job service:
+
+* :class:`~stateright_tpu.service.driver.StepDriver` — drives one
+  checker run as ``start → step(budget) → … → finish`` over the
+  engines' chunk-granular generators, with ``pause()`` draining the
+  pipeline and landing a ``resume_from``-loadable checkpoint;
+* :class:`~stateright_tpu.service.jobs.JobStore` — durable per-job
+  directories (spec, autosave checkpoint, trace JSONL, flight dump,
+  result summary) that survive service restarts;
+* :class:`~stateright_tpu.service.scheduler.Scheduler` — packs
+  concurrent jobs onto DISJOINT power-of-two device subsets (the
+  ladder's subset carving generalized from fault response to capacity
+  allocation), re-carving as jobs finish; preemption pauses the
+  lowest-priority job and resumes it on a smaller subset;
+* :func:`~stateright_tpu.service.api.serve_jobs` — the HTTP job API
+  (submit / status / cancel / pause / resume, per-job SSE event
+  streams and metrics), client in ``tools/jobs.py``.
+
+README.md § Checking as a service documents the API and artifact
+layout.
+"""
+
+from .driver import DONE, FAILED, PAUSED, RUNNING, StepDriver
+from .jobs import (JOB_STATES, MODEL_REGISTRY, Job, JobSpec, JobStore,
+                   build_model, register_model)
+from .scheduler import DeviceLease, DevicePool, Scheduler
+from .api import ServiceHandle, serve_jobs
+
+__all__ = [
+    "DONE",
+    "DeviceLease",
+    "DevicePool",
+    "FAILED",
+    "JOB_STATES",
+    "Job",
+    "JobSpec",
+    "JobStore",
+    "MODEL_REGISTRY",
+    "PAUSED",
+    "RUNNING",
+    "Scheduler",
+    "ServiceHandle",
+    "StepDriver",
+    "build_model",
+    "register_model",
+    "serve_jobs",
+]

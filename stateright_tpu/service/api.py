@@ -1,0 +1,224 @@
+"""The job service HTTP API — the Explorer's stdlib server, grown up.
+
+Endpoints (JSON unless noted):
+
+    POST /jobs                  submit a job spec -> {"id": ...}
+                                body: {"model", "args", "kwargs",
+                                "options", "priority", "width",
+                                "target", "step_delay"}
+    GET  /jobs                  -> {"jobs": [view...], "profile": {...}}
+    GET  /jobs/<id>             -> job view (+ "result" when terminal)
+    POST /jobs/<id>/cancel      -> {"ok": bool}
+    POST /jobs/<id>/pause       -> {"ok": bool}   (checkpoint + hold)
+    POST /jobs/<id>/resume      -> {"ok": bool}   (re-enqueue)
+    GET  /jobs/<id>/events      Server-Sent Events: a RUNNING job
+                                streams its live run trace (the
+                                Explorer's bounded-queue/slow-client-
+                                drop subscriber, flight-ring backlog
+                                first); otherwise the recorded
+                                trace.jsonl replays and the stream ends
+    GET  /jobs/<id>/metrics     live engine metrics (RUNNING) or the
+                                recorded result profile
+
+``tools/jobs.py`` is the CLI client (serve / submit / watch / result /
+list / pause / resume / cancel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from ..checker.explorer import metrics_view, serve_events
+from .jobs import JobSpec, TERMINAL_STATES
+from .scheduler import Scheduler
+
+
+class ServiceHandle:
+    """A running job service: ``.port``, ``.url``, ``.shutdown()``."""
+
+    def __init__(self, scheduler: Scheduler,
+                 server: ThreadingHTTPServer):
+        self.scheduler = scheduler
+        self.server = server
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.server.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop serving and gracefully stop the scheduler (running
+        jobs checkpoint and re-enqueue for the next boot)."""
+        self.server.shutdown()
+        self.server.server_close()
+        self.scheduler.shutdown(wait=wait)
+
+
+def _replay_trace_sse(handler, trace_path: str) -> None:
+    """SSE replay of a finished/paused job's recorded trace file."""
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/event-stream")
+    handler.send_header("Cache-Control", "no-cache")
+    handler.end_headers()
+    try:
+        if os.path.exists(trace_path):
+            with open(trace_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        handler.wfile.write(
+                            b"data: " + line.encode() + b"\n\n")
+        handler.wfile.write(b": end of recorded trace\n\n")
+        handler.wfile.flush()
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        pass
+
+
+def _make_handler(scheduler: Scheduler):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send_json(self, code: int, payload) -> None:
+            body = json.dumps(payload, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _job(self, job_id: str):
+            job = scheduler.job(job_id)
+            if job is None:
+                self._send_json(404, {"error": f"no job {job_id!r}"})
+            return job
+
+        # --- GET -------------------------------------------------------
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+            path, _, _query = self.path.partition("?")
+            parts = [p for p in path.split("/") if p]
+            try:
+                if parts == ["jobs"]:
+                    self._send_json(200, {
+                        "jobs": [j.view() for j in scheduler.jobs()],
+                        "profile": scheduler.profile()})
+                elif len(parts) == 2 and parts[0] == "jobs":
+                    job = self._job(parts[1])
+                    if job is not None:
+                        self._send_json(200, job.view())
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                        and parts[2] == "events"):
+                    job = self._job(parts[1])
+                    if job is None:
+                        return
+                    checker = scheduler.checker_for(job.id)
+                    if checker is not None:
+                        serve_events(self, checker)
+                    else:
+                        _replay_trace_sse(self, job.paths["trace"])
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                        and parts[2] == "metrics"):
+                    job = self._job(parts[1])
+                    if job is None:
+                        return
+                    checker = scheduler.checker_for(job.id)
+                    if checker is not None:
+                        self._send_json(200, metrics_view(checker))
+                    else:
+                        result = job.read_result()
+                        self._send_json(200, {
+                            "done": job.state in TERMINAL_STATES,
+                            "state": job.state,
+                            "profile": (result or {}).get("profile",
+                                                          {})})
+                else:
+                    self._send(404, b"not found", "text/plain")
+            except Exception as exc:  # pragma: no cover - defensive
+                try:
+                    self._send_json(500, {"error": str(exc)})
+                except OSError:
+                    pass
+
+        # --- POST ------------------------------------------------------
+        def _read_body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return {}
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def do_POST(self) -> None:  # noqa: N802 (stdlib API)
+            path, _, _query = self.path.partition("?")
+            parts = [p for p in path.split("/") if p]
+            try:
+                if parts == ["jobs"]:
+                    payload = self._read_body()
+                    spec = JobSpec(
+                        model=payload["model"],
+                        args=payload.get("args") or (),
+                        kwargs=payload.get("kwargs") or {},
+                        options=payload.get("options") or {},
+                        priority=payload.get("priority", 0),
+                        width=payload.get("width", 1),
+                        target=payload.get("target"),
+                        step_delay=payload.get("step_delay", 0.0))
+                    job = scheduler.submit(spec)
+                    self._send_json(201, {"id": job.id,
+                                          "state": job.state})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                        and parts[2] in ("cancel", "pause", "resume")):
+                    job = self._job(parts[1])
+                    if job is None:
+                        return
+                    ok = getattr(scheduler, parts[2])(job.id)
+                    self._send_json(200 if ok else 409,
+                                    {"ok": bool(ok),
+                                     "state": job.state})
+                else:
+                    self._send(404, b"not found", "text/plain")
+            except (KeyError, ValueError, json.JSONDecodeError) as exc:
+                self._send_json(400, {"error": str(exc)})
+            except Exception as exc:  # pragma: no cover - defensive
+                try:
+                    self._send_json(500, {"error": str(exc)})
+                except OSError:
+                    pass
+
+    return Handler
+
+
+def serve_jobs(scheduler: Scheduler,
+               address: Tuple[str, int] | str = ("127.0.0.1", 0),
+               block: bool = False) -> Optional[ServiceHandle]:
+    """Serve the job API. ``block=False`` (default) serves on a daemon
+    thread and returns a :class:`ServiceHandle`; ``block=True`` serves
+    until interrupted (the CLI's ``serve`` mode) and shuts the
+    scheduler down gracefully on the way out."""
+    if isinstance(address, str):
+        host, _, port = address.rpartition(":")
+        address = (host or "localhost", int(port))
+    server = ThreadingHTTPServer(address, _make_handler(scheduler))
+    handle = ServiceHandle(scheduler, server)
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            server.server_close()
+            scheduler.shutdown()
+        return None
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return handle

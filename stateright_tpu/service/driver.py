@@ -1,0 +1,119 @@
+"""Epoch-granular step driver over a checker run.
+
+The engines' run loops are generators since round 10
+(``TpuChecker._drive_device`` / ``ShardedTpuChecker._run_steps`` yield
+once per processed chunk or handled intervention); the blocking
+``run()``/``join()`` surface is a thin loop over them. ``StepDriver``
+exposes the other way to drive the same generator:
+
+    driver = StepDriver(checker)
+    driver.start()
+    while driver.step(budget=4) == RUNNING:
+        ...  # poll a control channel, sleep, report progress
+    driver.status  # DONE / PAUSED / FAILED
+
+``pause()`` asks the engine to stop at the next chunk boundary — the
+chunk loop drains its in-flight pipeline and writes a
+``resume_from``-loadable checkpoint (complete mirror + pending
+frontier) — then drives the generator to its clean exit and returns the
+checkpoint path. Resumption is a NEW checker built with
+``resume_from(path)``, on any mesh width: that asymmetry (pause is an
+engine exit, resume is a fresh run) is what lets the scheduler preempt
+a D=4 job and restart it on a D=2 subset with the ladder's existing
+parity guarantee.
+
+The driver runs engine code on the CALLING thread (no background
+thread); errors are captured on the checker exactly like the threaded
+path — ``checker.error()`` holds them, ``status`` reports ``FAILED``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: driver states (``StepDriver.status``)
+NEW = "new"
+RUNNING = "running"
+DONE = "done"
+PAUSED = "paused"
+FAILED = "failed"
+
+
+class StepDriver:
+    """Drive one checker run step by step on the calling thread."""
+
+    def __init__(self, checker):
+        self._checker = checker
+        self._gen = None
+        self._status = NEW
+
+    @property
+    def checker(self):
+        return self._checker
+
+    @property
+    def status(self) -> str:
+        return self._status
+
+    # ------------------------------------------------------------------
+    def start(self) -> "StepDriver":
+        """Claim the run (the background thread can no longer start on
+        it) and arm the engine generator; no engine work runs yet."""
+        if self._gen is not None:
+            raise RuntimeError("StepDriver.start() called twice")
+        self._checker._claim_driver()
+        self._gen = self._checker._step_wrapper()
+        self._status = RUNNING
+        return self
+
+    def step(self, budget: int = 1) -> str:
+        """Advance up to ``budget`` engine quanta (a quantum is one
+        processed chunk / handled intervention on the device engines;
+        host engines run whole in one). Returns the driver status —
+        ``RUNNING`` while more work remains."""
+        if self._gen is None:
+            raise RuntimeError("StepDriver.step() before start()")
+        if self._status != RUNNING:
+            return self._status
+        for _ in range(max(1, int(budget))):
+            try:
+                next(self._gen)
+            except StopIteration:
+                self._finish()
+                break
+        return self._status
+
+    def drain(self) -> str:
+        """Drive the run to its exit (completion, a pause exit, or a
+        captured failure)."""
+        while self._status == RUNNING:
+            self.step(64)
+        return self._status
+
+    def pause(self, path=None) -> Optional[str]:
+        """Request a pause and drive the engine to its clean exit
+        (pipeline drained, checkpoint written). Returns the checkpoint
+        path when the engine actually paused, ``None`` when the run
+        finished (or failed) before the pause landed — check
+        ``status``."""
+        self._checker.request_pause(path)
+        self.drain()
+        if self._checker.paused():
+            import os
+            return os.fspath(self._checker.pause_path())
+        return None
+
+    def cancel(self) -> str:
+        """Cancel the run and drive it to its exit."""
+        self._checker.cancel()
+        return self.drain()
+
+    # ------------------------------------------------------------------
+    def _finish(self) -> None:
+        ck = self._checker
+        if ck.error() is not None:
+            self._status = FAILED
+        elif ck.paused():
+            self._status = PAUSED
+        else:
+            self._status = DONE

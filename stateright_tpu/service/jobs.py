@@ -1,0 +1,303 @@
+"""Durable job store: one directory per checking job.
+
+Layout (``stateright_tpu.obs.artifact_paths`` — identical to a
+standalone run's ``tpu_options(artifact_dir=...)``):
+
+    <root>/<job_id>/
+        spec.json      the submitted job spec (model name + args,
+                       tpu_options, priority, width, target)
+        status.json    the job state machine (atomic tmp+replace
+                       writes, so a SIGKILL mid-transition can never
+                       leave a truncated status)
+        autosave.npz   the resilience/pause checkpoint
+                       (``resume_from``-loadable, mesh-width-agnostic)
+        trace.jsonl    the run-trace JSONL stream
+        flight.jsonl   the flight-recorder postmortem dump (on crash)
+        result.json    the final result summary (properties,
+                       unique_state_count, discoveries, profile, and a
+                       fingerprint-set digest for parity checks)
+
+Jobs survive a service restart: ``JobStore.load_all`` re-reads every
+directory, and the scheduler's recovery pass re-enqueues ``queued``
+jobs and resumes ``running`` ones from their last autosave.
+
+Models are named through :data:`MODEL_REGISTRY` so job specs are plain
+JSON — subprocess clients (``tools/jobs.py``) and restart recovery
+never pickle a model object. In-process callers may also pass a
+factory callable; such jobs cannot be rebuilt after a restart and are
+marked non-durable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..obs import artifact_paths
+
+#: job states (status.json "state")
+QUEUED = "queued"
+RUNNING = "running"
+PAUSED = "paused"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+JOB_STATES = (QUEUED, RUNNING, PAUSED, DONE, FAILED, CANCELLED)
+TERMINAL_STATES = (DONE, FAILED, CANCELLED)
+
+#: engine artifact knobs the service owns — user options must not
+#: redirect a job's artifacts outside its directory
+_RESERVED_OPTIONS = ("artifact_dir", "autosave", "flight_path", "trace",
+                     "mesh", "race")
+
+
+def _registry() -> Dict[str, Callable]:
+    """Named example models (lazy imports keep ``import
+    stateright_tpu.service`` light): every entry is a packed model
+    factory a subprocess can name in a JSON spec."""
+    from ..examples.paxos_packed import PackedPaxos
+    from ..examples.single_copy_packed import PackedSingleCopy
+    from ..examples.abd_packed import PackedAbd
+    from ..models.twopc import TwoPhaseSys
+    return {
+        "twopc": TwoPhaseSys,
+        "paxos": PackedPaxos,
+        "single_copy": PackedSingleCopy,
+        "abd": PackedAbd,
+    }
+
+
+#: extra factories registered at runtime (tests, embedders)
+MODEL_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str, factory: Callable) -> None:
+    """Register a model factory under ``name`` for job specs."""
+    MODEL_REGISTRY[name] = factory
+
+
+def build_model(name: str, args, kwargs):
+    factory = MODEL_REGISTRY.get(name) or _registry().get(name)
+    if factory is None:
+        known = sorted(set(MODEL_REGISTRY) | set(_registry()))
+        raise ValueError(
+            f"unknown model {name!r}; known models: {known} "
+            "(register_model(name, factory) adds more)")
+    return factory(*(args or ()), **(kwargs or {}))
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """tmp + ``os.replace``: a killed service never leaves a truncated
+    status/result where a good one stood (same discipline as
+    ``resilience.atomic_savez``)."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class JobSpec:
+    """What a client submits: a named packed-model factory plus the
+    run's knobs. ``width`` is the REQUESTED power-of-two device-subset
+    width (the scheduler may grant less when the mesh is busy);
+    ``options`` are ``tpu_options`` (artifact/mesh knobs are service-
+    owned and stripped); ``step_delay`` throttles the driver loop —
+    a testing knob that makes kill/preempt windows deterministic."""
+
+    def __init__(self, model: Any, args=(), kwargs=None, options=None,
+                 priority: int = 0, width: int = 1,
+                 target: Optional[int] = None,
+                 step_delay: float = 0.0):
+        if callable(model):
+            self.model_name = getattr(model, "__name__", "<callable>")
+            self.factory: Optional[Callable] = model
+        else:
+            self.model_name = str(model)
+            self.factory = None
+        self.args = list(args or ())
+        self.kwargs = dict(kwargs or {})
+        options = dict(options or {})
+        for key in _RESERVED_OPTIONS:
+            options.pop(key, None)
+        self.options = options
+        self.priority = int(priority)
+        width = int(width)
+        if width < 1 or (width & (width - 1)):
+            raise ValueError("JobSpec width must be a power of two >= 1")
+        self.width = width
+        self.target = None if target is None else int(target)
+        self.step_delay = float(step_delay)
+
+    @property
+    def durable(self) -> bool:
+        """Whether the spec can be rebuilt from JSON after a restart."""
+        return self.factory is None
+
+    def build(self):
+        if self.factory is not None:
+            return self.factory(*self.args, **self.kwargs)
+        return build_model(self.model_name, self.args, self.kwargs)
+
+    def to_json(self) -> dict:
+        return {"model": self.model_name, "args": self.args,
+                "kwargs": self.kwargs, "options": self.options,
+                "priority": self.priority, "width": self.width,
+                "target": self.target, "step_delay": self.step_delay,
+                "durable": self.durable}
+
+    @classmethod
+    def from_json(cls, payload: dict) -> "JobSpec":
+        return cls(model=payload["model"],
+                   args=payload.get("args") or (),
+                   kwargs=payload.get("kwargs") or {},
+                   options=payload.get("options") or {},
+                   priority=payload.get("priority", 0),
+                   width=payload.get("width", 1),
+                   target=payload.get("target"),
+                   step_delay=payload.get("step_delay", 0.0))
+
+
+class Job:
+    """One job's durable state + its artifact paths."""
+
+    def __init__(self, job_id: str, directory: str, spec: JobSpec,
+                 status: Optional[dict] = None):
+        self.id = job_id
+        self.dir = directory
+        self.spec = spec
+        self.paths = artifact_paths(directory)
+        self._status_path = os.path.join(directory, "status.json")
+        self._lock = threading.Lock()
+        self.status: Dict[str, Any] = status or {}
+
+    # --- state machine -------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self.status.get("state", QUEUED)
+
+    @property
+    def seq(self) -> int:
+        return int(self.status.get("seq", 0))
+
+    @property
+    def priority(self) -> int:
+        return self.spec.priority
+
+    def set_state(self, state: str, **extra) -> None:
+        assert state in JOB_STATES, state
+        with self._lock:
+            self.status["state"] = state
+            self.status[f"{state}_at"] = time.time()
+            self.status.update(extra)
+            _atomic_write_json(self._status_path, self.status)
+
+    def has_checkpoint(self) -> bool:
+        return os.path.exists(self.paths["autosave"])
+
+    def read_result(self) -> Optional[dict]:
+        try:
+            with open(self.paths["result"]) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def view(self) -> dict:
+        """The JSON shape the HTTP API serves for this job."""
+        out = {"id": self.id, "state": self.state,
+               "model": self.spec.model_name,
+               "args": self.spec.args,
+               "priority": self.spec.priority,
+               "width": self.spec.width,
+               "durable": self.spec.durable}
+        for key in ("seq", "granted_width", "resume", "preempted",
+                    "error", "queued_at", "running_at", "paused_at",
+                    "done_at", "failed_at", "cancelled_at"):
+            if key in self.status:
+                out[key] = self.status[key]
+        if self.state in TERMINAL_STATES:
+            result = self.read_result()
+            if result is not None:
+                out["result"] = result
+        return out
+
+
+class JobStore:
+    """The per-job directory tree under one service root."""
+
+    def __init__(self, root):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Job] = {}
+        self._seq = 0
+        for job in self._scan():
+            self._jobs[job.id] = job
+            self._seq = max(self._seq, job.seq)
+
+    #: the service's own trace stream (engine="service"), beside the
+    #: per-job directories
+    @property
+    def service_trace_path(self) -> str:
+        return os.path.join(self.root, "service.jsonl")
+
+    def _scan(self) -> List[Job]:
+        jobs = []
+        for name in sorted(os.listdir(self.root)):
+            directory = os.path.join(self.root, name)
+            spec_path = os.path.join(directory, "spec.json")
+            if not os.path.isfile(spec_path):
+                continue
+            try:
+                with open(spec_path) as f:
+                    spec = JobSpec.from_json(json.load(f))
+                status_path = os.path.join(directory, "status.json")
+                status = {}
+                if os.path.isfile(status_path):
+                    with open(status_path) as f:
+                        status = json.load(f)
+            except (OSError, json.JSONDecodeError, KeyError,
+                    ValueError):
+                continue  # a corrupt/foreign directory is not a job
+            jobs.append(Job(name, directory, spec, status))
+        return jobs
+
+    # ------------------------------------------------------------------
+    def create(self, spec: JobSpec) -> Job:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            job_id = f"j{seq:04d}-{_slug(spec.model_name)}"
+            directory = os.path.join(self.root, job_id)
+            os.makedirs(directory, exist_ok=True)
+            _atomic_write_json(os.path.join(directory, "spec.json"),
+                               spec.to_json())
+            job = Job(job_id, directory, spec)
+            job.status["seq"] = seq
+            job.set_state(QUEUED)
+            self._jobs[job_id] = job
+            return job
+
+    def get(self, job_id: str) -> Optional[Job]:
+        return self._jobs.get(job_id)
+
+    def jobs(self) -> List[Job]:
+        return sorted(self._jobs.values(), key=lambda j: j.seq)
+
+
+def _slug(name: str) -> str:
+    return "".join(c if c.isalnum() else "-" for c in name.lower())[:24]

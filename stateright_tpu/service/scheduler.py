@@ -1,0 +1,505 @@
+"""The job scheduler: pack concurrent checking jobs onto DISJOINT
+power-of-two device subsets.
+
+The degradation ladder (``checker/resilience.py DegradePolicy``)
+already carves power-of-two device subsets out of a mesh — as a fault
+response. This module generalizes that carving to CAPACITY allocation:
+:class:`DevicePool` is a buddy allocator over the device list (an
+8-device mesh can host one D=4 job + two D=2 jobs + singles, blocks
+merging back as jobs finish), and :class:`Scheduler` drives one worker
+thread per RUNNING job through the engines' step generators
+(:class:`~stateright_tpu.service.driver.StepDriver`), so every job is
+pausable between chunks.
+
+Scheduling policy:
+
+* queued jobs place in (priority desc, submission order) — a job asks
+  for ``width`` devices and is granted the largest free power-of-two
+  block ≤ its request (down to 1);
+* a running job's mesh width NEVER changes mid-flight — only at a
+  pause/resume boundary, riding the ladder's existing cross-mesh
+  resume machinery (the checkpoint format is shard-agnostic);
+* **preemption**: when nothing is free and a queued job outranks a
+  running one, the lowest-priority victim is paused (checkpoint
+  written, subset released) and re-queued to resume on whatever subset
+  remains — typically a smaller one;
+* restart recovery: jobs found RUNNING at boot (a killed service)
+  re-enqueue and resume from their last autosave; QUEUED jobs simply
+  re-enqueue; PAUSED jobs wait for an explicit resume.
+
+Observability: the scheduler emits ``job_submit`` / ``job_start`` /
+``job_pause`` / ``job_resume`` / ``job_done`` events (engine
+``service``) to ``<root>/service.jsonl`` and keeps the
+``jobs_submitted`` / ``jobs_done`` / ``jobs_failed`` / ``preemptions``
+/ ``queue_depth`` metrics (``stateright_tpu.obs.GLOSSARY``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from ..obs import Metrics, make_trace
+from . import jobs as jobstates
+from .driver import DONE, FAILED, RUNNING, StepDriver
+from .jobs import Job, JobSpec, JobStore, TERMINAL_STATES
+
+
+class DeviceLease(NamedTuple):
+    """A granted device subset: ``offset`` into the pool's device
+    list, power-of-two ``width``, and the device objects themselves."""
+    offset: int
+    width: int
+    devices: Tuple
+
+
+class DevicePool:
+    """Buddy allocator over an aligned power-of-two device range.
+
+    Subsets are power-of-two sized and naturally aligned
+    (``offset % width == 0``), so any two live leases are disjoint and
+    releases merge with their buddy — the same carving discipline the
+    degradation ladder uses, applied to capacity instead of faults.
+    Not thread-safe on its own; the scheduler serializes access."""
+
+    def __init__(self, devices):
+        devices = list(devices)
+        if not devices:
+            raise ValueError("DevicePool needs at least one device")
+        n = 1 << (len(devices).bit_length() - 1)  # pow2 floor
+        self.width = n
+        self._devices = devices[:n]
+        self._free: Dict[int, set] = {n: {0}}
+
+    def acquire(self, width: int) -> Optional[DeviceLease]:
+        width = int(width)
+        if width < 1 or (width & (width - 1)) or width > self.width:
+            return None
+        sizes = sorted(s for s, offs in self._free.items()
+                       if offs and s >= width)
+        if not sizes:
+            return None
+        size = sizes[0]
+        offset = min(self._free[size])
+        self._free[size].discard(offset)
+        while size > width:  # split, keeping the upper buddy free
+            size //= 2
+            self._free.setdefault(size, set()).add(offset + size)
+        return DeviceLease(offset, width,
+                           tuple(self._devices[offset:offset + width]))
+
+    def release(self, lease: DeviceLease) -> None:
+        offset, width = lease.offset, lease.width
+        while width < self.width:  # merge with the free buddy
+            buddy = offset ^ width
+            if buddy not in self._free.get(width, ()):
+                break
+            self._free[width].discard(buddy)
+            offset = min(offset, buddy)
+            width *= 2
+        self._free.setdefault(width, set()).add(offset)
+
+    def free_width(self) -> int:
+        return sum(s * len(offs) for s, offs in self._free.items())
+
+    def largest_free(self) -> int:
+        avail = [s for s, offs in self._free.items() if offs]
+        return max(avail) if avail else 0
+
+
+class _JobRuntime:
+    """Scheduler-side handle on one RUNNING job: the live checker and
+    driver (for the HTTP API's SSE/metrics), the worker thread, and a
+    one-slot control channel (pause / preempt / shutdown / cancel)."""
+
+    __slots__ = ("lease", "thread", "checker", "driver", "_control",
+                 "_ctl_lock")
+
+    def __init__(self, lease: DeviceLease):
+        self.lease = lease
+        self.thread: Optional[threading.Thread] = None
+        self.checker = None
+        self.driver: Optional[StepDriver] = None
+        self._control: Optional[str] = None
+        self._ctl_lock = threading.Lock()
+
+    def set_control(self, ctl: str) -> None:
+        with self._ctl_lock:
+            # cancel beats pause; otherwise first request wins
+            if self._control is None or ctl == "cancel":
+                self._control = ctl
+
+    def take_control(self) -> Optional[str]:
+        with self._ctl_lock:
+            ctl, self._control = self._control, None
+            return ctl
+
+
+class Scheduler:
+    """Multi-tenant job scheduler over the device mesh."""
+
+    def __init__(self, store, devices=None, step_budget: int = 4,
+                 trace=None, recover: bool = True):
+        self._store = store if isinstance(store, JobStore) \
+            else JobStore(store)
+        self._lock = threading.RLock()
+        self._running: Dict[str, _JobRuntime] = {}
+        self._closed = False
+        self._step_budget = max(1, int(step_budget))
+        self._metrics = Metrics()
+        self._trace = make_trace(
+            self._store.service_trace_path if trace is None else trace,
+            engine="service")
+        self._devices = None if devices is None else list(devices)
+        self._pool: Optional[DevicePool] = None
+        if recover:
+            self._recover()
+            # boot placement pass: recovered RUNNING jobs (and any
+            # still-QUEUED ones) must not wait for the next submit
+            if any(j.state == jobstates.QUEUED
+                   for j in self._store.jobs()):
+                self._schedule()
+
+    # --- introspection -------------------------------------------------
+    @property
+    def store(self) -> JobStore:
+        return self._store
+
+    def profile(self) -> dict:
+        return self._metrics.snapshot()
+
+    def jobs(self) -> List[Job]:
+        return self._store.jobs()
+
+    def job(self, job_id: str) -> Optional[Job]:
+        return self._store.get(job_id)
+
+    def checker_for(self, job_id: str):
+        """The live checker of a RUNNING job (None otherwise) — the
+        HTTP API's hook for per-job SSE/metrics."""
+        with self._lock:
+            rt = self._running.get(job_id)
+            return rt.checker if rt is not None else None
+
+    def pool_width(self) -> int:
+        self._ensure_pool()
+        return self._pool.width
+
+    # --- lifecycle -----------------------------------------------------
+    def submit(self, spec: JobSpec) -> Job:
+        if self._closed:
+            raise RuntimeError("scheduler is shut down")
+        job = self._store.create(spec)
+        self._metrics.inc("jobs_submitted")
+        self._trace.emit("job_submit", job=job.id,
+                         model=spec.model_name, priority=spec.priority)
+        self._schedule()
+        return job
+
+    def pause(self, job_id: str) -> bool:
+        """Pause a job: a RUNNING one checkpoints at the next chunk
+        boundary; a QUEUED one is simply held. Returns False for
+        unknown/terminal jobs."""
+        job = self._store.get(job_id)
+        if job is None:
+            return False
+        with self._lock:
+            rt = self._running.get(job_id)
+            if rt is not None:
+                rt.set_control("pause")
+                return True
+            if job.state == jobstates.QUEUED:
+                job.set_state(jobstates.PAUSED,
+                              resume=job.has_checkpoint())
+                self._trace.emit("job_pause", job=job.id, reason="user")
+                return True
+        return False
+
+    def resume(self, job_id: str) -> bool:
+        """Re-enqueue a PAUSED job (it resumes from its pause
+        checkpoint on whatever subset the pool can grant)."""
+        job = self._store.get(job_id)
+        if job is None or job.state != jobstates.PAUSED:
+            return False
+        job.set_state(jobstates.QUEUED, resume=job.has_checkpoint())
+        self._schedule()
+        return True
+
+    def cancel(self, job_id: str) -> bool:
+        job = self._store.get(job_id)
+        if job is None or job.state in TERMINAL_STATES:
+            return False
+        with self._lock:
+            rt = self._running.get(job_id)
+            if rt is not None:
+                rt.set_control("cancel")
+                return True
+        job.set_state(jobstates.CANCELLED)
+        self._trace.emit("job_done", job=job.id, state="cancelled")
+        self._schedule()
+        return True
+
+    def wait(self, job_id: str, timeout: float = 60.0,
+             states=TERMINAL_STATES) -> str:
+        """Poll until the job reaches one of ``states`` (default: a
+        terminal state); returns the state reached (or the current one
+        on timeout)."""
+        deadline = time.monotonic() + timeout
+        job = self._store.get(job_id)
+        while job is not None and job.state not in states \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        return job.state if job is not None else "unknown"
+
+    def shutdown(self, wait: bool = True, timeout: float = 60.0) -> None:
+        """Stop placing work and pause every RUNNING job (each lands
+        its checkpoint and re-enqueues, so the next boot resumes it)."""
+        with self._lock:
+            self._closed = True
+            rts = list(self._running.values())
+        for rt in rts:
+            rt.set_control("shutdown")
+        if wait:
+            deadline = time.monotonic() + timeout
+            for rt in rts:
+                t = rt.thread
+                if t is not None:
+                    t.join(max(0.0, deadline - time.monotonic()))
+        self._trace.close()
+
+    # --- recovery ------------------------------------------------------
+    def _recover(self) -> None:
+        """Boot pass over the durable store: QUEUED jobs re-enqueue;
+        jobs found RUNNING (a killed service) re-enqueue with their
+        last autosave as the resume point (or from scratch when none
+        landed); PAUSED jobs stay paused until an explicit resume.
+        Non-durable (callable-factory) jobs cannot be rebuilt and
+        fail."""
+        for job in self._store.jobs():
+            if job.state != jobstates.RUNNING:
+                continue
+            if not job.spec.durable:
+                job.set_state(jobstates.FAILED, error=(
+                    "service restarted and the job's model factory "
+                    "was a callable (non-durable spec); submit named "
+                    "models for restart-safe jobs"))
+                self._metrics.inc("jobs_failed")
+                self._trace.emit("job_done", job=job.id,
+                                 state="failed")
+                continue
+            job.set_state(jobstates.QUEUED, recovered=True,
+                          resume=job.has_checkpoint())
+
+    # --- placement core ------------------------------------------------
+    def _ensure_pool(self) -> None:
+        if self._pool is None:
+            if self._devices is None:
+                import jax
+                self._devices = list(jax.devices())
+            self._pool = DevicePool(self._devices)
+
+    def _schedule(self) -> None:
+        """One placement pass (called on submit / resume / job exit):
+        grant queued jobs the largest free power-of-two subset ≤ their
+        request, highest priority first; when nothing is free, preempt
+        the lowest-priority running job that the queue head outranks."""
+        with self._lock:
+            if self._closed:
+                return
+            self._ensure_pool()
+            queued = [j for j in self._store.jobs()
+                      if j.state == jobstates.QUEUED
+                      and j.id not in self._running]
+            queued.sort(key=lambda j: (-j.priority, j.seq))
+            for job in queued:
+                want = min(job.spec.width, self._pool.width)
+                lease = None
+                width = want
+                while width >= 1 and lease is None:
+                    lease = self._pool.acquire(width)
+                    width //= 2
+                if lease is None:
+                    self._maybe_preempt(job)
+                    continue
+                self._launch(job, lease)
+            depth = sum(1 for j in self._store.jobs()
+                        if j.state == jobstates.QUEUED
+                        and j.id not in self._running)
+            self._metrics.set("queue_depth", depth)
+
+    def _maybe_preempt(self, job: Job) -> None:
+        """Nothing is free and ``job`` waits: pause the lowest-priority
+        RUNNING job it strictly outranks (the victim checkpoints,
+        releases its subset, and re-queues to resume on a smaller
+        one)."""
+        victims = [(self._store.get(jid), rt)
+                   for jid, rt in self._running.items()]
+        victims = [(vj, rt) for vj, rt in victims
+                   if vj is not None and vj.priority < job.priority]
+        if not victims:
+            return
+        victims.sort(key=lambda pair: (pair[0].priority, -pair[0].seq))
+        victims[0][1].set_control("preempt")
+
+    def _launch(self, job: Job, lease: DeviceLease) -> None:
+        # registered under the lock BEFORE the thread starts, so a
+        # concurrent _schedule pass can never double-place the job
+        rt = _JobRuntime(lease)
+        self._running[job.id] = rt
+        thread = threading.Thread(
+            target=self._run_job, args=(job, lease, rt),
+            name=f"stateright-job-{job.id}", daemon=True)
+        rt.thread = thread
+        thread.start()
+
+    # --- the per-job worker --------------------------------------------
+    def _run_job(self, job: Job, lease: DeviceLease,
+                 rt: _JobRuntime) -> None:
+        try:
+            self._drive_job(job, lease, rt)
+        except BaseException as exc:
+            # metrics BEFORE the state flip: wait(job) unblocks on the
+            # state, and the profile must already account for the job
+            self._metrics.inc("jobs_failed")
+            job.set_state(jobstates.FAILED,
+                          error=f"{type(exc).__name__}: {exc}")
+            self._trace.emit("job_done", job=job.id, state="failed",
+                             error=f"{type(exc).__name__}: {exc}")
+        finally:
+            with self._lock:
+                self._running.pop(job.id, None)
+                self._pool.release(lease)
+            self._schedule()
+
+    def _drive_job(self, job: Job, lease: DeviceLease,
+                   rt: _JobRuntime) -> None:
+        import contextlib
+
+        import jax
+        import numpy as np
+
+        # a width-1 job pins every dispatch to its granted device
+        # (thread-local JAX config), so singles on different chips
+        # truly run disjoint; wider jobs carry their own mesh
+        ctx = (jax.default_device(lease.devices[0])
+               if lease.width == 1 else contextlib.nullcontext())
+        with ctx:
+            model = job.spec.build()
+            builder = (model.checker()
+                       .tpu_options(**job.spec.options)
+                       .tpu_options(race=False, artifact_dir=job.dir))
+            if lease.width > 1:
+                from jax.sharding import Mesh
+                builder.tpu_options(mesh=Mesh(
+                    np.array(list(lease.devices)), ("shards",)))
+            if job.spec.target:
+                builder.target_state_count(job.spec.target)
+            resumed = bool(job.status.get("resume")) \
+                and job.has_checkpoint()
+            if resumed:
+                builder.resume_from(job.paths["autosave"])
+            checker = builder.spawn_tpu()
+            rt.checker = checker
+            driver = StepDriver(checker).start()
+            rt.driver = driver
+            job.set_state(jobstates.RUNNING, granted_width=lease.width,
+                          resume=resumed)
+            self._trace.emit("job_resume" if resumed else "job_start",
+                             job=job.id, width=lease.width)
+            delay = job.spec.step_delay
+            while True:
+                ctl = rt.take_control()
+                if ctl in ("pause", "preempt", "shutdown"):
+                    checker.request_pause()
+                    driver.drain()
+                    if checker.paused():
+                        if ctl == "preempt":
+                            self._metrics.inc("preemptions")
+                            job.set_state(jobstates.QUEUED,
+                                          resume=True, preempted=True)
+                        elif ctl == "shutdown":
+                            # graceful stop: re-enqueue so the next
+                            # boot resumes it without an operator
+                            job.set_state(jobstates.QUEUED, resume=True)
+                        else:
+                            job.set_state(jobstates.PAUSED, resume=True)
+                        self._trace.emit(
+                            "job_pause", job=job.id,
+                            reason=("preempt" if ctl == "preempt"
+                                    else "shutdown"
+                                    if ctl == "shutdown" else "user"))
+                        return
+                    # the run finished before the pause landed
+                    self._finish_job(job, checker, driver)
+                    return
+                if ctl == "cancel":
+                    driver.cancel()
+                    job.set_state(jobstates.CANCELLED)
+                    self._trace.emit("job_done", job=job.id,
+                                     state="cancelled")
+                    return
+                status = driver.step(self._step_budget)
+                if delay:
+                    time.sleep(delay)
+                if status != RUNNING:
+                    self._finish_job(job, checker, driver)
+                    return
+
+    def _finish_job(self, job: Job, checker, driver: StepDriver) -> None:
+        # metrics BEFORE the state flip (wait(job) unblocks on it)
+        if driver.status == FAILED:
+            err = checker.error()
+            self._metrics.inc("jobs_failed")
+            job.set_state(jobstates.FAILED,
+                          error=f"{type(err).__name__}: {err}")
+            self._trace.emit("job_done", job=job.id, state="failed",
+                             error=f"{type(err).__name__}: {err}")
+            return
+        assert driver.status == DONE, driver.status
+        result = write_result(job, checker)
+        self._metrics.inc("jobs_done")
+        job.set_state(jobstates.DONE,
+                      unique=result["unique_state_count"])
+        self._trace.emit("job_done", job=job.id, state="done",
+                         unique=result["unique_state_count"])
+
+
+def write_result(job: Job, checker) -> dict:
+    """The durable result summary: property verdicts, counts, the
+    discoveries (encoded fingerprint paths), the metrics profile, and
+    a sha256 digest of the sorted reached fingerprint set — the
+    restart/parity tests' bit-identity hook."""
+    import hashlib
+    import json as _json
+
+    from .jobs import _atomic_write_json
+
+    model = checker.model()
+    fps = sorted(int(f) for f in checker.generated_fingerprints())
+    digest = hashlib.sha256(
+        "\n".join(map(str, fps)).encode()).hexdigest()
+    discs = checker.discoveries()
+    properties = []
+    for prop in model.properties():
+        found = discs.get(prop.name)
+        properties.append({
+            "expectation": prop.expectation.value,
+            "name": prop.name,
+            "discovery": (found.encode(model)
+                          if found is not None else None)})
+    profile = {k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in checker.profile().items()}
+    result = {
+        "job": job.id,
+        "model": job.spec.model_name,
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "properties": properties,
+        "profile": profile,
+        "fingerprint_count": len(fps),
+        "fingerprints_sha256": digest,
+    }
+    _json.dumps(result)  # fail here, not mid-atomic-write
+    _atomic_write_json(job.paths["result"], result)
+    return result

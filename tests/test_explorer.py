@@ -119,9 +119,11 @@ class TestStatusView:
 class TestHttpSmoke:
     def test_end_to_end(self):
         builder = TwoPhaseSys(2).checker()
-        checker, server = serve(builder, ("127.0.0.1", 0), block=False)
-        host, port = server.server_address
-        base = f"http://{host}:{port}"
+        # block=False returns a ServeHandle: legacy tuple-unpack still
+        # works, and .port/.shutdown() give a clean teardown
+        handle = serve(builder, ("127.0.0.1", 0), block=False)
+        checker, server = handle
+        base = f"http://127.0.0.1:{handle.port}"
         try:
             checker.join()
 
@@ -149,8 +151,22 @@ class TestHttpSmoke:
             except urllib.error.HTTPError as exc:
                 assert exc.code == 404
         finally:
-            server.shutdown()
-            server.server_close()
+            handle.shutdown()
+
+    def test_handle_shutdown_stops_checker_thread(self):
+        # the satellite fix: tests used to have no clean way to stop
+        # the server AND its background checking thread — shutdown()
+        # cancels the run and joins the engine thread
+        handle = serve(TwoPhaseSys(2).checker(), ("127.0.0.1", 0),
+                       block=False)
+        assert handle.port > 0
+        assert handle.url.endswith(str(handle.port))
+        handle.shutdown()
+        thread = getattr(handle.checker, "_thread", None)
+        assert thread is None or not thread.is_alive()
+        # the socket is really closed: a fresh connection fails
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"{handle.url}/.status", timeout=2)
 
 
 class TestTpuEngineExplorer:
@@ -162,10 +178,10 @@ class TestTpuEngineExplorer:
         pytest.importorskip("jax")
         builder = (TwoPhaseSys(3).checker()
                    .tpu_options(capacity=1 << 12))
-        checker, server = serve(builder, ("127.0.0.1", 0), block=False,
-                                engine="tpu")
-        host, port = server.server_address
-        base = f"http://{host}:{port}"
+        handle = serve(builder, ("127.0.0.1", 0), block=False,
+                       engine="tpu")
+        checker = handle.checker
+        base = handle.url
         try:
             # /.status responds mid-run too (counts may be partial)
             with urllib.request.urlopen(f"{base}/.status") as r:
@@ -185,8 +201,7 @@ class TestTpuEngineExplorer:
                 steps = json.loads(r.read())
             assert steps and "action" in steps[0]
         finally:
-            server.shutdown()
-            server.server_close()
+            handle.shutdown()
 
     def test_unknown_engine_rejected(self):
         import pytest

@@ -134,6 +134,9 @@ def parse_round(path: str) -> Dict[str, Any]:
                 ("spilled", bool(contract.get("spilled"))),
                 ("init_fallback", bool(contract.get("init_fallback"))),
                 ("cpu", contract.get("backend") == "cpu"),
+                # a --service-smoke round: the value is aggregate
+                # job-service throughput, not a device engine rate
+                ("service", bool(contract.get("service"))),
             ) if on)
         rnd["workloads"][CONTRACT] = {
             "name": contract.get("metric", "contract"),

@@ -1,0 +1,220 @@
+"""CLI for the checking-as-a-service job API (stateright_tpu/service).
+
+Server:
+    python tools/jobs.py serve --root DIR [--host H] [--port P]
+        [--devices N] [--cpu] [--cpu-devices N]
+        Runs the scheduler + HTTP API until interrupted. Prints ONE
+        ready line to stdout (``jobs-service listening on URL``) so
+        wrappers can scrape the ephemeral port. ``--cpu`` forces
+        JAX_PLATFORMS=cpu (with ``--cpu-devices N`` virtual devices)
+        BEFORE jax initializes — the no-hardware smoke path.
+
+Client (all take --url http://host:port):
+    python tools/jobs.py submit --url U --model NAME [--args 3,2]
+        [--width W] [--priority P] [--target N] [--options '{"k":v}']
+        [--step-delay S]                      -> prints the job id
+    python tools/jobs.py list --url U
+    python tools/jobs.py watch --url U JOB [--timeout S]
+        polls until the job is terminal or paused; prints transitions
+    python tools/jobs.py result --url U JOB  -> prints result.json
+    python tools/jobs.py pause|resume|cancel --url U JOB
+
+Models are the named registry in ``stateright_tpu/service/jobs.py``
+(twopc, paxos, single_copy, abd) — specs are plain JSON, so none of
+this pickles anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _arg(argv, flag, default=None):
+    if flag in argv:
+        return argv[argv.index(flag) + 1]
+    return default
+
+
+def _http(url: str, payload=None, timeout: float = 30.0):
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+        method="POST" if data is not None else "GET")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url: str, payload=None):
+    return _http(url, payload if payload is not None else {})
+
+
+def cmd_serve(argv) -> int:
+    if "--cpu" in argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        n = int(_arg(argv, "--cpu-devices", "2"))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n}"
+            ).strip()
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # a sitecustomize may have overridden the *config* (not just
+        # the env var); re-assert the requested platform
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"])
+
+    from stateright_tpu.service import JobStore, Scheduler, serve_jobs
+
+    root = _arg(argv, "--root")
+    if not root:
+        print("serve requires --root DIR", file=sys.stderr)
+        return 2
+    host = _arg(argv, "--host", "127.0.0.1")
+    port = int(_arg(argv, "--port", "0"))
+    devices = jax.devices()
+    limit = _arg(argv, "--devices")
+    if limit:
+        devices = devices[:int(limit)]
+    scheduler = Scheduler(JobStore(root), devices=devices)
+    handle = serve_jobs(scheduler, (host, port), block=False)
+    print(f"jobs-service listening on {handle.url} root={root} "
+          f"devices={len(devices)}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.shutdown()
+    return 0
+
+
+def _parse_args_list(raw):
+    if not raw:
+        return []
+    out = []
+    for tok in str(raw).split(","):
+        tok = tok.strip()
+        try:
+            out.append(int(tok))
+        except ValueError:
+            out.append(tok)
+    return out
+
+
+def cmd_submit(argv) -> int:
+    url = _arg(argv, "--url")
+    model = _arg(argv, "--model")
+    if not url or not model:
+        print("submit requires --url and --model", file=sys.stderr)
+        return 2
+    payload = {
+        "model": model,
+        "args": _parse_args_list(_arg(argv, "--args")),
+        "options": json.loads(_arg(argv, "--options", "{}")),
+        "priority": int(_arg(argv, "--priority", "0")),
+        "width": int(_arg(argv, "--width", "1")),
+        "step_delay": float(_arg(argv, "--step-delay", "0")),
+    }
+    target = _arg(argv, "--target")
+    if target:
+        payload["target"] = int(target)
+    out = _post(url.rstrip("/") + "/jobs", payload)
+    print(out["id"])
+    return 0
+
+
+def cmd_list(argv) -> int:
+    url = _arg(argv, "--url")
+    out = _http(url.rstrip("/") + "/jobs")
+    for job in out["jobs"]:
+        print(f"{job['id']:28} {job['state']:10} "
+              f"prio={job.get('priority', 0)} "
+              f"width={job.get('granted_width', job.get('width'))} "
+              f"model={job.get('model')}")
+    prof = out.get("profile") or {}
+    if prof:
+        print("# " + " ".join(f"{k}={v}" for k, v in sorted(
+            prof.items())))
+    return 0
+
+
+TERMINAL = ("done", "failed", "cancelled")
+
+
+def cmd_watch(argv) -> int:
+    url = _arg(argv, "--url").rstrip("/")
+    job_id = [a for a in argv if not a.startswith("--")
+              and a not in (url, _arg(argv, "--timeout") or "")][-1]
+    deadline = time.monotonic() + float(_arg(argv, "--timeout", "300"))
+    last = None
+    while time.monotonic() < deadline:
+        view = _http(f"{url}/jobs/{job_id}")
+        state = view.get("state")
+        if state != last:
+            print(f"{job_id}: {state}", flush=True)
+            last = state
+        if state in TERMINAL or state == "paused":
+            return 0 if state in ("done", "paused") else 1
+        time.sleep(0.2)
+    print(f"{job_id}: timeout (last state {last})", file=sys.stderr)
+    return 1
+
+
+def cmd_result(argv) -> int:
+    url = _arg(argv, "--url").rstrip("/")
+    job_id = [a for a in argv[1:] if not a.startswith("--")
+              and a != url][-1]
+    view = _http(f"{url}/jobs/{job_id}")
+    result = view.get("result")
+    if result is None:
+        print(json.dumps(view, indent=1, default=str))
+        return 1
+    print(json.dumps(result, indent=1, default=str))
+    return 0
+
+
+def _cmd_control(argv, action: str) -> int:
+    url = _arg(argv, "--url").rstrip("/")
+    job_id = [a for a in argv[1:] if not a.startswith("--")
+              and a != url][-1]
+    out = _post(f"{url}/jobs/{job_id}/{action}")
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+def main(argv) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd = argv[0]
+    if cmd == "serve":
+        return cmd_serve(argv)
+    if cmd == "submit":
+        return cmd_submit(argv)
+    if cmd == "list":
+        return cmd_list(argv)
+    if cmd == "watch":
+        return cmd_watch(argv)
+    if cmd == "result":
+        return cmd_result(argv)
+    if cmd in ("pause", "resume", "cancel"):
+        return _cmd_control(argv, cmd)
+    print(f"unknown command {cmd!r}; see --help", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main(sys.argv[1:]))
+    except BrokenPipeError:  # e.g. `jobs.py result ... | head`
+        raise SystemExit(0)

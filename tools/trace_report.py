@@ -2,6 +2,13 @@
 
 Usage:
     python tools/trace_report.py TRACE.jsonl [--validate]
+    python tools/trace_report.py --job JOB_DIR [--validate]
+
+``--job`` accepts a job directory (the service's per-job layout, or any
+``tpu_options(artifact_dir=...)`` run) and auto-locates its artifacts:
+``trace.jsonl``, the ``flight.jsonl`` postmortem dump, and — for a
+service ROOT directory — ``service.jsonl`` plus every job
+subdirectory's traces.
 
 Consumes the event stream written by ``tpu_options(trace="...")``
 (schema: ``stateright_tpu.obs.EVENT_SCHEMA``) and prints, per engine
@@ -151,8 +158,10 @@ def report(events, out=None):
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
                    "degrade", "fused_fallback", "recorder_dump",
-                   "spill", "evict",
-                   "crash", "restart", "partition")]
+                   "spill", "evict", "pause",
+                   "crash", "restart", "partition",
+                   "job_submit", "job_start", "job_pause",
+                   "job_resume", "job_done")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
@@ -222,6 +231,39 @@ def report(events, out=None):
             parts.append(f"history_ok={last.get('history_ok')}")
             out.write("\nsoak: " + " ".join(parts) + "\n")
 
+        # job-service summary (engine="service"): per-job lifecycle —
+        # when it was submitted/started, pauses (with reasons:
+        # user/preempt/shutdown), resumes, and how it ended
+        job_evs = [e for e in evs if e["ev"].startswith("job_")]
+        if job_evs:
+            per_job = {}
+            for ev in job_evs:
+                per_job.setdefault(ev.get("job", "?"), []).append(ev)
+            done = sum(1 for e in job_evs if e["ev"] == "job_done"
+                       and e.get("state") == "done")
+            failed = sum(1 for e in job_evs if e["ev"] == "job_done"
+                         and e.get("state") == "failed")
+            preempts = sum(1 for e in job_evs
+                           if e["ev"] == "job_pause"
+                           and e.get("reason") == "preempt")
+            out.write(f"\njobs: submitted={sum(1 for e in job_evs if e['ev'] == 'job_submit')} "
+                      f"done={done} failed={failed} "
+                      f"preemptions={preempts}\n")
+            for jid in sorted(per_job):
+                parts = []
+                for ev in per_job[jid]:
+                    kind = ev["ev"][4:]  # strip "job_"
+                    extra = ""
+                    if ev["ev"] == "job_start" \
+                            or ev["ev"] == "job_resume":
+                        extra = f"(w={ev.get('width')})"
+                    elif ev["ev"] == "job_pause":
+                        extra = f"({ev.get('reason')})"
+                    elif ev["ev"] == "job_done":
+                        extra = f"({ev.get('state')})"
+                    parts.append(f"{kind}{extra}@{ev['t']:.2f}")
+                out.write(f"  {jid}: " + " -> ".join(parts) + "\n")
+
         # fused-kernel summary: which path the run took, and why a
         # fused='auto' attempt fell back (the classified cause)
         fb = [e for e in evs if e["ev"] == "fused_fallback"]
@@ -246,12 +288,54 @@ def report(events, out=None):
         out.write("\n")
 
 
+def job_traces(directory):
+    """Locate a job directory's (or a service root's) trace artifacts
+    by the canonical layout (``stateright_tpu.obs.artifact_paths``)."""
+    found = []
+    for name in ("service.jsonl", "trace.jsonl", "flight.jsonl"):
+        path = os.path.join(directory, name)
+        if os.path.isfile(path):
+            found.append(path)
+    # a service ROOT: include every job subdirectory's traces
+    try:
+        entries = sorted(os.listdir(directory))
+    except OSError:
+        entries = []
+    for entry in entries:
+        sub = os.path.join(directory, entry)
+        if not os.path.isdir(sub):
+            continue
+        if not os.path.isfile(os.path.join(sub, "spec.json")):
+            continue
+        for name in ("trace.jsonl", "flight.jsonl"):
+            path = os.path.join(sub, name)
+            if os.path.isfile(path):
+                found.append(path)
+    return found
+
+
 def main(argv):
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0
     validate = "--validate" in argv
     paths = [a for a in argv if not a.startswith("--")]
+    if "--job" in argv:
+        job_dirs = [paths.pop(paths.index(a))
+                    for a in list(paths) if os.path.isdir(a)]
+        if not job_dirs:
+            print("--job requires a job directory", file=sys.stderr)
+            return 2
+        for d in job_dirs:
+            located = job_traces(d)
+            if not located:
+                print(f"{d}: no trace artifacts found "
+                      "(expected trace.jsonl / flight.jsonl / "
+                      "service.jsonl)", file=sys.stderr)
+                return 2
+            print(f"# {d}: {len(located)} artifact(s)",
+                  file=sys.stderr)
+            paths.extend(located)
     for path in paths:
         events = load_events(path)
         if validate:
