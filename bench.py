@@ -58,7 +58,12 @@ under the same crash-proof contract — no device required;
 ``--service-smoke`` runs the job service (stateright_tpu/service) with
 two concurrent CPU jobs on disjoint device subsets and lands a
 ``"service": true`` contract line with per-job uniq/s — no device
-required either.
+required either; ``--job-storm`` floods the service with dozens of
+tiny randomized specs, unbatched then batched through the lane engine
+(service/batch.py), and lands a ``"storm": true`` contract line with
+``jobs_per_min`` for both modes, the speedup, and distinct-compile
+counts (the trend line tools/bench_history.py tracks for ROADMAP's
+>=50 small-job completions/min target).
 """
 
 from __future__ import annotations
@@ -390,12 +395,165 @@ def _service_smoke() -> None:
         print(json.dumps(contract))
 
 
+def _storm_specs(n: int, seed: int, models: str):
+    """The randomized tiny-spec generator both storm modes share:
+    per-user shape drift (randomized fmax, small capacities) that
+    fragments the solo compile cache but collapses into >=1 bucket per
+    model config under the normalizer (capacity pads to the 4096
+    floor, fmax 65..128 pads to the 128 bucket)."""
+    import random
+
+    from stateright_tpu.service import JobSpec
+
+    rng = random.Random(seed)
+    configs = []
+    for tok in models.split(","):
+        name, _, arg = tok.strip().partition(":")
+        configs.append((name, [int(a) for a in arg.split("+")]
+                        if arg else []))
+    specs = []
+    for _i in range(n):
+        name, args = configs[_i % len(configs)]
+        specs.append(dict(
+            model=name, args=args,
+            options={"capacity": rng.choice((1 << 11, 1 << 12)),
+                     "fmax": rng.randrange(65, 129)}))
+    return specs
+
+
+def _job_storm() -> None:
+    """``--job-storm``: dozens of tiny randomized specs through the job
+    service on ONE CPU device, unbatched (every job a solo engine run
+    paying its own randomized-shape compile) then batched
+    (``JobSpec(batch='auto')`` — the normalizer buckets the shapes and
+    the lane engine checks up to L jobs per kernel launch). The
+    contract line lands ``jobs_per_min`` for both modes, the speedup,
+    and the distinct-compile counts — ``tools/bench_history.py``
+    tracks ``jobs_per_min`` as its own trend line. Crash-proof like
+    every bench mode: emitted from a ``finally`` path, rc=0 always.
+
+    Flags: ``--storm-jobs N`` (default 24), ``--storm-lanes L``
+    (default 8), ``--storm-seed S``, ``--storm-models
+    name[:a+b][,name2...]`` (default ``twopc:2,twopc:3``). The run
+    uses a FRESH persistent-compile-cache dir so the unbatched
+    baseline honestly pays the per-shape compiles a cold service
+    would (a warm cache would flatter neither mode equally)."""
+    import os
+    import tempfile
+    import time as _time
+
+    n_jobs = int(_arg_after("--storm-jobs", 24))
+    lanes = int(_arg_after("--storm-lanes", 8))
+    seed = int(_arg_after("--storm-seed", 11))
+    models = _arg_after("--storm-models", "twopc:2,twopc:3")
+    contract = {
+        "metric": "job-storm small-job throughput "
+                  "(batched lanes vs unbatched solo runs)",
+        "value": None,
+        "unit": "jobs/min",
+        "service": True,
+        "storm": True,
+        "jobs": n_jobs,
+        "lanes": lanes,
+        "jobs_per_min": {"batched": None, "unbatched": None},
+        "speedup": None,
+        "compiles": {"batched": None, "unbatched": None},
+    }
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # a fresh persistent-cache dir: the unbatched baseline must
+        # pay the compiles a cold multi-tenant service pays
+        os.environ["STATERIGHT_TPU_CACHE"] = tempfile.mkdtemp(
+            prefix="stateright_storm_cache_")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from stateright_tpu.service import (JobSpec, JobStore,
+                                            Scheduler)
+
+        specs = _storm_specs(n_jobs, seed, models)
+
+        def run_mode(batched: bool) -> dict:
+            root = tempfile.mkdtemp(prefix="stateright_storm_")
+            sched = Scheduler(JobStore(root),
+                              devices=jax.devices()[:1],
+                              batch_lanes=lanes, batch_wait=0.3)
+            t0 = _time.perf_counter()
+            jobs = [sched.submit(JobSpec(
+                s["model"], args=s["args"], options=dict(s["options"]),
+                batch="auto" if batched else False)) for s in specs]
+            done = failed = 0
+            compiles = 0
+            for job in jobs:
+                state = sched.wait(job.id, timeout=600.0)
+                if state == "done":
+                    done += 1
+                    if not batched:
+                        result = job.read_result() or {}
+                        compiles += int((result.get("profile") or {})
+                                        .get("compiles", 0))
+                else:
+                    failed += 1
+                    FAILED.append(
+                        f"storm-{'b' if batched else 'u'}-{job.id}")
+            wall = _time.perf_counter() - t0
+            prof = sched.profile()
+            sched.shutdown()
+            row = {
+                "mode": "batched" if batched else "unbatched",
+                "done": done, "failed": failed,
+                "wall_s": round(wall, 3),
+                "jobs_per_min": round(done / wall * 60.0, 1),
+                "compiles": (int(prof.get("compiles", 0)) if batched
+                             else compiles),
+                "batched_jobs": int(prof.get("batched_jobs", 0)),
+                "bucket_hits": int(prof.get("bucket_hits", 0)),
+                "compile_reuse": int(prof.get("compile_reuse", 0)),
+            }
+            print(json.dumps({"workload": f"job-storm "
+                              f"{row['mode']}", **row}),
+                  file=sys.stderr)
+            return row
+
+        un = run_mode(batched=False)
+        ba = run_mode(batched=True)
+        contract["jobs_per_min"] = {"batched": ba["jobs_per_min"],
+                                    "unbatched": un["jobs_per_min"]}
+        contract["value"] = ba["jobs_per_min"]
+        contract["compiles"] = {"batched": ba["compiles"],
+                                "unbatched": un["compiles"]}
+        contract["batched_jobs"] = ba["batched_jobs"]
+        contract["bucket_hits"] = ba["bucket_hits"]
+        contract["compile_reuse"] = ba["compile_reuse"]
+        if un["jobs_per_min"]:
+            contract["speedup"] = round(
+                ba["jobs_per_min"] / un["jobs_per_min"], 2)
+    except BaseException as exc:
+        print(json.dumps({"workload": "job-storm", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("job-storm")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
+def _arg_after(flag: str, default):
+    if flag in sys.argv:
+        return sys.argv[sys.argv.index(flag) + 1]
+    return default
+
+
 def main() -> None:
     global N, SMOKE, INJECT_FAULT
     SMOKE = "--smoke" in sys.argv
     INJECT_FAULT = "--inject-fault" in sys.argv
     if "--soak-smoke" in sys.argv:
         _soak_smoke()
+        return
+    if "--job-storm" in sys.argv:
+        _job_storm()
         return
     if "--service-smoke" in sys.argv:
         _service_smoke()

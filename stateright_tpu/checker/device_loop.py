@@ -266,6 +266,24 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                     n_init: int = 0, kraw: int = 0, hint_eff: int = 0,
                     ecap: int = 0, fused: bool = False,
                     fused_interpret: bool = False):
+    return jax.jit(
+        build_chunk_core(model, qcap, capacity, fmax, kmax, symmetry,
+                         sound, hcap, n_init, kraw, hint_eff, ecap,
+                         fused, fused_interpret),
+        donate_argnums=(0,))
+
+
+def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
+                     kmax: int, symmetry: bool, sound: bool = False,
+                     hcap: int = 0, n_init: int = 0, kraw: int = 0,
+                     hint_eff: int = 0, ecap: int = 0,
+                     fused: bool = False, fused_interpret: bool = False):
+    """The UN-jitted chunk program: ``chunk(carry, target_remaining,
+    grow_limit, h_base) -> (carry, stats)``. ``build_chunk_fn`` wraps
+    it in the solo engines' donating ``jax.jit``; the batch engine
+    (``checker/batch_loop.py``) instead maps it over a LANE axis with
+    ``jax.vmap`` — one compiled program advancing many small same-shape
+    jobs, each lane carrying its own queue/table/log slices."""
     if fused:
         # support matrix (ops/fused.py supports()): the engines route
         # sound / host-property / hint configs to the staged build
@@ -779,7 +797,7 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
         win = jnp.concatenate([rows, out.log[li][:, 0:2]], axis=1)
         return out, jnp.concatenate([stats, win.reshape(-1)])
 
-    return jax.jit(chunk, donate_argnums=(0,))
+    return chunk
 
 
 #: representatives returned inline with each chunk's sync; beyond this the

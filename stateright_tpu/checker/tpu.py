@@ -70,6 +70,20 @@ def _next_pow2(n: int) -> int:
     return 1 << max((n - 1).bit_length(), 0)
 
 
+def model_tag(model) -> str:
+    """Checkpoint identity check, shared by the solo engines and the
+    batch loop's per-lane pause checkpoints: a checkpoint only makes
+    sense for the same model config (same packed layout, same
+    transitions) AND the same fingerprint algorithm — resuming
+    old-scheme fingerprints would silently fail to dedup against newly
+    computed ones."""
+    from ..fingerprint import FP_VERSION
+
+    return (f"{type(model).__module__}.{type(model).__qualname__}"
+            f"|{model.cache_key()!r}|w={model.packed_width}"
+            f"|fpv={FP_VERSION}")
+
+
 def _bucket(n: int) -> int:
     return max(_MIN_BUCKET, _next_pow2(n))
 
@@ -2681,16 +2695,7 @@ class TpuChecker(HostChecker):
                               self._discovery_fps)
 
     def _model_tag(self) -> str:
-        """Identity check for resume: a checkpoint only makes sense for
-        the same model config (same packed layout, same transitions) AND
-        the same fingerprint algorithm — resuming old-scheme fingerprints
-        would silently fail to dedup against newly computed ones."""
-        from ..fingerprint import FP_VERSION
-
-        model = self._model
-        return (f"{type(model).__module__}.{type(model).__qualname__}"
-                f"|{model.cache_key()!r}|w={model.packed_width}"
-                f"|fpv={FP_VERSION}")
+        return model_tag(self._model)
 
     def _load_checkpoint(self, discoveries: Dict[str, int]):
         """Seed state from a ``save()`` file: the mirror (and its

@@ -206,6 +206,22 @@ GLOSSARY: Dict[str, str] = {
                    "checkpoint, typically on a smaller subset)",
     "queue_depth": "jobs currently waiting for a device subset "
                    "(gauge; sampled after every scheduling pass)",
+    # --- batch lane engine (service/batch.py + checker/batch_loop.py) --
+    "batched_jobs": "jobs completed as lanes of a vmapped batch chunk "
+                    "program (vs solo engine runs) — the "
+                    "compile-amortized small-job path",
+    "lanes": "lane width of the batch programs (gauge; the vmapped "
+             "leading axis — up to this many jobs advance per kernel "
+             "launch)",
+    "bucket_hits": "submissions whose NORMALIZED compile bucket "
+                   "(model config × padded capacity/fmax) matched a "
+                   "bucket already seen this process — the spec "
+                   "normalizer turning per-user shape drift into "
+                   "compile-cache hits",
+    "compile_reuse": "batched lane-jobs that ran WITHOUT paying a "
+                     "chunk-program build (every lane after the first "
+                     "of a fresh build, and every lane of a "
+                     "cache-hit batch)",
 }
 
 #: keys that are point-in-time GAUGES, not accumulating counters:
@@ -214,7 +230,7 @@ GLOSSARY: Dict[str, str] = {
 #: values (``fused=2``, a ``mesh_shards`` no mesh ever had).
 GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
-    "shard_balance", "host_tier_keys", "queue_depth",
+    "shard_balance", "host_tier_keys", "queue_depth", "lanes",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

@@ -128,6 +128,17 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "job_pause": frozenset({"job", "reason"}),
     "job_resume": frozenset({"job", "width"}),
     "job_done": frozenset({"job", "state"}),
+    # the batch lane engine (service/batch.py + checker/batch_loop.py):
+    # `bucket_flush` — a bucket queue launched as a batch (reason:
+    # "full" | "max_wait"); `batch_form` — the batch's initial lane
+    # fill (jobs seeded, lane width); `lane_retire` — one lane's job
+    # left the batch (reason: "done" | "pause" | "cancel" | an
+    # abnormal cause like "grow"/"kovf" that falls the job back to the
+    # solo engine); optional fields (unique counts, the batch id on
+    # job_* events) ride along
+    "bucket_flush": frozenset({"bucket", "jobs", "reason"}),
+    "batch_form": frozenset({"batch", "bucket", "jobs", "lanes"}),
+    "lane_retire": frozenset({"batch", "job", "lane", "reason"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

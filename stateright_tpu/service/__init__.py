@@ -26,13 +26,16 @@ README.md § Checking as a service documents the API and artifact
 layout.
 """
 
+from .batch import (BatchRun, LaneView, bucket_label, normalize_shapes,
+                    plan_batch)
 from .driver import DONE, FAILED, PAUSED, RUNNING, StepDriver
 from .jobs import (JOB_STATES, MODEL_REGISTRY, Job, JobSpec, JobStore,
-                   build_model, register_model)
+                   build_model, known_models, register_model)
 from .scheduler import DeviceLease, DevicePool, Scheduler
 from .api import ServiceHandle, serve_jobs
 
 __all__ = [
+    "BatchRun",
     "DONE",
     "DeviceLease",
     "DevicePool",
@@ -41,13 +44,18 @@ __all__ = [
     "Job",
     "JobSpec",
     "JobStore",
+    "LaneView",
     "MODEL_REGISTRY",
     "PAUSED",
     "RUNNING",
     "Scheduler",
     "ServiceHandle",
     "StepDriver",
+    "bucket_label",
     "build_model",
+    "known_models",
+    "normalize_shapes",
+    "plan_batch",
     "register_model",
     "serve_jobs",
 ]

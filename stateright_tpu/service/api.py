@@ -5,7 +5,11 @@ Endpoints (JSON unless noted):
     POST /jobs                  submit a job spec -> {"id": ...}
                                 body: {"model", "args", "kwargs",
                                 "options", "priority", "width",
-                                "target", "step_delay"}
+                                "target", "step_delay", "batch"}
+                                ("batch": "auto" opts into the batch
+                                lane engine — README § Batched small
+                                jobs; batched job views carry the
+                                "batch" id and "lane" index)
     GET  /jobs                  -> {"jobs": [view...], "profile": {...}}
     GET  /jobs/<id>             -> job view (+ "result" when terminal)
     POST /jobs/<id>/cancel      -> {"ok": bool}
@@ -175,7 +179,8 @@ def _make_handler(scheduler: Scheduler):
                         priority=payload.get("priority", 0),
                         width=payload.get("width", 1),
                         target=payload.get("target"),
-                        step_delay=payload.get("step_delay", 0.0))
+                        step_delay=payload.get("step_delay", 0.0),
+                        batch=payload.get("batch", False))
                     job = scheduler.submit(spec)
                     self._send_json(201, {"id": job.id,
                                           "state": job.state})

@@ -55,37 +55,57 @@ _RESERVED_OPTIONS = ("artifact_dir", "autosave", "flight_path", "trace",
                      "mesh", "race")
 
 
-def _registry() -> Dict[str, Callable]:
-    """Named example models (lazy imports keep ``import
-    stateright_tpu.service`` light): every entry is a packed model
-    factory a subprocess can name in a JSON spec."""
+#: THE model registry: built-in example models (lazily populated on
+#: first use, so ``import stateright_tpu.service`` stays light) plus
+#: anything registered at runtime through :func:`register_model` — one
+#: dict, one lookup path. The previous split (a runtime dict merged
+#: against a fresh built-ins dict on every miss) rebuilt the built-in
+#: table per lookup and let a runtime name silently shadow-or-not
+#: depending on which dict was consulted first.
+MODEL_REGISTRY: Dict[str, Callable] = {}
+
+_BUILTINS_LOADED = False
+
+
+def _ensure_builtins() -> None:
+    """Populate the built-in factories once. ``setdefault`` keeps any
+    earlier runtime :func:`register_model` of the same name
+    authoritative — registration order is the single precedence
+    rule."""
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return
+    from ..examples.abd_packed import PackedAbd
     from ..examples.paxos_packed import PackedPaxos
     from ..examples.single_copy_packed import PackedSingleCopy
-    from ..examples.abd_packed import PackedAbd
     from ..models.twopc import TwoPhaseSys
-    return {
-        "twopc": TwoPhaseSys,
-        "paxos": PackedPaxos,
-        "single_copy": PackedSingleCopy,
-        "abd": PackedAbd,
-    }
-
-
-#: extra factories registered at runtime (tests, embedders)
-MODEL_REGISTRY: Dict[str, Callable] = {}
+    for name, factory in (
+            ("twopc", TwoPhaseSys),
+            ("paxos", PackedPaxos),
+            ("single_copy", PackedSingleCopy),
+            ("abd", PackedAbd)):
+        MODEL_REGISTRY.setdefault(name, factory)
+    _BUILTINS_LOADED = True
 
 
 def register_model(name: str, factory: Callable) -> None:
-    """Register a model factory under ``name`` for job specs."""
+    """Register a model factory under ``name`` for job specs (the one
+    registration path — built-ins land here too)."""
     MODEL_REGISTRY[name] = factory
 
 
+def known_models() -> list:
+    """Deterministic (sorted) list of every registered model name."""
+    _ensure_builtins()
+    return sorted(MODEL_REGISTRY)
+
+
 def build_model(name: str, args, kwargs):
-    factory = MODEL_REGISTRY.get(name) or _registry().get(name)
+    _ensure_builtins()
+    factory = MODEL_REGISTRY.get(name)
     if factory is None:
-        known = sorted(set(MODEL_REGISTRY) | set(_registry()))
         raise ValueError(
-            f"unknown model {name!r}; known models: {known} "
+            f"unknown model {name!r}; known models: {known_models()} "
             "(register_model(name, factory) adds more)")
     return factory(*(args or ()), **(kwargs or {}))
 
@@ -122,7 +142,7 @@ class JobSpec:
     def __init__(self, model: Any, args=(), kwargs=None, options=None,
                  priority: int = 0, width: int = 1,
                  target: Optional[int] = None,
-                 step_delay: float = 0.0):
+                 step_delay: float = 0.0, batch=False):
         if callable(model):
             self.model_name = getattr(model, "__name__", "<callable>")
             self.factory: Optional[Callable] = model
@@ -142,6 +162,15 @@ class JobSpec:
         self.width = width
         self.target = None if target is None else int(target)
         self.step_delay = float(step_delay)
+        # batch lane engine opt-in (service/batch.py): 'auto' lets the
+        # scheduler coalesce this job with same-bucket small jobs into
+        # one vmapped chunk program (ineligible specs quietly run
+        # solo); False (the default) always runs solo
+        if batch not in (False, "auto"):
+            raise ValueError(
+                f"JobSpec batch must be False or 'auto', got "
+                f"{batch!r}")
+        self.batch = batch
 
     @property
     def durable(self) -> bool:
@@ -158,10 +187,11 @@ class JobSpec:
                 "kwargs": self.kwargs, "options": self.options,
                 "priority": self.priority, "width": self.width,
                 "target": self.target, "step_delay": self.step_delay,
-                "durable": self.durable}
+                "batch": self.batch, "durable": self.durable}
 
     @classmethod
     def from_json(cls, payload: dict) -> "JobSpec":
+        batch = payload.get("batch", False)
         return cls(model=payload["model"],
                    args=payload.get("args") or (),
                    kwargs=payload.get("kwargs") or {},
@@ -169,7 +199,8 @@ class JobSpec:
                    priority=payload.get("priority", 0),
                    width=payload.get("width", 1),
                    target=payload.get("target"),
-                   step_delay=payload.get("step_delay", 0.0))
+                   step_delay=payload.get("step_delay", 0.0),
+                   batch="auto" if batch == "auto" else False)
 
 
 class Job:
@@ -224,7 +255,10 @@ class Job:
                "priority": self.spec.priority,
                "width": self.spec.width,
                "durable": self.spec.durable}
+        if self.spec.batch:
+            out["batch_requested"] = self.spec.batch
         for key in ("seq", "granted_width", "resume", "preempted",
+                    "batch", "lane", "batch_fallback",
                     "error", "queued_at", "running_at", "paused_at",
                     "done_at", "failed_at", "cancelled_at"):
             if key in self.status:

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from ..obs import Metrics, make_trace
@@ -136,11 +137,40 @@ class _JobRuntime:
             return ctl
 
 
+class _BatchRuntime:
+    """Scheduler-side handle on one RUNNING batch: the device lease,
+    the worker thread, the live :class:`~stateright_tpu.service.batch.
+    BatchRun`, and a multi-slot control channel (per-job pause/cancel
+    plus shutdown)."""
+
+    __slots__ = ("lease", "thread", "run", "_controls", "_ctl_lock")
+
+    def __init__(self, lease: DeviceLease):
+        self.lease = lease
+        self.thread: Optional[threading.Thread] = None
+        self.run = None
+        self._controls: List[tuple] = []
+        self._ctl_lock = threading.Lock()
+
+    def set_control(self, ctl: str, job_id: Optional[str] = None) \
+            -> None:
+        with self._ctl_lock:
+            self._controls.append((ctl, job_id))
+
+    def take_controls(self) -> List[tuple]:
+        with self._ctl_lock:
+            ctls, self._controls = self._controls, []
+            return ctls
+
+
 class Scheduler:
     """Multi-tenant job scheduler over the device mesh."""
 
     def __init__(self, store, devices=None, step_budget: int = 4,
-                 trace=None, recover: bool = True):
+                 trace=None, recover: bool = True,
+                 batch_lanes: Optional[int] = None,
+                 batch_wait: Optional[float] = None):
+        from .batch import DEFAULT_LANES, DEFAULT_MAX_WAIT
         self._store = store if isinstance(store, JobStore) \
             else JobStore(store)
         self._lock = threading.RLock()
@@ -153,6 +183,22 @@ class Scheduler:
             engine="service")
         self._devices = None if devices is None else list(devices)
         self._pool: Optional[DevicePool] = None
+        # --- batch lane engine (service/batch.py): same-bucket small
+        # jobs coalesce in per-bucket queues and run as lanes of ONE
+        # vmapped chunk program on a width-1 allocation
+        self._batch_lanes = int(batch_lanes if batch_lanes is not None
+                                else DEFAULT_LANES)
+        self._batch_wait = float(batch_wait if batch_wait is not None
+                                 else DEFAULT_MAX_WAIT)
+        #: bucket key -> {"jobs": deque[Job], "label", "model",
+        #: "capacity", "fmax", "since"}
+        self._buckets: Dict[tuple, dict] = {}
+        self._batch_running: Dict[tuple, _BatchRuntime] = {}
+        self._job_batch: Dict[str, tuple] = {}
+        self._batch_reason: Dict[str, str] = {}
+        self._bucket_keys_seen: set = set()
+        self._batch_seq = 0
+        self._flush_timer: Optional[threading.Timer] = None
         if recover:
             self._recover()
             # boot placement pass: recovered RUNNING jobs (and any
@@ -177,10 +223,20 @@ class Scheduler:
 
     def checker_for(self, job_id: str):
         """The live checker of a RUNNING job (None otherwise) — the
-        HTTP API's hook for per-job SSE/metrics."""
+        HTTP API's hook for per-job SSE/metrics. A batched job returns
+        its :class:`~stateright_tpu.service.batch.LaneView`, which
+        speaks the same surface (``_trace`` for SSE, ``profile`` /
+        counts for metrics)."""
         with self._lock:
             rt = self._running.get(job_id)
-            return rt.checker if rt is not None else None
+            if rt is not None:
+                return rt.checker
+            key = self._job_batch.get(job_id)
+            if key is not None:
+                brt = self._batch_running.get(key)
+                if brt is not None and brt.run is not None:
+                    return brt.run.view_for(job_id)
+        return None
 
     def pool_width(self) -> int:
         self._ensure_pool()
@@ -209,7 +265,12 @@ class Scheduler:
             if rt is not None:
                 rt.set_control("pause")
                 return True
+            brt = self._batch_rt_for(job_id)
+            if brt is not None:
+                brt.set_control("pause", job_id)
+                return True
             if job.state == jobstates.QUEUED:
+                self._drop_from_bucket(job_id)
                 job.set_state(jobstates.PAUSED,
                               resume=job.has_checkpoint())
                 self._trace.emit("job_pause", job=job.id, reason="user")
@@ -235,6 +296,11 @@ class Scheduler:
             if rt is not None:
                 rt.set_control("cancel")
                 return True
+            brt = self._batch_rt_for(job_id)
+            if brt is not None:
+                brt.set_control("cancel", job_id)
+                return True
+            self._drop_from_bucket(job_id)
         job.set_state(jobstates.CANCELLED)
         self._trace.emit("job_done", job=job.id, state="cancelled")
         self._schedule()
@@ -254,15 +320,23 @@ class Scheduler:
 
     def shutdown(self, wait: bool = True, timeout: float = 60.0) -> None:
         """Stop placing work and pause every RUNNING job (each lands
-        its checkpoint and re-enqueues, so the next boot resumes it)."""
+        its checkpoint and re-enqueues, so the next boot resumes it).
+        Batched lanes checkpoint per lane; bucket-queued jobs simply
+        stay QUEUED for the next boot."""
         with self._lock:
             self._closed = True
             rts = list(self._running.values())
+            brts = list(self._batch_running.values())
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
         for rt in rts:
             rt.set_control("shutdown")
+        for brt in brts:
+            brt.set_control("shutdown")
         if wait:
             deadline = time.monotonic() + timeout
-            for rt in rts:
+            for rt in rts + brts:
                 t = rt.thread
                 if t is not None:
                     t.join(max(0.0, deadline - time.monotonic()))
@@ -299,11 +373,186 @@ class Scheduler:
                 self._devices = list(jax.devices())
             self._pool = DevicePool(self._devices)
 
+    # --- batch lane engine plumbing (service/batch.py) -----------------
+    def _batch_rt_for(self, job_id: str) -> Optional[_BatchRuntime]:
+        """The RUNNING batch currently holding ``job_id`` as a lane
+        (None when the job is not a live batched lane). Caller holds
+        the lock."""
+        key = self._job_batch.get(job_id)
+        if key is None:
+            return None
+        brt = self._batch_running.get(key)
+        if brt is None or brt.run is None:
+            return None
+        if brt.run.view_for(job_id) is None:
+            return None
+        return brt
+
+    def _drop_from_bucket(self, job_id: str) -> None:
+        """Remove a still-queued job from its bucket queue (pause and
+        cancel of not-yet-seeded batched jobs). Caller holds the
+        lock."""
+        key = self._job_batch.pop(job_id, None)
+        bucket = self._buckets.get(key) if key is not None else None
+        if bucket is not None:
+            bucket["jobs"] = deque(
+                j for j in bucket["jobs"] if j.id != job_id)
+
+    def _pop_bucket_job(self, key: tuple) -> Optional[Job]:
+        """The running batch's backfill feed: the next queued job of
+        the bucket, or None when the queue is dry."""
+        with self._lock:
+            bucket = self._buckets.get(key)
+            if bucket and bucket["jobs"]:
+                return bucket["jobs"].popleft()
+            return None
+
+    def _route_to_bucket(self, job: Job) -> bool:
+        """Decide (once per job) whether ``job`` coalesces into a
+        bucket queue instead of taking a solo placement. Caller holds
+        the lock."""
+        from .batch import plan_batch
+        solo_bound = (not job.spec.batch
+                      or job.status.get("batch_fallback")
+                      or (job.status.get("resume")
+                          and job.has_checkpoint()))
+        if job.id in self._job_batch:
+            if solo_bound:
+                # the job LEFT the batch lifecycle (abnormal-lane
+                # fallback, or a paused lane resuming from its
+                # checkpoint): un-map it so solo placement takes it
+                self._job_batch.pop(job.id, None)
+                return False
+            return True  # already bucketed (waiting or running)
+        if job.id in self._batch_reason:
+            return False
+        if solo_bound:
+            # fallback and resumed jobs take the solo engine (growth /
+            # checkpoint machinery lives there)
+            if job.spec.batch:
+                self._batch_reason[job.id] = "fallback-or-resume"
+            return False
+        reason, model, key, label = plan_batch(job.spec)
+        if reason is not None:
+            self._batch_reason[job.id] = reason
+            return False
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            from .batch import normalize_shapes
+            capacity, fmax = normalize_shapes(job.spec.options)
+            # chunk_steps is DATA (not part of the compile key), so
+            # the bucket simply adopts the first job's value
+            bucket = {"jobs": deque(), "label": label, "model": model,
+                      "capacity": capacity, "fmax": fmax,
+                      "chunk_steps": int(job.spec.options.get(
+                          "chunk_steps", 32)),
+                      "since": time.monotonic()}
+            self._buckets[key] = bucket
+        elif not bucket["jobs"]:
+            bucket["since"] = time.monotonic()
+        if key in self._bucket_keys_seen:
+            # a later user landed in an already-seen compile bucket —
+            # the normalizer doing its job across submissions
+            self._metrics.inc("bucket_hits")
+        else:
+            self._bucket_keys_seen.add(key)
+        bucket["jobs"].append(job)
+        self._job_batch[job.id] = key
+        return True
+
+    def _flush_buckets(self) -> None:
+        """Start a batch for every bucket that is FULL (>= lanes jobs)
+        or has waited past the max-wait window; arm the flush timer
+        for the rest. Caller holds the lock."""
+        now = time.monotonic()
+        next_due = None
+        for key, bucket in self._buckets.items():
+            if not bucket["jobs"] or key in self._batch_running:
+                continue
+            waited = now - bucket["since"]
+            full = len(bucket["jobs"]) >= self._batch_lanes
+            if full or waited >= self._batch_wait:
+                self._start_batch(key, bucket,
+                                  reason="full" if full else "max_wait")
+            else:
+                due = self._batch_wait - waited
+                next_due = due if next_due is None \
+                    else min(next_due, due)
+        if next_due is not None and self._flush_timer is None:
+            timer = threading.Timer(next_due + 0.01, self._flush_tick)
+            timer.daemon = True
+            self._flush_timer = timer
+            timer.start()
+
+    def _flush_tick(self) -> None:
+        with self._lock:
+            self._flush_timer = None
+        self._schedule()
+
+    def _start_batch(self, key: tuple, bucket: dict,
+                     reason: str) -> None:
+        """Place one batch as a width-1 pool allocation and launch its
+        worker. Caller holds the lock; no-op (retried on the next
+        pass) when the pool is saturated."""
+        from .batch import BatchRun
+        lease = self._pool.acquire(1)
+        if lease is None:
+            return
+        self._batch_seq += 1
+        batch_id = f"b{self._batch_seq:03d}"
+        brt = _BatchRuntime(lease)
+        run = BatchRun(batch_id, key, bucket["label"], bucket["model"],
+                       self._batch_lanes, bucket["capacity"],
+                       bucket["fmax"], self, brt,
+                       chunk_steps=bucket["chunk_steps"])
+        brt.run = run
+        self._batch_running[key] = brt
+        self._trace.emit("bucket_flush", bucket=bucket["label"],
+                         jobs=len(bucket["jobs"]), reason=reason,
+                         batch=batch_id)
+        thread = threading.Thread(
+            target=self._run_batch, args=(key, brt),
+            name=f"stateright-batch-{batch_id}", daemon=True)
+        brt.thread = thread
+        thread.start()
+
+    def _run_batch(self, key: tuple, brt: _BatchRuntime) -> None:
+        run = brt.run
+        try:
+            import contextlib
+
+            import jax
+            lease = brt.lease
+            ctx = (jax.default_device(lease.devices[0])
+                   if lease.width == 1 else contextlib.nullcontext())
+            with ctx:
+                run.run()
+        except BaseException as exc:
+            # the batch engine died: fail its live lanes loudly (their
+            # artifacts hold whatever landed) — queued bucket jobs are
+            # untouched and re-batch on the next pass
+            for lane, job in list(run._jobs.items()):
+                self._metrics.inc("jobs_failed")
+                job.set_state(jobstates.FAILED,
+                              error=f"{type(exc).__name__}: {exc}")
+                self._trace.emit("job_done", job=job.id,
+                                 state="failed", batch=run.id,
+                                 error=f"{type(exc).__name__}: {exc}")
+        finally:
+            run.close()
+            with self._lock:
+                self._batch_running.pop(key, None)
+                self._pool.release(brt.lease)
+            self._schedule()
+
     def _schedule(self) -> None:
         """One placement pass (called on submit / resume / job exit):
-        grant queued jobs the largest free power-of-two subset ≤ their
-        request, highest priority first; when nothing is free, preempt
-        the lowest-priority running job that the queue head outranks."""
+        route batch-eligible small jobs into bucket queues (flushed as
+        lane batches when full or past max-wait), then grant the
+        remaining queued jobs the largest free power-of-two subset ≤
+        their request, highest priority first; when nothing is free,
+        preempt the lowest-priority running job that the queue head
+        outranks."""
         with self._lock:
             if self._closed:
                 return
@@ -312,6 +561,9 @@ class Scheduler:
                       if j.state == jobstates.QUEUED
                       and j.id not in self._running]
             queued.sort(key=lambda j: (-j.priority, j.seq))
+            queued = [j for j in queued
+                      if not self._route_to_bucket(j)]
+            self._flush_buckets()
             for job in queued:
                 want = min(job.spec.width, self._pool.width)
                 lease = None
@@ -399,6 +651,10 @@ class Scheduler:
                 and job.has_checkpoint()
             if resumed:
                 builder.resume_from(job.paths["autosave"])
+            # a job that previously ran as a batch lane (fallback or
+            # checkpoint resume) must not advertise a stale lane
+            job.status.pop("batch", None)
+            job.status.pop("lane", None)
             checker = builder.spawn_tpu()
             rt.checker = checker
             driver = StepDriver(checker).start()

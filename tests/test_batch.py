@@ -1,0 +1,399 @@
+"""Batch lane engine (service/batch.py + checker/batch_loop.py).
+
+The load-bearing guarantees, all pinned on CPU:
+
+* **normalizer soundness** — padding a spec's shape knobs up to its
+  power-of-two bucket (capacity/fmax) NEVER changes the model's
+  reachable fingerprint set (dedup is set-semantics; shapes only move
+  batching granularity);
+* **per-lane digest parity** — every batched job's sha256
+  fingerprint digest is bit-identical to a solo run of the same job,
+  across lane positions AND for jobs backfilled into retired lanes
+  mid-flight;
+* **graceful degradation** — ineligible specs and lanes that outgrow
+  the bucket transparently run/re-run on the solo engine, same
+  digest;
+* **pause/resume** — pausing a batched lane lands a standard
+  ``resume_from``-loadable checkpoint; the resumed (solo) run
+  restores per-lane parity;
+* **throughput** — ``bench.py --job-storm`` (subprocess): >=24 tiny
+  same-bucket jobs complete with <=2 distinct compiles (vs >=24
+  unbatched) and batched ``jobs_per_min`` >= 3x the unbatched
+  baseline (ROADMAP target: >=50 small-job completions/min on one
+  chip).
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+from stateright_tpu.service import (JobSpec, JobStore,  # noqa: E402
+                                    Scheduler, build_model,
+                                    normalize_shapes, plan_batch,
+                                    register_model)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: pinned solo-engine shapes shared with tests/test_service.py (the
+#: persistent compile cache reuses the programs)
+OPTS = {"capacity": 1 << 12, "fmax": 64, "chunk_steps": 2}
+
+
+def _digest(fps) -> str:
+    fps = sorted(int(f) for f in fps)
+    return hashlib.sha256("\n".join(map(str, fps)).encode()).hexdigest()
+
+
+def _solo_fps(n: int, **extra):
+    ck = (TwoPhaseSys(n).checker()
+          .tpu_options(race=False, **{**OPTS, **extra})
+          .spawn_tpu().join())
+    return set(int(f) for f in ck.generated_fingerprints())
+
+
+@pytest.fixture(scope="module")
+def solo_2pc3_digest():
+    return _digest(_solo_fps(3))
+
+
+@pytest.fixture(scope="module")
+def solo_2pc4_digest():
+    return _digest(_solo_fps(4))
+
+
+@pytest.fixture(scope="module")
+def solo_2pc5_digest():
+    return _digest(_solo_fps(5))
+
+
+# --- the spec normalizer ---------------------------------------------------
+
+class TestNormalizer:
+    def test_shapes_pad_up_to_pow2_buckets(self):
+        assert normalize_shapes({"capacity": 3000, "fmax": 70}) \
+            == (4096, 128)
+        assert normalize_shapes({"capacity": 1 << 12, "fmax": 64}) \
+            == (4096, 64)
+        # floors and clamps
+        assert normalize_shapes({"capacity": 64, "fmax": 1}) \
+            == (4096, 32)
+        assert normalize_shapes({"fmax": 100000})[1] == 512
+        # defaults land on the grid
+        cap, fmax = normalize_shapes({})
+        assert cap & (cap - 1) == 0 and fmax & (fmax - 1) == 0
+
+    def test_same_bucket_iff_same_padded_shapes(self):
+        spec_a = JobSpec("twopc", args=[3], batch="auto",
+                         options={"capacity": 1 << 11, "fmax": 70})
+        spec_b = JobSpec("twopc", args=[3], batch="auto",
+                         options={"capacity": 1 << 12, "fmax": 128})
+        spec_c = JobSpec("twopc", args=[3], batch="auto",
+                         options={"capacity": 1 << 12, "fmax": 40})
+        keys = [plan_batch(s)[2] for s in (spec_a, spec_b, spec_c)]
+        assert keys[0] == keys[1]          # both pad to (4096, 128)
+        assert keys[0] != keys[2]          # fmax 40 pads to the 64 bucket
+
+    @pytest.mark.parametrize("seed", [3, 17])
+    def test_padding_never_changes_the_fingerprint_set(self, seed):
+        # PROPERTY: a run at the requested (unpadded) shapes and a run
+        # at the normalizer's padded shapes enumerate the identical
+        # fingerprint set — capacity/fmax only change batching
+        # granularity, never reachability
+        import random
+        rng = random.Random(seed)
+        requested = {"capacity": rng.choice((1 << 11, 1 << 12)),
+                     "fmax": rng.randrange(65, 129)}
+        padded_cap, padded_fmax = normalize_shapes(requested)
+        base = _solo_fps(3, capacity=requested["capacity"],
+                         fmax=requested["fmax"])
+        padded = _solo_fps(3, capacity=padded_cap, fmax=padded_fmax)
+        assert base == padded
+
+    def test_eligibility_reasons(self):
+        # opt-out, wide meshes, caps, exotic options, host-prop models
+        assert plan_batch(JobSpec("twopc", args=[3]))[0] \
+            == "batch=False"
+        assert "width" in plan_batch(
+            JobSpec("twopc", args=[3], batch="auto", width=2))[0]
+        assert "target" in plan_batch(
+            JobSpec("twopc", args=[3], batch="auto", target=100))[0]
+        assert "options" in plan_batch(
+            JobSpec("twopc", args=[3], batch="auto",
+                    options={"max_capacity": 1 << 20}))[0]
+        assert "host-evaluated" in plan_batch(
+            JobSpec("single_copy", args=[2, 2], batch="auto"))[0]
+        reason, model, key, label = plan_batch(
+            JobSpec("twopc", args=[3], batch="auto", options=OPTS))
+        assert reason is None and model is not None
+        assert "twopc" in label
+
+
+# --- the registry unification satellite ------------------------------------
+
+class TestModelRegistry:
+    def test_single_lazily_populated_registry(self):
+        from stateright_tpu.service.jobs import MODEL_REGISTRY, \
+            known_models
+        names = known_models()
+        assert {"twopc", "paxos", "single_copy", "abd"} <= set(names)
+        assert names == sorted(names)  # deterministic listing
+        # built-ins live in THE registry after first use
+        assert "twopc" in MODEL_REGISTRY
+
+    def test_unknown_model_error_lists_known_sorted(self):
+        register_model("zz_custom", TwoPhaseSys)
+        try:
+            with pytest.raises(ValueError) as err:
+                build_model("nope", (), {})
+            msg = str(err.value)
+            assert "'nope'" in msg and "zz_custom" in msg
+            assert "twopc" in msg
+        finally:
+            from stateright_tpu.service.jobs import MODEL_REGISTRY
+            MODEL_REGISTRY.pop("zz_custom", None)
+
+    def test_runtime_registration_wins_once(self):
+        sentinel = object()
+        register_model("twopc_alias", lambda *a, **k: sentinel)
+        try:
+            assert build_model("twopc_alias", (), {}) is sentinel
+        finally:
+            from stateright_tpu.service.jobs import MODEL_REGISTRY
+            MODEL_REGISTRY.pop("twopc_alias", None)
+
+
+# --- the lane engine through the scheduler ---------------------------------
+
+def _sched(tmp_path, lanes=2, wait=0.05, **kw):
+    return Scheduler(JobStore(tmp_path / "svc"),
+                     devices=jax.devices()[:1], batch_lanes=lanes,
+                     batch_wait=wait, **kw)
+
+
+class TestBatchedJobs:
+    def test_digest_parity_all_lane_positions_and_backfill(
+            self, tmp_path, solo_2pc3_digest):
+        # ACCEPTANCE: 5 same-bucket jobs on 2 lanes — jobs 3..5 are
+        # BACKFILLED into retired lanes mid-flight; every per-job
+        # digest is bit-identical to the solo run, regardless of lane
+        # position or backfill order
+        sched = _sched(tmp_path, lanes=2)
+        jobs = [sched.submit(JobSpec(
+            "twopc", args=[3], batch="auto",
+            options={"capacity": 1 << 12, "fmax": 65 + 7 * i}))
+            for i in range(5)]
+        lanes_used = []
+        for job in jobs:
+            assert sched.wait(job.id, timeout=120.0) == "done", \
+                job.status
+            result = job.read_result()
+            assert result["fingerprints_sha256"] == solo_2pc3_digest
+            assert result["unique_state_count"] == 288
+            assert "batch" in job.status and "lane" in job.status
+            lanes_used.append(job.status["lane"])
+        # 5 jobs over 2 lanes: some lane MUST have been backfilled
+        assert len(lanes_used) > len(set(lanes_used))
+        prof = sched.profile()
+        # one bucket (every fmax pads to 128) -> ONE compiled program
+        assert prof.get("compiles") == 1
+        assert prof.get("batched_jobs") == 5
+        assert prof.get("compile_reuse") == 4
+        assert prof.get("bucket_hits") == 4
+        sched.shutdown()
+
+    def test_batch_artifacts_and_events(self, tmp_path):
+        # per-job trace.jsonl (run_start/chunk/done) + the service
+        # stream's bucket_flush/batch_form/lane_retire are all
+        # schema-valid, and trace_report renders the batching summary
+        from stateright_tpu.obs import validate_event
+        sched = _sched(tmp_path, lanes=2)
+        jobs = [sched.submit(JobSpec("twopc", args=[3], batch="auto",
+                                     options=dict(OPTS)))
+                for _ in range(2)]
+        for job in jobs:
+            assert sched.wait(job.id, timeout=120.0) == "done"
+        service_events = []
+        with open(sched.store.service_trace_path) as f:
+            for line in f:
+                ev = json.loads(line)
+                validate_event(ev)
+                service_events.append(ev["ev"])
+        for wanted in ("bucket_flush", "batch_form", "lane_retire",
+                       "job_start", "job_done"):
+            assert wanted in service_events, service_events
+        job_events = []
+        with open(jobs[0].paths["trace"]) as f:
+            for line in f:
+                ev = json.loads(line)
+                validate_event(ev)
+                job_events.append(ev["ev"])
+        assert job_events[0] == "run_start"
+        assert "chunk" in job_events and job_events[-1] == "done"
+        # view surfaces the lane; trace_report renders the summary
+        view = jobs[0].view()
+        assert view["batch"].startswith("b") and "lane" in view
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "trace_report.py"),
+             "--validate", sched.store.service_trace_path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "batching:" in out.stdout
+        sched.shutdown()
+
+    def test_ineligible_and_solo_parity(self, tmp_path,
+                                        solo_2pc3_digest):
+        # a spec the batch matrix rejects (target cap) quietly runs
+        # solo — and a batch=False spec never touches the lane engine
+        sched = _sched(tmp_path, lanes=2)
+        capped = sched.submit(JobSpec("twopc", args=[3], batch="auto",
+                                      target=100_000,
+                                      options=dict(OPTS)))
+        plain = sched.submit(JobSpec("twopc", args=[3],
+                                     options=dict(OPTS)))
+        for job in (capped, plain):
+            assert sched.wait(job.id, timeout=120.0) == "done"
+            assert "batch" not in job.status
+            assert job.read_result()["fingerprints_sha256"] \
+                == solo_2pc3_digest
+        assert not sched.profile().get("batched_jobs")
+        sched.shutdown()
+
+    def test_bucket_overflow_falls_back_solo(self, tmp_path,
+                                             solo_2pc4_digest):
+        # a lane whose state space outgrows the bucket (2pc4's 2832
+        # uniques vs the bucket's growth limit) retires with reason
+        # "grow" and re-runs on the solo engine — identical digest
+        sched = _sched(tmp_path, lanes=2)
+        job = sched.submit(JobSpec(
+            "twopc", args=[4], batch="auto",
+            options={"capacity": 1 << 11, "fmax": 128}))
+        assert sched.wait(job.id, timeout=180.0) == "done", job.status
+        assert job.status.get("batch_fallback") == "grow"
+        assert job.read_result()["fingerprints_sha256"] \
+            == solo_2pc4_digest
+        sched.shutdown()
+
+    def test_pause_batched_lane_resumes_solo_to_parity(
+            self, tmp_path, solo_2pc5_digest):
+        # ACCEPTANCE: pause a batched lane mid-flight -> a standard
+        # resume_from-loadable checkpoint lands; the resumed job (solo
+        # engine) restores per-lane parity; the OTHER lane's job is
+        # untouched by the pause
+        sched = _sched(tmp_path, lanes=2)
+        # chunk_steps=1 -> one iteration per batched chunk: 2pc5 at
+        # fmax 32 needs hundreds of chunks, so the pause control lands
+        # mid-flight deterministically once the lane is RUNNING
+        slow_opts = {"capacity": 1 << 14, "fmax": 32, "chunk_steps": 1}
+        j1 = sched.submit(JobSpec("twopc", args=[5], batch="auto",
+                                  options=dict(slow_opts)))
+        j2 = sched.submit(JobSpec("twopc", args=[5], batch="auto",
+                                  options=dict(slow_opts)))
+        assert sched.wait(j1.id, timeout=120.0,
+                          states=("running",)) == "running"
+        assert sched.pause(j1.id)
+        assert sched.wait(j1.id, timeout=120.0,
+                          states=("paused",)) == "paused", j1.status
+        assert sched.wait(j2.id, timeout=180.0) == "done"
+        assert j2.read_result()["fingerprints_sha256"] \
+            == solo_2pc5_digest
+        assert j1.has_checkpoint()
+        assert j1.status.get("resume") is True
+        # partial progress landed in the checkpoint mid-flight
+        assert 0 < j1.status.get("seq", 1)
+        assert sched.resume(j1.id)
+        assert sched.wait(j1.id, timeout=180.0) == "done", j1.status
+        # resumed SOLO from the lane checkpoint, to the identical set
+        assert "batch" not in j1.status
+        assert j1.read_result()["fingerprints_sha256"] \
+            == solo_2pc5_digest
+        sched.shutdown()
+
+    def test_cancel_batched_lane(self, tmp_path):
+        sched = _sched(tmp_path, lanes=2)
+        slow_opts = {"capacity": 1 << 14, "fmax": 32, "chunk_steps": 1}
+        j1 = sched.submit(JobSpec("twopc", args=[4], batch="auto",
+                                  options=dict(slow_opts)))
+        j2 = sched.submit(JobSpec("twopc", args=[4], batch="auto",
+                                  options=dict(slow_opts)))
+        assert sched.cancel(j1.id)
+        assert sched.wait(j1.id, timeout=120.0) in ("cancelled",
+                                                    "done")
+        assert sched.wait(j2.id, timeout=180.0) == "done"
+        sched.shutdown()
+
+
+# --- the throughput pin (bench --job-storm subprocess) ---------------------
+
+class TestJobStorm:
+    def test_storm_contract_compiles_and_speedup(self):
+        # ACCEPTANCE: >=24 tiny same-bucket-family jobs on one CPU
+        # device complete with <=2 distinct compiles (vs >=24
+        # unbatched) and batched jobs_per_min >= 3x unbatched (and >=
+        # the ROADMAP 50/min target). The storm uses a FRESH
+        # persistent-cache dir internally, so this pin is warm-cache
+        # deterministic.
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--job-storm", "--storm-jobs", "24"],
+            capture_output=True, text=True, timeout=560, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        contract = json.loads(line)
+        assert contract.get("storm") is True
+        assert not contract.get("partial"), contract
+        assert contract["jobs"] == 24
+        assert contract["compiles"]["batched"] <= 2, contract
+        assert contract["compiles"]["unbatched"] >= 24, contract
+        assert contract["speedup"] >= 3.0, contract
+        assert contract["jobs_per_min"]["batched"] >= 50.0, contract
+        # bench_history picks the per-mode rows up as jobs/min trends
+        rows = [json.loads(ln) for ln in proc.stderr.splitlines()
+                if ln.startswith("{") and "job-storm" in ln]
+        assert {r.get("mode") for r in rows} == {"batched",
+                                                "unbatched"}
+
+
+class TestBenchHistoryStorm:
+    def test_jobs_per_min_trend_and_regression_flag(self, tmp_path):
+        # synthetic two-round trend: the storm rows land as their own
+        # jobs/min trend lines and a collapsed batched rate flags a
+        # regression
+        def art(jpm_batched):
+            tail = json.dumps({
+                "workload": "job-storm batched", "mode": "batched",
+                "done": 24, "failed": 0, "wall_s": 5.0,
+                "jobs_per_min": jpm_batched, "compiles": 2,
+                "batched_jobs": 24, "bucket_hits": 22,
+                "compile_reuse": 22})
+            return {"rc": 0, "parsed": {
+                "metric": "job-storm", "value": jpm_batched,
+                "unit": "jobs/min", "storm": True, "service": True,
+                "backend": "cpu"}, "tail": tail}
+        p1 = tmp_path / "BENCH_r90.json"
+        p2 = tmp_path / "BENCH_r91.json"
+        p1.write_text(json.dumps(art(300.0)))
+        p2.write_text(json.dumps(art(90.0)))  # 70% collapse
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import bench_history
+            report = bench_history.build_report([str(p1), str(p2)])
+        finally:
+            sys.path.pop(0)
+        trend = report["trend"]["job-storm batched"]
+        assert [e["best"] for e in trend] == [300.0, 90.0]
+        assert trend[0]["unit"] == "jobs/min"
+        assert "storm" in trend[0]["tags"]
+        kinds = {f["kind"] for f in report["flags"]}
+        assert "regression" in kinds, report["flags"]

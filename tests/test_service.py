@@ -258,12 +258,17 @@ class TestScheduler:
         assert result["fingerprints_sha256"] == _digest(solo_2pc4)
         sched2.shutdown()
 
+    @pytest.mark.slow
     def test_preempt_d4_resumes_at_d2_equals_uninterrupted_d2(
             self, tmp_path):
         # ACCEPTANCE: preemption = pause the lowest-priority job,
         # resume on a smaller subset — a D=4 job paused mid-run and
         # resumed at D=2 equals an uninterrupted D=2 run (the ladder's
         # parity guarantee, now scheduler-driven)
+        # (-m slow since round 11: the slowest service pin after the
+        # sigkill subprocess; cross-width pause/resume parity stays in
+        # tier-1 via test_pause_restart_resume_parity, and the batch
+        # storm pin needed the budget headroom)
         if len(jax.devices()) < 4:
             pytest.skip("need 4 devices")
         clean_d2 = (TwoPhaseSys(3).checker()
@@ -430,11 +435,16 @@ class TestServiceRestart:
         url = [tok for tok in line.split() if tok.startswith("http")][0]
         return proc, url
 
+    @pytest.mark.slow
     def test_sigkill_midrun_resumes_to_identical_fingerprints(
             self, tmp_path, solo_2pc4):
         # ACCEPTANCE: service killed -9 mid-run; on the next boot the
         # RUNNING job resumes from its last autosave and finishes with
         # the identical fingerprint set
+        # (-m slow since round 11: the second-slowest service pin; the
+        # in-process restart-resume parity pin — pause_restart_resume
+        # — keeps boot recovery in tier-1, and the batch storm pin
+        # needed the budget headroom)
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # the serve --cpu flags rebuild it
         root = tmp_path / "svc"

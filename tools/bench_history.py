@@ -105,7 +105,26 @@ def parse_round(path: str) -> Dict[str, Any]:
             rnd["errors"].append({"workload": name,
                                   "error": row["error"]})
             continue
-        if "skipped" in row or "best" not in row:
+        if "skipped" in row:
+            continue
+        if "best" not in row and "jobs_per_min" in row:
+            # a --job-storm mode row: jobs/min IS the trend number
+            # (one line per mode, so a batched regression can never
+            # hide behind an unbatched improvement)
+            rnd["workloads"][normalize_workload(name)] = {
+                "name": name,
+                "best": row["jobs_per_min"],
+                "median": None,
+                "unit": "jobs/min",
+                "uniq": None,
+                "gen_per_uniq": None,
+                "tags": sorted(t for t, on in (
+                    ("storm", True),
+                    ("partial", bool(row.get("failed"))),
+                ) if on),
+            }
+            continue
+        if "best" not in row:
             continue
         metrics = row.get("metrics") or {}
         rnd["workloads"][normalize_workload(name)] = {
@@ -137,6 +156,10 @@ def parse_round(path: str) -> Dict[str, Any]:
                 # a --service-smoke round: the value is aggregate
                 # job-service throughput, not a device engine rate
                 ("service", bool(contract.get("service"))),
+                # a --job-storm round: the value is batched jobs/min
+                # through the lane engine (jobs_per_min rides the
+                # per-mode rows as their own trend lines)
+                ("storm", bool(contract.get("storm"))),
             ) if on)
         rnd["workloads"][CONTRACT] = {
             "name": contract.get("metric", "contract"),

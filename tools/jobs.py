@@ -12,7 +12,11 @@ Server:
 Client (all take --url http://host:port):
     python tools/jobs.py submit --url U --model NAME [--args 3,2]
         [--width W] [--priority P] [--target N] [--options '{"k":v}']
-        [--step-delay S]                      -> prints the job id
+        [--step-delay S] [--batch]            -> prints the job id
+        ``--batch`` opts the job into the batch lane engine
+        (JobSpec batch='auto'): same-bucket small jobs coalesce into
+        one vmapped chunk program; ``list`` shows the batch/lane a
+        batched job ran on
     python tools/jobs.py list --url U
     python tools/jobs.py watch --url U JOB [--timeout S]
         polls until the job is terminal or paused; prints transitions
@@ -128,6 +132,8 @@ def cmd_submit(argv) -> int:
     target = _arg(argv, "--target")
     if target:
         payload["target"] = int(target)
+    if "--batch" in argv:
+        payload["batch"] = "auto"
     out = _post(url.rstrip("/") + "/jobs", payload)
     print(out["id"])
     return 0
@@ -137,10 +143,12 @@ def cmd_list(argv) -> int:
     url = _arg(argv, "--url")
     out = _http(url.rstrip("/") + "/jobs")
     for job in out["jobs"]:
+        lane = (f" batch={job['batch']}/lane{job['lane']}"
+                if "batch" in job and "lane" in job else "")
         print(f"{job['id']:28} {job['state']:10} "
               f"prio={job.get('priority', 0)} "
               f"width={job.get('granted_width', job.get('width'))} "
-              f"model={job.get('model')}")
+              f"model={job.get('model')}{lane}")
     prof = out.get("profile") or {}
     if prof:
         print("# " + " ".join(f"{k}={v}" for k, v in sorted(

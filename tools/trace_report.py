@@ -161,7 +161,8 @@ def report(events, out=None):
                    "spill", "evict", "pause",
                    "crash", "restart", "partition",
                    "job_submit", "job_start", "job_pause",
-                   "job_resume", "job_done")]
+                   "job_resume", "job_done",
+                   "bucket_flush", "batch_form", "lane_retire")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
@@ -263,6 +264,30 @@ def report(events, out=None):
                         extra = f"({ev.get('state')})"
                     parts.append(f"{kind}{extra}@{ev['t']:.2f}")
                 out.write(f"  {jid}: " + " -> ".join(parts) + "\n")
+
+        # batch-lane summary (service/batch.py): how many small jobs
+        # rode the compile-amortized lane engine, how batches formed,
+        # and why lanes retired (done vs solo fallback vs pause)
+        batches = [e for e in evs if e["ev"] == "batch_form"]
+        retires = [e for e in evs if e["ev"] == "lane_retire"]
+        if batches or retires:
+            reasons = {}
+            for ev in retires:
+                r = ev.get("reason", "?")
+                reasons[r] = reasons.get(r, 0) + 1
+            flushes = [e for e in evs if e["ev"] == "bucket_flush"]
+            buckets = sorted({e.get("bucket", "?") for e in batches})
+            parts = [f"batches={len(batches)}",
+                     f"flushes={len(flushes)}",
+                     f"lane_retires={len(retires)}"]
+            if reasons:
+                parts.append("reasons=" + ",".join(
+                    f"{k}:{v}" for k, v in sorted(reasons.items())))
+            out.write("\nbatching: " + " ".join(parts) + "\n")
+            for b in buckets:
+                lanes = [e.get("lanes") for e in batches
+                         if e.get("bucket") == b]
+                out.write(f"  bucket {b}: lanes={lanes[0]}\n")
 
         # fused-kernel summary: which path the run took, and why a
         # fused='auto' attempt fell back (the classified cause)
