@@ -63,7 +63,11 @@ tiny randomized specs, unbatched then batched through the lane engine
 (service/batch.py), and lands a ``"storm": true`` contract line with
 ``jobs_per_min`` for both modes, the speedup, and distinct-compile
 counts (the trend line tools/bench_history.py tracks for ROADMAP's
->=50 small-job completions/min target).
+>=50 small-job completions/min target); ``--multihost-smoke`` runs a
+2-process CPU fleet mesh through tools/mesh_launch.py plus the
+two-level DevicePool over two simulated hosts, and lands a
+``"hosts": N`` contract line (uniq/s across DCN +
+jobs-granted-per-host) — bench_history tags it ``multihost``.
 """
 
 from __future__ import annotations
@@ -395,6 +399,116 @@ def _service_smoke() -> None:
         print(json.dumps(contract))
 
 
+def _multihost_smoke() -> None:
+    """``--multihost-smoke``: a seconds-scale proof of the fleet layer
+    (stateright_tpu/cluster) under the crash-proof contract — (a) a
+    2-process CPU mesh run through ``tools/mesh_launch.py`` (2 virtual
+    devices per process; the fingerprint all-to-all spans the
+    process boundary) reporting uniq/s and the fingerprint digest, and
+    (b) the service's TWO-LEVEL DevicePool granting width-1 jobs
+    across two simulated hosts (jobs-granted-per-host). The contract
+    line is tagged ``"hosts": N`` (tools/bench_history.py learns the
+    multihost tag). Emitted from a ``finally`` path with
+    ``"partial"``/``"failed"`` on any error; rc=0 regardless."""
+    import os
+    import subprocess
+    import tempfile
+
+    contract = {
+        "metric": "multihost 2-process CPU mesh smoke (DCN exchange + "
+                  "two-level pool grants)",
+        "value": None,
+        "unit": "uniq/s",
+        "hosts": None,
+        "procs": None,
+        "jobs_by_host": None,
+    }
+    try:
+        out_dir = tempfile.mkdtemp(prefix="stateright_multihost_")
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [sys.executable,
+               os.path.join(here, "tools", "mesh_launch.py"),
+               "--procs", "2", "--devices-per-proc", "2",
+               "--model", "twopc", "--args", "3",
+               "--capacity", "4096", "--fmax", "64",
+               "--chunk-steps", "2",
+               "--out", out_dir, "--timeout", "240"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300)
+        line = (proc.stdout.strip().splitlines() or [""])[-1]
+        result = json.loads(line) if line.startswith("{") else {}
+        if proc.returncode != 0 or "error" in result:
+            FAILED.append("multihost-mesh")
+            print(json.dumps({"workload": "multihost mesh",
+                              "error": result.get(
+                                  "error", f"rc={proc.returncode}")}),
+                  file=sys.stderr)
+        else:
+            contract["value"] = result.get("uniq_per_s")
+            contract["hosts"] = result.get("hosts")
+            contract["procs"] = result.get("procs")
+            contract["mesh"] = {
+                "unique": result.get("unique"),
+                "shards": result.get("shards"),
+                "fingerprints_sha256": result.get(
+                    "fingerprints_sha256"),
+                "secs": result.get("secs")}
+            print(json.dumps({"workload": "multihost mesh",
+                              **contract["mesh"],
+                              "uniq_per_s": contract["value"]}),
+                  file=sys.stderr)
+
+        # (b) two-level pool: four width-1 jobs over two simulated
+        # hosts; the grants must land on both hosts
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from stateright_tpu.service import JobSpec, JobStore, Scheduler
+
+        root = tempfile.mkdtemp(prefix="stateright_multihost_svc_")
+        devices = jax.devices()[:4]
+        sched = Scheduler(JobStore(root), devices=devices,
+                          hosts=["h0", "h0", "h1", "h1"])
+        opts = {"capacity": 1 << 12, "fmax": 64}
+        jobs = [sched.submit(JobSpec("twopc", args=[3], options=opts))
+                for _ in range(4)]
+        by_host: dict = {}
+        for job in jobs:
+            state = sched.wait(job.id, timeout=180.0)
+            if state != "done":
+                FAILED.append(f"multihost-job-{job.id}")
+                continue
+            for h in job.status.get("hosts", ()):
+                by_host[h] = by_host.get(h, 0) + 1
+        contract["jobs_by_host"] = by_host
+        if contract["hosts"] is None:
+            contract["hosts"] = len(by_host)
+        prof = sched.profile()
+        contract["jobs_done"] = int(prof.get("jobs_done", 0))
+        sched.shutdown()
+        print(json.dumps({"workload": "multihost pool",
+                          "jobs_by_host": by_host,
+                          "jobs_done": contract["jobs_done"]}),
+              file=sys.stderr)
+        if len(by_host) < 2:
+            FAILED.append("multihost-pool-spread")
+    except BaseException as exc:
+        print(json.dumps({"workload": "multihost", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("multihost")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
 def _storm_specs(n: int, seed: int, models: str):
     """The randomized tiny-spec generator both storm modes share:
     per-user shape drift (randomized fmax, small capacities) that
@@ -557,6 +671,9 @@ def main() -> None:
         return
     if "--service-smoke" in sys.argv:
         _service_smoke()
+        return
+    if "--multihost-smoke" in sys.argv:
+        _multihost_smoke()
         return
     if SMOKE:
         N = 1

@@ -1,0 +1,34 @@
+"""Multi-host fleet checking: ``jax.distributed`` mesh over DCN.
+
+* :mod:`~stateright_tpu.cluster.mesh` — per-process bootstrap
+  (``init_process`` / ``init_from_env``), host×device ``Mesh``
+  construction (``fleet_mesh``), host identity (``device_host``), and
+  the process-spanning host-pull primitive (``pull_global``).
+* :mod:`~stateright_tpu.cluster.launch` — the coordinator: spawn one
+  subprocess per rank, watch ready markers and exit codes, abort
+  fan-out on the first failure (``launch_fleet``).
+* ``tools/mesh_launch.py`` — the CLI driving both halves (README
+  § Multi-host checking).
+"""
+
+from .launch import FleetResult, launch_fleet, pick_port, worker_env
+from .mesh import (FleetContext, dcn_probe, device_host, fleet_mesh,
+                   force_cpu_devices, init_from_env, init_process,
+                   mesh_hosts, mesh_spans_processes, pull_global)
+
+__all__ = [
+    "FleetContext",
+    "FleetResult",
+    "dcn_probe",
+    "device_host",
+    "fleet_mesh",
+    "force_cpu_devices",
+    "init_from_env",
+    "init_process",
+    "launch_fleet",
+    "mesh_hosts",
+    "mesh_spans_processes",
+    "pick_port",
+    "pull_global",
+    "worker_env",
+]

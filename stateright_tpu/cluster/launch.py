@@ -1,0 +1,177 @@
+"""Fleet process launcher: spawn, watch, and tear down a mesh's workers.
+
+The coordinator half of the ``STPU_*`` contract (the worker half is
+``cluster.mesh.init_from_env``): :func:`launch_fleet` starts one
+subprocess per rank with the coordinator address / rank / device
+forcing in its environment, watches them, and fans an ABORT out to the
+survivors the moment any rank dies or the deadline passes — a wedged
+``jax.distributed`` worker otherwise blocks forever on its first
+collective, which is exactly the hang a launcher exists to prevent.
+
+Observability: the launcher keeps a ``fleet.jsonl`` trace
+(``engine="fleet"``): a ``host_join`` event per rank as its ready file
+lands (workers write ``rank<k>.ready`` after mesh construction — see
+``tools/mesh_launch.py``), a ``mesh_init`` once the fleet is up, and
+the per-rank exit codes on the way down. ``tools/trace_report.py``
+renders these as the ``fleet:`` summary line.
+
+Artifact ownership is rank-0's: the launcher hands every rank the same
+``--out`` directory, workers write rank-local files (logs, ready
+markers, non-canonical checkpoints) under ``rank<k>`` names, and only
+rank 0 writes ``result.json`` / ``trace.jsonl`` / the canonical
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .mesh import (ENV_COORDINATOR, ENV_CPU, ENV_LOCAL_DEVICES,
+                   ENV_NUM_PROCS, ENV_RANK)
+
+
+def pick_port() -> int:
+    """A free TCP port for the ``jax.distributed`` coordinator."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker_env(rank: int, num_procs: int, coordinator: str,
+               local_devices: int, cpu: bool = True,
+               base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """The environment one rank is launched with (inherits ``base`` /
+    ``os.environ`` so compile caches and PATH carry over)."""
+    env = dict(os.environ if base is None else base)
+    env[ENV_COORDINATOR] = coordinator
+    env[ENV_NUM_PROCS] = str(int(num_procs))
+    env[ENV_RANK] = str(int(rank))
+    env[ENV_LOCAL_DEVICES] = str(int(local_devices))
+    env[ENV_CPU] = "1" if cpu else "0"
+    return env
+
+
+class FleetResult:
+    """What :func:`launch_fleet` returns: per-rank exit codes plus the
+    paths a caller (bench, tests) reads results from."""
+
+    def __init__(self, returncodes: List[Optional[int]],
+                 log_paths: List[str], aborted: Optional[str]):
+        self.returncodes = returncodes
+        self.log_paths = log_paths
+        self.aborted = aborted  # None, or why the fan-out fired
+
+    @property
+    def ok(self) -> bool:
+        return self.aborted is None and all(
+            rc == 0 for rc in self.returncodes)
+
+    def tail(self, rank: int, n: int = 40) -> str:
+        try:
+            with open(self.log_paths[rank]) as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return ""
+
+
+def _terminate(procs: Sequence[subprocess.Popen],
+               grace: float = 5.0) -> None:
+    """Abort fan-out: SIGTERM the survivors, escalate to SIGKILL."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace
+    for p in procs:
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+
+
+def launch_fleet(cmd: Sequence[str], num_procs: int, *,
+                 local_devices: int = 1, cpu: bool = True,
+                 coordinator: Optional[str] = None,
+                 out_dir: str, timeout: float = 600.0,
+                 trace=None) -> FleetResult:
+    """Spawn ``num_procs`` copies of ``cmd`` as fleet ranks and watch
+    them to completion.
+
+    Every rank runs the SAME command line (workers read their identity
+    from the environment). Logs land in ``out_dir/rank<k>.log``; the
+    first failing rank (non-zero exit) or the ``timeout`` triggers the
+    abort fan-out so no rank is left blocked on a collective whose
+    peers are gone. ``trace`` is an optional ``RunTrace`` (the
+    launcher's ``fleet.jsonl``) receiving ``host_join`` events as ready
+    markers land.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    coordinator = coordinator or f"127.0.0.1:{pick_port()}"
+    procs: List[subprocess.Popen] = []
+    logs: List[str] = []
+    log_files = []
+    joined = set()
+    try:
+        for rank in range(num_procs):
+            log_path = os.path.join(out_dir, f"rank{rank}.log")
+            logs.append(log_path)
+            lf = open(log_path, "w")
+            log_files.append(lf)
+            procs.append(subprocess.Popen(
+                list(cmd), stdout=lf, stderr=subprocess.STDOUT,
+                env=worker_env(rank, num_procs, coordinator,
+                               local_devices, cpu=cpu)))
+        deadline = time.monotonic() + timeout
+        aborted = None
+        while True:
+            codes = [p.poll() for p in procs]
+            if trace is not None:
+                for rank in range(num_procs):
+                    if rank in joined:
+                        continue
+                    ready = os.path.join(out_dir, f"rank{rank}.ready")
+                    if os.path.exists(ready):
+                        joined.add(rank)
+                        info = {}
+                        try:
+                            with open(ready) as f:
+                                info = json.load(f)
+                        except (OSError, json.JSONDecodeError):
+                            pass
+                        trace.emit("host_join", host=rank,
+                                   devices=info.get("local_devices"),
+                                   global_devices=info.get(
+                                       "global_devices"))
+            if all(c is not None for c in codes):
+                break
+            failed = [r for r, c in enumerate(codes)
+                      if c is not None and c != 0]
+            if failed:
+                aborted = (f"rank {failed[0]} exited "
+                           f"rc={codes[failed[0]]}")
+            elif time.monotonic() > deadline:
+                aborted = f"timeout after {timeout}s"
+            if aborted:
+                _terminate(procs)
+                break
+            time.sleep(0.05)
+        return FleetResult([p.poll() for p in procs], logs, aborted)
+    finally:
+        for lf in log_files:
+            try:
+                lf.close()
+            except OSError:
+                pass

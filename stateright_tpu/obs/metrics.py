@@ -222,6 +222,19 @@ GLOSSARY: Dict[str, str] = {
                      "chunk-program build (every lane after the first "
                      "of a fresh build, and every lane of a "
                      "cache-hit batch)",
+    # --- fleet layer (stateright_tpu/cluster + multi-host meshes) ------
+    "hosts": "distinct hosts behind the run's mesh or the scheduler's "
+             "device pool (gauge; real process_index or the simulated "
+             "host_map/hosts= labels; drops when the degradation "
+             "ladder's host rung fires)",
+    "procs": "jax processes participating in the run (gauge; 1 for "
+             "single-controller runs, the jax.distributed world size "
+             "on a fleet mesh)",
+    "dcn_exchange_s": "timed cross-host collective round trip at mesh "
+                      "init (one warm replicated psum over the global "
+                      "mesh — the DCN latency floor every fingerprint "
+                      "all-to-all pays between hosts; 0 on "
+                      "single-process meshes, which skip the probe)",
 }
 
 #: keys that are point-in-time GAUGES, not accumulating counters:
@@ -231,6 +244,7 @@ GLOSSARY: Dict[str, str] = {
 GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
     "shard_balance", "host_tier_keys", "queue_depth", "lanes",
+    "hosts", "procs",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

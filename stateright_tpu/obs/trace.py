@@ -139,6 +139,17 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "bucket_flush": frozenset({"bucket", "jobs", "reason"}),
     "batch_form": frozenset({"batch", "bucket", "jobs", "lanes"}),
     "lane_retire": frozenset({"batch", "job", "lane", "reason"}),
+    # the fleet layer (stateright_tpu/cluster + the sharded engine on a
+    # multi-host mesh): `mesh_init` — the global mesh is up (shard
+    # count, distinct hosts, jax processes; optional `dcn_exchange_s`,
+    # the timed cross-host psum round trip); `host_join` — one rank's
+    # ready marker landed at the launcher (engine="fleet"; optional
+    # device counts); `host_drop` — the degradation ladder's host rung
+    # dropped an entire host's devices (optional from/to shard widths
+    # and the blamed device)
+    "mesh_init": frozenset({"shards", "hosts", "procs"}),
+    "host_join": frozenset({"host"}),
+    "host_drop": frozenset({"host"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

@@ -26,9 +26,9 @@ import numpy as np
 
 from ..checker.builder import CheckerBuilder
 from ..checker.tpu import TpuChecker, _combine64, auto_fmax
-from .sharded import (ShardedCarry, build_sharded_chunk_fn,
-                      build_sharded_insert, effective_kb, owner_of,
-                      seed_sharded_carry)
+from .sharded import (MAX_MESH_SHARDS, ShardedCarry,
+                      build_sharded_chunk_fn, build_sharded_insert,
+                      effective_kb, owner_of, seed_sharded_carry)
 
 
 class ShardedTpuChecker(TpuChecker):
@@ -46,6 +46,13 @@ class ShardedTpuChecker(TpuChecker):
         d = self._mesh.shape[self._axis]
         if d & (d - 1):
             raise ValueError("mesh axis size must be a power of two")
+        if d > MAX_MESH_SHARDS:
+            raise ValueError(
+                f"fleet width {d} exceeds the {MAX_MESH_SHARDS}-shard "
+                "limit: owner_of() top-bit routing must nest the spill "
+                "tier's 8-bit prefix ranges (checker/resilience.py "
+                "SPILL_PREFIX_BITS) inside shard ownership — shard "
+                f"over <= {MAX_MESH_SHARDS} devices")
         if self._capacity % d:
             raise ValueError("capacity must be divisible by the mesh axis")
         if int(opts.get("hint", 0)):
@@ -71,6 +78,16 @@ class ShardedTpuChecker(TpuChecker):
                 "host-evaluated eventually properties need the per-level "
                 "engine; drop tpu_options(mesh=...) or use single-chip "
                 "spawn_tpu")
+
+    # ------------------------------------------------------------------
+    def _pull_global(self, arrays):
+        """``jax.device_get`` of carry pieces, safe when the mesh spans
+        processes (``cluster.mesh.pull_global`` replicates over DCN
+        first). COLLECTIVE on a multi-process mesh: every rank's host
+        loop takes the same pulls in the same order — guaranteed
+        because all control flow derives from the replicated stats."""
+        from ..cluster.mesh import pull_global
+        return pull_global(arrays, self._mesh)
 
     # ------------------------------------------------------------------
     def _run_steps(self):
@@ -289,6 +306,27 @@ class ShardedTpuChecker(TpuChecker):
 
         self._fault_shards = D
         self._metrics.set("mesh_shards", D)
+
+        # --- fleet visibility (cluster/mesh.py): host labels (real
+        # process_index, or the simulated tpu_options(host_map=...)),
+        # process count, and — once the mesh spans processes — one
+        # timed DCN round trip, the latency floor every fingerprint
+        # exchange pays between hosts
+        from ..cluster.mesh import dcn_probe, mesh_hosts
+        host_map = opts.get("host_map")
+        n_hosts = len(set(mesh_hosts(mesh, host_map)))
+        n_procs = int(jax.process_count())
+        self._metrics.set("hosts", n_hosts)
+        self._metrics.set("procs", n_procs)
+        probe_s = None
+        if n_procs > 1:
+            probe_s = dcn_probe(mesh, axis)
+            self._metrics.add_time("dcn_exchange_s", probe_s)
+        if self._trace:
+            self._trace.emit(
+                "mesh_init", shards=D, hosts=n_hosts, procs=n_procs,
+                dcn_exchange_s=(round(probe_s, 6)
+                                if probe_s is not None else None))
 
         def seed_shadow_epoch(rows_list, frontier_keys, ebs_arr,
                               cache_list) -> None:
@@ -613,7 +651,7 @@ class ShardedTpuChecker(TpuChecker):
                 old_eloc = ecap // D
                 ecap *= 4
                 eloc = ecap // D
-                elog_h, en_h = jax.device_get(
+                elog_h, en_h = self._pull_global(
                     (carry.elog, carry.e_n))
                 new_elog = np.zeros((ecap, 4), np.uint32)
                 for s in range(D):
@@ -794,25 +832,47 @@ class ShardedTpuChecker(TpuChecker):
             # when the next rung is the single-chip device loop
             # (checker/tpu.py shadow handoff).
             nonlocal mesh, D, insert_fn, headroom, size_key
+            from ..cluster.mesh import device_host
             new_d = D // 2
             devs = list(mesh.devices.flat)
+            host_map = opts.get("host_map")
+            labels = [device_host(dv, host_map) for dv in devs]
+            hosts_before = set(labels)
+            pos = None
             if blamed is not None:
                 # a real PJRT fault names the GLOBAL device id; an
                 # injected one may name the mesh position — match id
                 # first, fall back to position
                 ids = [getattr(d, "id", None) for d in devs]
                 if blamed in ids:
-                    devs.pop(ids.index(blamed))
+                    pos = ids.index(blamed)
                 elif 0 <= blamed < len(devs):
-                    devs.pop(blamed)
+                    pos = blamed
+            if len(hosts_before) > 1 and pos is not None:
+                # HOST RUNG: on a multi-host mesh a blamed chip takes
+                # its whole HOST down the ladder (DCN partitions and
+                # host deaths fault every chip behind that NIC) — the
+                # survivors are host-major, so the halved mesh stays
+                # host-aligned and the owner_of(fp, D/2) re-route is
+                # exactly the chip rung's math
+                bad = labels[pos]
+                devs = [dv for dv, h in zip(devs, labels) if h != bad]
+            elif pos is not None:
+                devs.pop(pos)
             keep = devs[:new_d]
+            hosts_after = {device_host(dv, host_map) for dv in keep}
             self._metrics.inc("degrades")
             self._metrics.set("mesh_shards", new_d)
+            self._metrics.set("hosts", len(hosts_after))
             if self._trace:
                 self._trace.emit(
                     "degrade", from_shards=D, to_shards=new_d,
                     device=blamed,
                     error=f"{type(exc).__name__}: {exc}")
+                for h in sorted(hosts_before - hosts_after, key=str):
+                    self._trace.emit("host_drop", host=h,
+                                     from_shards=D, to_shards=new_d,
+                                     device=blamed)
             # each rung is a postmortem-worthy incident even though the
             # run survives it: land the ring (the final error dump, if
             # the ladder too fails, overwrites this with a superset)
@@ -1049,7 +1109,7 @@ class ShardedTpuChecker(TpuChecker):
                 self._ensure_mirror()
                 qloc = qcap // D
                 width = model.packed_width
-                q_h, qh, qt = jax.device_get(
+                q_h, qh, qt = self._pull_global(
                     (carry.q, carry.q_head, carry.q_tail))
                 pend = np.concatenate(
                     [q_h[s * qloc + int(qh[s]):s * qloc + int(qt[s])]
@@ -1099,7 +1159,7 @@ class ShardedTpuChecker(TpuChecker):
             # the single-chip one (shard-agnostic)
             qloc = qcap // D
             width = model.packed_width
-            q_h, qh, qt = jax.device_get(
+            q_h, qh, qt = self._pull_global(
                 (carry.q, carry.q_head, carry.q_tail))
             pend_l = [q_h[s * qloc + int(qh[s]):s * qloc + int(qt[s])]
                       for s in range(D)]
@@ -1170,7 +1230,7 @@ class ShardedTpuChecker(TpuChecker):
         # pull only what the rebuild reads — NOT the old table halves,
         # which are discarded and re-derived from the logs
         (q_h, qh, qt, log_h, ln_h, elog_h, en_h, disc_hit, disc_hi,
-         disc_lo, gen, xovf, steps) = jax.device_get(
+         disc_lo, gen, xovf, steps) = self._pull_global(
             (carry.q, carry.q_head, carry.q_tail, carry.log,
              carry.log_n, carry.elog, carry.e_n, carry.disc_hit,
              carry.disc_hi, carry.disc_lo,
@@ -1277,7 +1337,8 @@ class ShardedTpuChecker(TpuChecker):
                                        self._capacity, hmax)
             (rows_d, src_d, whi_d, wlo_d, hcount_d, tovf, over) = fn(
                 carry.q, q_tail_d, carry.log, n_init_d)
-            hcount, tovf, over = jax.device_get((hcount_d, tovf, over))
+            hcount, tovf, over = self._pull_global(
+                (hcount_d, tovf, over))
             if bool(tovf):
                 raise RuntimeError(
                     "device hash table probe overflow during post-hoc "
@@ -1285,7 +1346,7 @@ class ShardedTpuChecker(TpuChecker):
             if not bool(over):
                 break
             hmax *= 2
-        rows_h, src_h, whi_h, wlo_h = jax.device_get(
+        rows_h, src_h, whi_h, wlo_h = self._pull_global(
             (rows_d, src_d, whi_d, wlo_d))
         for s in range(D):
             hc = int(hcount[s])
@@ -1321,7 +1382,7 @@ class ShardedTpuChecker(TpuChecker):
         width = model.packed_width
         qloc = qcap // D
         closc = self._capacity // D
-        q_h, log_h, elog_h = jax.device_get(
+        q_h, log_h, elog_h = self._pull_global(
             (carry.q, carry.log, carry.elog))
         eloc = elog_h.shape[0] // D
         node_fp: Dict[int, int] = {}
@@ -1367,7 +1428,7 @@ class ShardedTpuChecker(TpuChecker):
         with self._timed("mirror_pull"):
             D = self._mesh.shape[self._axis]
             closc = self._capacity // D
-            log_n, log = jax.device_get((log_n_d, log_d))
+            log_n, log = self._pull_global((log_n_d, log_d))
             if self._trace:
                 # per-shard pull volumes: the mirror transfer is the
                 # big host-link cost of a sharded run

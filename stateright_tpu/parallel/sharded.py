@@ -146,8 +146,27 @@ class ShardedCarry(NamedTuple):
     #                              rounds this chunk (obs probe_rounds)
 
 
+#: widest supported mesh axis. ``owner_of`` routes by the dedup key's
+#: top ``log2(D)`` bits, and the host tier's eviction ranges are
+#: top-8-bit prefix buckets (``checker/resilience.py``
+#: ``SPILL_PREFIX_BITS``) that must nest INSIDE shard ownership — a
+#: fleet wider than ``2**8`` shards would silently mis-route spilled
+#: ranges, so the width is guarded with an explicit raise instead.
+MAX_MESH_SHARDS = 1 << 8
+
+
 def _owner_bits(d: int) -> int:
     assert d & (d - 1) == 0, "mesh axis size must be a power of two"
+    if d > MAX_MESH_SHARDS:
+        raise ValueError(
+            f"fleet width {d} exceeds the {MAX_MESH_SHARDS}-shard "
+            "limit: owner_of() routes by the fingerprint's top log2(D) "
+            "bits and the spill tier's eviction ranges are top-8-bit "
+            "prefixes (checker/resilience.py SPILL_PREFIX_BITS) that "
+            "must nest inside shard ownership — a wider fleet would "
+            "silently mis-route spilled ranges. Check on <= "
+            f"{MAX_MESH_SHARDS} devices, or widen SPILL_PREFIX_BITS in "
+            "lockstep.")
     return d.bit_length() - 1
 
 

@@ -4,12 +4,17 @@ power-of-two device subsets.
 The degradation ladder (``checker/resilience.py DegradePolicy``)
 already carves power-of-two device subsets out of a mesh — as a fault
 response. This module generalizes that carving to CAPACITY allocation:
-:class:`DevicePool` is a buddy allocator over the device list (an
-8-device mesh can host one D=4 job + two D=2 jobs + singles, blocks
-merging back as jobs finish), and :class:`Scheduler` drives one worker
-thread per RUNNING job through the engines' step generators
+:class:`DevicePool` is a TWO-LEVEL buddy allocator — device slices
+within hosts, whole hosts within the fleet (an 8-device host can host
+one D=4 job + two D=2 jobs + singles, blocks merging back as jobs
+finish; a 2-host × 4-device fleet additionally grants a width-8 job
+both hosts whole, never a subset straddling a partially-carved host) —
+and :class:`Scheduler` drives one worker thread per RUNNING job
+through the engines' step generators
 (:class:`~stateright_tpu.service.driver.StepDriver`), so every job is
-pausable between chunks.
+pausable between chunks. Host labels come from ``Scheduler(hosts=...)``
+(simulated fleets, tests) or each device's ``process_index`` (real
+multi-host pools).
 
 Scheduling policy:
 
@@ -49,64 +54,216 @@ from .jobs import Job, JobSpec, JobStore, TERMINAL_STATES
 
 class DeviceLease(NamedTuple):
     """A granted device subset: ``offset`` into the pool's device
-    list, power-of-two ``width``, and the device objects themselves."""
+    list, power-of-two ``width``, the device objects themselves, and
+    the host labels the subset spans (one label for slice-level
+    leases, several for whole-host fleet leases)."""
     offset: int
     width: int
     devices: Tuple
+    hosts: Tuple = ()
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (int(n).bit_length() - 1) if n else 0
 
 
 class DevicePool:
-    """Buddy allocator over an aligned power-of-two device range.
+    """TWO-LEVEL buddy allocator: device slices within hosts, whole
+    hosts within the fleet.
 
-    Subsets are power-of-two sized and naturally aligned
-    (``offset % width == 0``), so any two live leases are disjoint and
-    releases merge with their buddy — the same carving discipline the
-    degradation ladder uses, applied to capacity instead of faults.
-    Not thread-safe on its own; the scheduler serializes access."""
+    Level 1 — within a host: power-of-two, naturally aligned slices
+    (``offset % width == 0``), splitting and buddy-merging exactly like
+    the degradation ladder's subset carving. Level 2 — across the
+    fleet: whole hosts as the allocation unit, buddy-merged in host
+    units, so a job wider than one host is granted an aligned run of
+    FULLY-FREE hosts (a mesh must never straddle a partially-carved
+    host — its all-to-all would share chips with another tenant's
+    lanes).
 
-    def __init__(self, devices):
+    Construction trims to the fleet shape both levels need: devices
+    are grouped host-major (``hosts=`` labels, else each device's
+    ``process_index`` — one anonymous host for plain lists, which
+    keeps the original single-level behavior bit-for-bit), every host
+    contributes the same power-of-two device count, and the host count
+    is a power of two.
+
+    Placement policy: slice-level requests prefer the host whose
+    smallest adequate free block is TIGHTEST (best fit), breaking a
+    fully-free host out of the fleet level only when no partially-used
+    host fits — small jobs pack into already-carved hosts, preserving
+    whole hosts for fleet-wide work. Not thread-safe on its own; the
+    scheduler serializes access."""
+
+    def __init__(self, devices, hosts=None):
         devices = list(devices)
         if not devices:
             raise ValueError("DevicePool needs at least one device")
-        n = 1 << (len(devices).bit_length() - 1)  # pow2 floor
-        self.width = n
-        self._devices = devices[:n]
-        self._free: Dict[int, set] = {n: {0}}
+        if hosts is None:
+            hosts = [getattr(d, "process_index", 0) for d in devices]
+        else:
+            hosts = list(hosts)
+            if len(hosts) != len(devices):
+                raise ValueError(
+                    f"hosts ({len(hosts)}) must label every device "
+                    f"({len(devices)})")
+        order: List = []
+        groups: Dict = {}
+        for d, h in zip(devices, hosts):
+            if h not in groups:
+                groups[h] = []
+                order.append(h)
+            groups[h].append(d)
+        hw = min(_pow2_floor(len(g)) for g in groups.values())
+        nh = _pow2_floor(len(order))
+        #: devices each host contributes (the slice-level ceiling)
+        self.host_width = hw
+        #: host labels, in pool order (host ``i`` owns the global
+        #: offset range ``[i*host_width, (i+1)*host_width)``)
+        self.host_labels: List = order[:nh]
+        self._devices = [d for h in self.host_labels
+                         for d in groups[h][:hw]]
+        self.width = nh * hw
+        # level 1: per-host free blocks, GLOBAL offsets (empty dict =
+        # the host is wholly at level 2)
+        self._local_free: List[Dict[int, set]] = [
+            {} for _ in range(nh)]
+        # level 2: free blocks of whole hosts, in host units
+        self._free_hosts: Dict[int, set] = {nh: {0}}
+
+    @property
+    def host_count(self) -> int:
+        return len(self.host_labels)
+
+    def _host_of_offset(self, offset: int) -> int:
+        return offset // self.host_width
+
+    def _carve_host(self) -> Optional[int]:
+        """Break the lowest fully-free host out of level 2 for
+        slice-level use (splitting its host-block buddy-style)."""
+        sizes = sorted(s for s, offs in self._free_hosts.items()
+                       if offs)
+        if not sizes:
+            return None
+        size = sizes[0]
+        h = min(self._free_hosts[size])
+        self._free_hosts[size].discard(h)
+        while size > 1:  # keep the upper host-buddy at level 2
+            size //= 2
+            self._free_hosts.setdefault(size, set()).add(h + size)
+        self._local_free[h] = {self.host_width: {h * self.host_width}}
+        return h
 
     def acquire(self, width: int) -> Optional[DeviceLease]:
         width = int(width)
         if width < 1 or (width & (width - 1)) or width > self.width:
             return None
-        sizes = sorted(s for s, offs in self._free.items()
-                       if offs and s >= width)
-        if not sizes:
-            return None
-        size = sizes[0]
-        offset = min(self._free[size])
-        self._free[size].discard(offset)
+        hw = self.host_width
+        if width > hw:
+            # fleet level: an aligned run of width/hw fully-free hosts
+            k = width // hw
+            sizes = sorted(s for s, offs in self._free_hosts.items()
+                           if offs and s >= k)
+            if not sizes:
+                return None
+            size = sizes[0]
+            h = min(self._free_hosts[size])
+            self._free_hosts[size].discard(h)
+            while size > k:
+                size //= 2
+                self._free_hosts.setdefault(size, set()).add(h + size)
+            offset = h * hw
+            return DeviceLease(
+                offset, width,
+                tuple(self._devices[offset:offset + width]),
+                tuple(self.host_labels[h:h + k]))
+        # slice level: best-fit across partially-used hosts first
+        best = None  # (block_size, host)
+        for hi, free in enumerate(self._local_free):
+            sizes = [s for s, offs in free.items()
+                     if offs and s >= width]
+            if sizes:
+                cand = (min(sizes), hi)
+                if best is None or cand < best:
+                    best = cand
+        if best is None:
+            hi = self._carve_host()
+            if hi is None:
+                return None
+            best = (hw, hi)
+        size, hi = best
+        free = self._local_free[hi]
+        offset = min(free[size])
+        free[size].discard(offset)
         while size > width:  # split, keeping the upper buddy free
             size //= 2
-            self._free.setdefault(size, set()).add(offset + size)
+            free.setdefault(size, set()).add(offset + size)
         return DeviceLease(offset, width,
-                           tuple(self._devices[offset:offset + width]))
+                           tuple(self._devices[offset:offset + width]),
+                           (self.host_labels[hi],))
 
     def release(self, lease: DeviceLease) -> None:
         offset, width = lease.offset, lease.width
-        while width < self.width:  # merge with the free buddy
-            buddy = offset ^ width
-            if buddy not in self._free.get(width, ()):
+        hw = self.host_width
+        if width > hw:
+            h, k = offset // hw, width // hw
+            self._merge_hosts(h, k)
+            return
+        hi = self._host_of_offset(offset)
+        free = self._local_free[hi]
+        while width < hw:  # merge with the free buddy (host-local)
+            rel = offset - hi * hw
+            buddy = hi * hw + (rel ^ width)
+            if buddy not in free.get(width, ()):
                 break
-            self._free[width].discard(buddy)
+            free[width].discard(buddy)
             offset = min(offset, buddy)
             width *= 2
-        self._free.setdefault(width, set()).add(offset)
+        if width == hw:
+            # the host is whole again: hand it back to the fleet level
+            self._local_free[hi] = {}
+            self._merge_hosts(hi, 1)
+        else:
+            free.setdefault(width, set()).add(offset)
+
+    def _merge_hosts(self, h: int, k: int) -> None:
+        nh = len(self.host_labels)
+        while k < nh:  # buddy merge in host units
+            buddy = h ^ k
+            if buddy not in self._free_hosts.get(k, ()):
+                break
+            self._free_hosts[k].discard(buddy)
+            h = min(h, buddy)
+            k *= 2
+        self._free_hosts.setdefault(k, set()).add(h)
 
     def free_width(self) -> int:
-        return sum(s * len(offs) for s, offs in self._free.items())
+        local = sum(s * len(offs)
+                    for free in self._local_free
+                    for s, offs in free.items())
+        fleet = sum(s * len(offs) * self.host_width
+                    for s, offs in self._free_hosts.items())
+        return local + fleet
 
     def largest_free(self) -> int:
-        avail = [s for s, offs in self._free.items() if offs]
+        local = [s for free in self._local_free
+                 for s, offs in free.items() if offs]
+        fleet = [s * self.host_width
+                 for s, offs in self._free_hosts.items() if offs]
+        avail = local + fleet
         return max(avail) if avail else 0
+
+    def per_host_free(self) -> Dict:
+        """Free device count per host label (the fleet-utilization
+        view bench's multihost smoke and operators read)."""
+        out = {h: 0 for h in self.host_labels}
+        for hi, free in enumerate(self._local_free):
+            out[self.host_labels[hi]] += sum(
+                s * len(offs) for s, offs in free.items())
+        for s, offs in self._free_hosts.items():
+            for h in offs:
+                for hi in range(h, h + s):
+                    out[self.host_labels[hi]] += self.host_width
+        return out
 
 
 class _JobRuntime:
@@ -169,7 +326,7 @@ class Scheduler:
     def __init__(self, store, devices=None, step_budget: int = 4,
                  trace=None, recover: bool = True,
                  batch_lanes: Optional[int] = None,
-                 batch_wait: Optional[float] = None):
+                 batch_wait: Optional[float] = None, hosts=None):
         from .batch import DEFAULT_LANES, DEFAULT_MAX_WAIT
         self._store = store if isinstance(store, JobStore) \
             else JobStore(store)
@@ -182,6 +339,9 @@ class Scheduler:
             self._store.service_trace_path if trace is None else trace,
             engine="service")
         self._devices = None if devices is None else list(devices)
+        #: per-device host labels (simulated fleets / real
+        #: process_index grouping) — the two-level pool's second level
+        self._hosts = None if hosts is None else list(hosts)
         self._pool: Optional[DevicePool] = None
         # --- batch lane engine (service/batch.py): same-bucket small
         # jobs coalesce in per-bucket queues and run as lanes of ONE
@@ -371,7 +531,8 @@ class Scheduler:
             if self._devices is None:
                 import jax
                 self._devices = list(jax.devices())
-            self._pool = DevicePool(self._devices)
+            self._pool = DevicePool(self._devices, hosts=self._hosts)
+            self._metrics.set("hosts", self._pool.host_count)
 
     # --- batch lane engine plumbing (service/batch.py) -----------------
     def _batch_rt_for(self, job_id: str) -> Optional[_BatchRuntime]:
@@ -660,9 +821,11 @@ class Scheduler:
             driver = StepDriver(checker).start()
             rt.driver = driver
             job.set_state(jobstates.RUNNING, granted_width=lease.width,
-                          resume=resumed)
+                          resume=resumed,
+                          hosts=[str(h) for h in lease.hosts])
             self._trace.emit("job_resume" if resumed else "job_start",
-                             job=job.id, width=lease.width)
+                             job=job.id, width=lease.width,
+                             hosts=[str(h) for h in lease.hosts])
             delay = job.spec.step_delay
             while True:
                 ctl = rt.take_control()

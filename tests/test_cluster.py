@@ -1,0 +1,351 @@
+"""Fleet mesh: multi-host ``jax.distributed`` checking over DCN
+(stateright_tpu/cluster + the sharded engine on a global mesh).
+
+The load-bearing guarantees:
+
+* **cross-process parity** — a 2-process CPU mesh (launcher-spawned
+  subprocesses, per-process device forcing like
+  ``__graft_entry__.dryrun_multichip``) enumerates a fingerprint set
+  and discovery list BIT-IDENTICAL (sha256 digest) to the same model
+  on a single-process mesh;
+* **cross-process resume** — the shard-agnostic checkpoint format now
+  spans *process* boundaries: a checkpoint written by the 2-process
+  mesh resumes on a single process (and vice versa, ``-m slow``) to
+  the identical fingerprint set;
+* **host rung** — on a multi-host mesh the degradation ladder drops a
+  blamed chip's ENTIRE host (the surviving mesh never straddles the
+  dead host), re-routing by ``owner_of(fp, D/2)`` exactly like the
+  chip rung — bit-identical to an uninterrupted single-host run;
+* **owner_of width guard** — the D <= 256 top-bit assumption
+  (``checker/resilience.py`` SPILL_PREFIX_BITS nesting) raises with an
+  actionable message instead of silently mis-routing;
+* obs: ``mesh_init`` / ``host_join`` / ``host_drop`` are schema-valid
+  and ``tools/trace_report.py`` renders the ``fleet:`` summary.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCH = os.path.join(REPO, "tools", "mesh_launch.py")
+
+#: pinned engine shapes shared with tests/test_service.py and
+#: tests/test_resilience.py (persistent compile cache reuse)
+OPTS = {"capacity": 1 << 12, "fmax": 64, "chunk_steps": 2}
+
+
+def _digest(fps) -> str:
+    fps = sorted(int(f) for f in fps)
+    return hashlib.sha256("\n".join(map(str, fps)).encode()).hexdigest()
+
+
+def _mesh(n):
+    devices = jax.devices()
+    if len(devices) < n:
+        pytest.skip(f"need {n} devices, have {len(devices)}")
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices[:n]), ("shards",))
+
+
+def _run_launcher(out_dir, *extra, timeout=300):
+    """Coordinator-mode tools/mesh_launch.py; returns rank 0's result."""
+    cmd = [sys.executable, LAUNCH, "--procs", "2",
+           "--devices-per-proc", "2", "--model", "twopc", "--args",
+           "3", "--capacity", "4096", "--fmax", "64", "--chunk-steps",
+           "2", "--out", str(out_dir)] + list(extra)
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    line = proc.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+@pytest.fixture(scope="module")
+def solo_2pc3():
+    """The oracle: an uninterrupted single-chip run."""
+    return (TwoPhaseSys(3).checker()
+            .tpu_options(race=False, **OPTS).spawn_tpu().join())
+
+
+# --- owner_of width guard ---------------------------------------------
+
+class TestOwnerGuard:
+    def test_owner_of_within_limit(self):
+        from stateright_tpu.parallel.sharded import owner_of
+        fp = 0xDEADBEEF12345678
+        assert owner_of(fp, 1) == 0
+        assert owner_of(fp, 256) == fp >> 56
+
+    def test_owner_of_past_limit_raises_naming_the_width(self):
+        from stateright_tpu.parallel.sharded import owner_of
+        with pytest.raises(ValueError, match="256-shard limit"):
+            owner_of(0x1, 512)
+        with pytest.raises(ValueError, match="SPILL_PREFIX_BITS"):
+            owner_of(0x1, 1 << 12)
+
+    def test_limit_is_locked_to_the_spill_prefix(self):
+        # the guard exists BECAUSE eviction ranges (top-8-bit
+        # prefixes) must nest inside owner_of's top-bit routing; the
+        # two constants must move in lockstep
+        from stateright_tpu.checker.resilience import SPILL_PREFIX_BITS
+        from stateright_tpu.parallel.sharded import MAX_MESH_SHARDS
+        assert MAX_MESH_SHARDS == 1 << SPILL_PREFIX_BITS
+
+    def test_chunk_build_guards_too(self):
+        from stateright_tpu.parallel.sharded import _owner_bits
+        assert _owner_bits(256) == 8
+        with pytest.raises(ValueError, match="256"):
+            _owner_bits(512)
+
+
+# --- fleet mesh construction ------------------------------------------
+
+class TestFleetMesh:
+    def test_single_process_is_one_host(self):
+        from stateright_tpu.cluster import fleet_mesh, mesh_hosts
+        mesh = fleet_mesh(devices=jax.devices()[:4])
+        assert mesh.shape["shards"] == 4
+        assert set(mesh_hosts(mesh)) == {0}
+
+    def test_host_map_trims_per_host_and_orders_host_major(self):
+        # two simulated hosts of 3 devices each: per-host pow2 floor
+        # is 2, so the fleet mesh is 4 wide and host-major
+        from stateright_tpu.cluster import (device_host, fleet_mesh,
+                                            mesh_hosts)
+        devs = jax.devices()[:6]
+        host_map = {d.id: ("a" if i < 3 else "b")
+                    for i, d in enumerate(devs)}
+        mesh = fleet_mesh(devices=devs, host_map=host_map)
+        assert mesh.shape["shards"] == 4
+        labels = mesh_hosts(mesh, host_map)
+        assert labels == ["a", "a", "b", "b"]
+        assert device_host(devs[0], host_map) == "a"
+        assert device_host(devs[0]) == 0  # process_index fallback
+
+    def test_pull_global_is_plain_device_get_on_one_process(self):
+        from stateright_tpu.cluster import pull_global
+        mesh = _mesh(2)
+        import jax.numpy as jnp
+        a, b = pull_global((jnp.arange(4), np.int32(7)), mesh)
+        assert list(a) == [0, 1, 2, 3] and int(b) == 7
+
+
+# --- the degradation ladder's host rung -------------------------------
+
+class TestHostRung:
+    @pytest.mark.faults
+    def test_blamed_chip_drops_its_whole_host(self):
+        # D=4 across two simulated hosts (a: devices 0,1 / b: 2,3); a
+        # permanent fault blaming device 1 must drop ALL of host a —
+        # the chip rung would keep {0, 2}, straddling the dead host —
+        # and finish bit-identical to an uninterrupted single-host D=2
+        # run. This is the service-facing acceptance: a D=4-across-2-
+        # hosts run resumes on one host.
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("need 4 devices")
+        host_map = {d.id: ("a" if d.id < 2 else "b")
+                    for d in devs[:4]}
+
+        def hook(chunk, shards):
+            if shards > 2:
+                raise RuntimeError(
+                    "UNAVAILABLE: device 1 fell off the mesh "
+                    "(injected)")
+
+        trace = []
+        faulty = (TwoPhaseSys(3).checker()
+                  .tpu_options(race=False, **OPTS, mesh=_mesh(4),
+                               retries=1, backoff=0.0,
+                               fault_hook=hook, host_map=host_map,
+                               trace=trace)
+                  .spawn_tpu().join())
+        clean = (TwoPhaseSys(3).checker()
+                 .tpu_options(race=False, **OPTS, mesh=_mesh(2))
+                 .spawn_tpu().join())
+        assert faulty.unique_state_count() == clean.unique_state_count()
+        assert (faulty.generated_fingerprints()
+                == clean.generated_fingerprints())
+        assert set(faulty.discoveries()) == set(clean.discoveries())
+        # the surviving mesh is host b, whole — never {0, 2}
+        surv = sorted(d.id for d in faulty._mesh.devices.flat)
+        assert surv == [2, 3]
+        prof = faulty.profile()
+        assert prof["degrades"] == 1
+        assert prof["mesh_shards"] == 2
+        assert prof["hosts"] == 1  # dropped from 2
+        drops = [e for e in trace if e["ev"] == "host_drop"]
+        assert len(drops) == 1 and drops[0]["host"] == "a"
+        assert drops[0]["from_shards"] == 4
+        assert drops[0]["to_shards"] == 2
+        mesh_init = [e for e in trace if e["ev"] == "mesh_init"]
+        assert mesh_init and mesh_init[0]["hosts"] == 2
+        assert mesh_init[0]["procs"] == 1
+        from stateright_tpu.obs import validate_event
+        for ev in trace:
+            validate_event(ev)
+
+
+# --- 2-process CPU mesh: the acceptance pins --------------------------
+
+class TestMultiProcess:
+    def test_two_process_mesh_bit_identical_to_single_process(
+            self, tmp_path, solo_2pc3):
+        # launcher-spawned subprocesses, per-process CPU device
+        # forcing; the all-to-all spans the process boundary — and the
+        # fingerprint set + discovery list are pinned byte-identical
+        # (sha256) to a single-process mesh AND the single-chip oracle
+        result = _run_launcher(tmp_path / "fleet")
+        assert result["procs"] == 2
+        assert result["hosts"] == 2
+        assert result["shards"] == 4
+        single = (TwoPhaseSys(3).checker()
+                  .tpu_options(race=False, **OPTS, mesh=_mesh(4))
+                  .spawn_tpu().join())
+        want = _digest(single.generated_fingerprints())
+        assert result["fingerprints_sha256"] == want
+        assert want == _digest(solo_2pc3.generated_fingerprints())
+        assert result["unique"] == solo_2pc3.unique_state_count()
+        assert (result["discoveries"]
+                == sorted(solo_2pc3.discoveries()))
+        # fleet trace: both ranks joined, mesh_init landed, schema OK
+        from stateright_tpu.obs import validate_event
+        with open(tmp_path / "fleet" / "fleet.jsonl") as f:
+            fleet = [json.loads(line) for line in f if line.strip()]
+        for ev in fleet:
+            validate_event(ev)
+        assert sorted(e["host"] for e in fleet
+                      if e["ev"] == "host_join") == [0, 1]
+        assert any(e["ev"] == "mesh_init" and e["procs"] == 2
+                   for e in fleet)
+        # rank 0's engine trace carries the DCN probe
+        with open(tmp_path / "fleet" / "trace.jsonl") as f:
+            trace = [json.loads(line) for line in f if line.strip()]
+        for ev in trace:
+            validate_event(ev)
+        mi = [e for e in trace if e["ev"] == "mesh_init"]
+        assert mi and mi[0]["procs"] == 2 and mi[0]["hosts"] == 2
+        assert mi[0]["dcn_exchange_s"] is not None
+
+    def test_trace_report_renders_fleet_summary(self, tmp_path):
+        # reuses nothing: a tiny launcher round just for the renderer
+        # would cost another fleet spawn, so render from a synthetic
+        # trace carrying the real event shapes
+        trace = tmp_path / "fleet.jsonl"
+        evs = [
+            {"t": 0.1, "ev": "host_join", "engine": "fleet", "host": 0},
+            {"t": 0.2, "ev": "host_join", "engine": "fleet", "host": 1},
+            {"t": 0.3, "ev": "mesh_init", "engine": "fleet",
+             "shards": 4, "hosts": 2, "procs": 2,
+             "dcn_exchange_s": 0.0021},
+            {"t": 0.9, "ev": "host_drop", "engine": "fleet",
+             "host": 1, "from_shards": 4, "to_shards": 2},
+        ]
+        trace.write_text("\n".join(json.dumps(e) for e in evs) + "\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"),
+             str(trace), "--validate"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "fleet:" in proc.stdout
+        assert "procs=2" in proc.stdout
+        assert "hosts=2" in proc.stdout
+        assert "host_drops=['1']" in proc.stdout
+
+    def test_checkpoint_from_two_process_mesh_resumes_on_one(
+            self, tmp_path, solo_2pc3):
+        # the shard-agnostic checkpoint claim across PROCESS
+        # boundaries: a target-capped 2-process run saves (rank 0's
+        # checkpoint is canonical), a plain single-chip resume
+        # completes to the oracle's exact fingerprint set
+        result = _run_launcher(tmp_path / "fleet", "--target", "150",
+                               "--save")
+        assert result["unique"] < solo_2pc3.unique_state_count()
+        ckpt = tmp_path / "fleet" / "checkpoint.npz"
+        assert ckpt.exists()
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(race=False, **OPTS)
+                   .resume_from(str(ckpt))
+                   .spawn_tpu().join())
+        assert (_digest(resumed.generated_fingerprints())
+                == _digest(solo_2pc3.generated_fingerprints()))
+        assert (set(resumed.discoveries())
+                == set(solo_2pc3.discoveries()))
+
+    @pytest.mark.slow
+    def test_single_process_checkpoint_resumes_on_two_process_mesh(
+            self, tmp_path, solo_2pc3):
+        # the reverse direction: a single-chip capped save, resumed by
+        # the 2-process fleet to the identical fingerprint set
+        ckpt = tmp_path / "solo.npz"
+        capped = (TwoPhaseSys(3).checker()
+                  .tpu_options(race=False, **OPTS, resumable=True)
+                  .target_state_count(150)
+                  .spawn_tpu().join())
+        capped.save(str(ckpt))
+        result = _run_launcher(tmp_path / "fleet", "--resume",
+                               str(ckpt))
+        assert result["resumed"] is True
+        assert (result["fingerprints_sha256"]
+                == _digest(solo_2pc3.generated_fingerprints()))
+        assert (result["discoveries"]
+                == sorted(solo_2pc3.discoveries()))
+
+    @pytest.mark.slow
+    def test_two_process_parity_on_a_deeper_model(self, tmp_path):
+        # a heavier pin: 2pc n=4 (1,764 states) across the process
+        # boundary vs the single-chip oracle
+        solo = (TwoPhaseSys(4).checker()
+                .tpu_options(race=False, **OPTS).spawn_tpu().join())
+        result = _run_launcher(tmp_path / "fleet", "--model", "twopc",
+                               "--args", "4")
+        assert (result["fingerprints_sha256"]
+                == _digest(solo.generated_fingerprints()))
+        assert result["unique"] == solo.unique_state_count()
+
+
+# --- bench contract + bench_history tag --------------------------------
+
+class TestBenchMultihostSmoke:
+    def test_contract_line_lands_rc0(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--multihost-smoke"],
+            capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = proc.stdout.strip().splitlines()[-1]
+        contract = json.loads(line)
+        assert contract.get("partial") is None, contract
+        assert contract["hosts"] == 2
+        assert contract["procs"] == 2
+        assert contract["value"] and contract["value"] > 0
+        assert contract["mesh"]["unique"] == 288
+        # the two-level pool spread the width-1 jobs over BOTH hosts
+        assert sorted(contract["jobs_by_host"]) == ["h0", "h1"]
+        assert sum(contract["jobs_by_host"].values()) == 4
+
+    def test_bench_history_learns_the_multihost_tag(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import bench_history
+        finally:
+            sys.path.pop(0)
+        art = tmp_path / "BENCH_r90.json"
+        art.write_text(json.dumps({
+            "n": 1, "rc": 0, "tail": "",
+            "parsed": {"metric": "multihost smoke", "value": 104.6,
+                       "unit": "uniq/s", "hosts": 2, "procs": 2}}))
+        report = bench_history.build_report([str(art)])
+        row = report["rounds"][0]["workloads"][bench_history.CONTRACT]
+        assert "multihost" in row["tags"]

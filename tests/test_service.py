@@ -110,6 +110,105 @@ class TestDevicePool:
         assert pool.free_width() == 4
 
 
+# --- two-level pool: slices within hosts, hosts within the fleet ------
+
+class TestTwoLevelPool:
+    def test_two_hosts_carve_and_merge(self):
+        pool = DevicePool(list(range(8)), hosts=[0] * 4 + [1] * 4)
+        assert pool.host_count == 2 and pool.host_width == 4
+        assert pool.width == 8
+        # fleet-wide lease: both hosts, whole
+        l8 = pool.acquire(8)
+        assert l8.width == 8 and l8.hosts == (0, 1)
+        assert pool.acquire(1) is None
+        pool.release(l8)
+        assert pool.largest_free() == 8
+        # slice leases never straddle hosts; best-fit packs the
+        # partially-carved host first, preserving whole hosts
+        l2 = pool.acquire(2)
+        assert l2.hosts == (0,)
+        l4 = pool.acquire(4)
+        assert l4.hosts == (1,)  # host 0 is carved; host 1 goes whole
+        l2b = pool.acquire(2)
+        assert l2b.hosts == (0,)  # packs into host 0's remainder
+        assert pool.acquire(2) is None
+        for lease in (l2, l4, l2b):
+            pool.release(lease)
+        assert pool.largest_free() == 8  # both levels merged back
+
+    def test_wide_leases_take_whole_free_hosts_only(self):
+        pool = DevicePool(list(range(4)), hosts=[0, 0, 1, 1])
+        lone = pool.acquire(1)
+        assert lone.hosts == (0,)
+        # width == host_width needs a FULLY-FREE host, not host 0's
+        # fragmented remainder
+        l2 = pool.acquire(2)
+        assert l2.hosts == (1,)
+        assert l2.offset % l2.width == 0
+        assert pool.acquire(2) is None  # host 0 has 1 free, fragmented
+        assert pool.acquire(4) is None  # no fleet-wide block either
+        pool.release(lone)
+        pool.release(l2)
+        assert pool.acquire(4).hosts == (0, 1)
+
+    def test_unequal_hosts_trim_to_common_pow2(self):
+        # 3+3 devices: per-host floor 2, fleet width 4 — and a slice
+        # lease can never span the host boundary (the old flat floor
+        # of 6 -> 4 would have straddled it)
+        pool = DevicePool(list(range(6)), hosts=[0, 0, 0, 1, 1, 1])
+        assert pool.host_width == 2 and pool.width == 4
+        la = pool.acquire(2)
+        lb = pool.acquire(2)
+        spans = sorted([la.devices, lb.devices])
+        assert spans == [(0, 1), (3, 4)]
+
+    def test_plain_device_list_is_one_anonymous_host(self):
+        # hosts=None on non-jax objects: process_index defaults to 0,
+        # preserving the original single-level behavior
+        pool = DevicePool(list(range(8)))
+        assert pool.host_count == 1 and pool.host_width == 8
+        assert pool.acquire(8).hosts == (0,)
+
+    def test_per_host_free_accounting(self):
+        pool = DevicePool(list(range(8)), hosts=["a"] * 4 + ["b"] * 4)
+        assert pool.per_host_free() == {"a": 4, "b": 4}
+        lease = pool.acquire(2)
+        assert pool.per_host_free() == {"a": 2, "b": 4}
+        pool.release(lease)
+        assert pool.per_host_free() == {"a": 4, "b": 4}
+
+
+class TestTwoHostScheduler:
+    def test_grants_jobs_across_two_simulated_hosts(self, tmp_path,
+                                                    solo_2pc3):
+        # ACCEPTANCE: one scheduler packs jobs across the whole fleet —
+        # four width-1 jobs over a 2-host × 2-device pool land on BOTH
+        # hosts (recorded per job), every result bit-identical to the
+        # solo oracle, and the buddies merge back on completion
+        if len(jax.devices()) < 4:
+            pytest.skip("need 4 devices")
+        sched = Scheduler(JobStore(tmp_path),
+                          devices=jax.devices()[:4],
+                          hosts=["h0", "h0", "h1", "h1"])
+        jobs = [sched.submit(JobSpec("twopc", args=[3], options=OPTS))
+                for _ in range(4)]
+        by_host = {}
+        for job in jobs:
+            assert sched.wait(job.id, timeout=180.0) == "done"
+            result = job.read_result()
+            assert result["fingerprints_sha256"] == _digest(solo_2pc3)
+            for h in job.status["hosts"]:
+                by_host[h] = by_host.get(h, 0) + 1
+        assert by_host == {"h0": 2, "h1": 2}
+        prof = sched.profile()
+        assert prof["jobs_done"] == 4
+        assert prof["hosts"] == 2
+        # completion merged the carves back through both levels
+        assert sched._pool.largest_free() == 4
+        assert sched._pool.per_host_free() == {"h0": 2, "h1": 2}
+        sched.shutdown()
+
+
 # --- StepDriver: start -> step(budget) -> ... -> finish ---------------
 
 class TestStepDriver:

@@ -14,9 +14,10 @@ human reviewer noticed. This tool is the machine that notices:
   cap changes: ``tpu paxos3 capped 500k`` and ``... capped 40000`` are
   the same trend line), one column per round, each cell the best rate
   with its tags (``fused``/``staged``, ``degraded``,
-  ``init_fallback``) — so a round whose number was measured on a
-  degraded mesh or a CPU fallback can never silently ride the
-  trajectory as a device number;
+  ``init_fallback``, ``multihost``) — so a round whose number was
+  measured on a degraded mesh, a CPU fallback, or a DCN-spanning
+  fleet mesh can never silently ride the trajectory as a
+  single-host device number;
 * **flags** — machine-readable problems: empty artifacts (rc != 0,
   ``parsed: null``), partial contract lines, per-workload error rows,
   workloads that vanished between rounds, and regressions (best rate
@@ -160,6 +161,10 @@ def parse_round(path: str) -> Dict[str, Any]:
                 # through the lane engine (jobs_per_min rides the
                 # per-mode rows as their own trend lines)
                 ("storm", bool(contract.get("storm"))),
+                # a --multihost-smoke round: the value is uniq/s of a
+                # multi-process fleet mesh spanning DCN — not
+                # comparable to single-host device rates
+                ("multihost", bool(contract.get("hosts"))),
             ) if on)
         rnd["workloads"][CONTRACT] = {
             "name": contract.get("metric", "contract"),

@@ -1,0 +1,183 @@
+"""Launch a multi-host (multi-process) sharded checking run.
+
+Coordinator mode (no ``STPU_RANK`` in the environment):
+
+    python tools/mesh_launch.py --procs 2 --devices-per-proc 2 \\
+        --model twopc --args 3 --out /tmp/fleet [--capacity 4096]
+        [--fmax 64] [--chunk-steps 2] [--target N] [--save]
+        [--resume CKPT] [--timeout S]
+
+spawns ``--procs`` copies of itself as fleet ranks (CPU-forced with
+``--devices-per-proc`` virtual devices each — the ``dryrun_multichip``
+recipe, per process), watches them with abort fan-out, and prints rank
+0's ``result.json`` as one JSON line on stdout. Worker mode (launched
+by the coordinator; identity in ``STPU_*`` env vars) bootstraps
+``jax.distributed``, builds the host×device fleet mesh, and runs the
+named ``MODEL_REGISTRY`` model SPMD across the GLOBAL mesh — the
+fingerprint all-to-all exchange spans DCN between the processes.
+
+Artifacts (all under ``--out``): rank 0 owns ``result.json`` (unique
+count, sha256 fingerprint digest, discoveries, hosts/procs/shards),
+``trace.jsonl``, and — with ``--save`` — ``checkpoint.npz`` (the
+shard-agnostic format: resumable on ANY mesh, including a single
+process); every rank writes ``rank<k>.log`` / ``rank<k>.ready``; the
+coordinator writes ``fleet.jsonl`` (``host_join`` per rank +
+``mesh_init``, rendered by ``tools/trace_report.py`` as ``fleet:``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--devices-per-proc", type=int, default=2)
+    ap.add_argument("--model", default="twopc")
+    ap.add_argument("--args", nargs="*", type=int, default=[3])
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--capacity", type=int, default=1 << 12)
+    ap.add_argument("--fmax", type=int, default=64)
+    ap.add_argument("--chunk-steps", type=int, default=2)
+    ap.add_argument("--target", type=int, default=None)
+    ap.add_argument("--save", action="store_true",
+                    help="write a resume_from-loadable checkpoint at "
+                         "the end (pair with --target to checkpoint "
+                         "mid-search)")
+    ap.add_argument("--resume", default=None,
+                    help="resume from a checkpoint (any mesh width "
+                         "wrote it)")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    return ap.parse_args(argv)
+
+
+def worker_main(args, ctx) -> int:
+    """One rank: bootstrap is done (``ctx``); build the global mesh,
+    run the model, land rank-0 artifacts."""
+    import jax
+
+    from stateright_tpu.cluster.mesh import fleet_mesh
+    from stateright_tpu.service.jobs import build_model
+
+    rank = ctx.rank
+    out = args.out
+    mesh = fleet_mesh("shards")
+    ready = {
+        "rank": rank,
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "shards": int(mesh.shape["shards"]),
+    }
+    with open(os.path.join(out, f"rank{rank}.ready"), "w") as f:
+        json.dump(ready, f)
+
+    model = build_model(args.model, list(args.args), {})
+    builder = (model.checker()
+               .tpu_options(race=False, mesh=mesh,
+                            capacity=args.capacity, fmax=args.fmax,
+                            chunk_steps=args.chunk_steps))
+    if rank == 0:
+        builder.tpu_options(trace=os.path.join(out, "trace.jsonl"))
+    if args.save:
+        builder.tpu_options(resumable=True)
+    if args.target:
+        builder.target_state_count(args.target)
+    if args.resume:
+        builder.resume_from(args.resume)
+    t0 = time.perf_counter()
+    checker = builder.spawn_tpu().join()
+    secs = time.perf_counter() - t0
+    # COLLECTIVE pulls (mirror, frontier): every rank must take them,
+    # in the same order — only the file writes are rank-0-owned
+    fps = sorted(int(f) for f in checker.generated_fingerprints())
+    digest = hashlib.sha256(
+        "\n".join(map(str, fps)).encode()).hexdigest()
+    if args.save:
+        # the checkpoint save pulls nothing sharded (the resumable
+        # frontier was pulled collectively during the run), but every
+        # rank writing keeps the host loops symmetric anyway; rank 0's
+        # name is the canonical one
+        name = ("checkpoint.npz" if rank == 0
+                else f"rank{rank}.checkpoint.npz")
+        checker.save(os.path.join(out, name))
+    if rank == 0:
+        prof = checker.profile()
+        result = {
+            "model": args.model,
+            "args": list(args.args),
+            "unique": checker.unique_state_count(),
+            "state_count": checker.state_count(),
+            "fingerprints_sha256": digest,
+            "discoveries": sorted(checker.discoveries()),
+            "secs": round(secs, 4),
+            "uniq_per_s": round(len(fps) / max(secs, 1e-9), 1),
+            "procs": int(jax.process_count()),
+            "hosts": int(prof.get("hosts", 1)),
+            "shards": int(mesh.shape["shards"]),
+            "resumed": bool(args.resume),
+        }
+        tmp = os.path.join(out, "result.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+        os.replace(tmp, os.path.join(out, "result.json"))
+    return 0
+
+
+def coordinator_main(args) -> int:
+    from stateright_tpu.cluster.launch import launch_fleet, pick_port
+    from stateright_tpu.obs import make_trace
+
+    out = args.out
+    os.makedirs(out, exist_ok=True)
+    trace = make_trace(os.path.join(out, "fleet.jsonl"),
+                       engine="fleet")
+    coordinator = f"127.0.0.1:{pick_port()}"
+    cmd = [sys.executable, os.path.abspath(__file__)] + [
+        a for a in sys.argv[1:]]
+    t0 = time.perf_counter()
+    res = launch_fleet(cmd, args.procs,
+                       local_devices=args.devices_per_proc, cpu=True,
+                       coordinator=coordinator, out_dir=out,
+                       timeout=args.timeout, trace=trace)
+    result_path = os.path.join(out, "result.json")
+    if res.ok and os.path.isfile(result_path):
+        with open(result_path) as f:
+            result = json.load(f)
+        trace.emit("mesh_init", shards=result.get("shards"),
+                   hosts=result.get("hosts"),
+                   procs=result.get("procs"),
+                   wall=round(time.perf_counter() - t0, 4))
+        trace.close()
+        print(json.dumps(result))
+        return 0
+    trace.close()
+    detail = res.aborted or f"returncodes={res.returncodes}"
+    print(json.dumps({"error": f"fleet failed: {detail}",
+                      "returncodes": res.returncodes}))
+    for rank in range(args.procs):
+        tail = res.tail(rank)
+        if tail:
+            sys.stderr.write(f"--- rank {rank} log tail ---\n{tail}\n")
+    return 1
+
+
+def main(argv) -> int:
+    args = parse_args(argv)
+    from stateright_tpu.cluster.mesh import init_from_env
+    ctx = init_from_env()
+    if ctx is not None:
+        return worker_main(args, ctx)
+    return coordinator_main(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

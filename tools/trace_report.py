@@ -26,6 +26,10 @@ found in the trace:
     timestamps — on a flaky round this table says *where* the tunnel
     dropped, what the engine did about it, and whether an autosave
     landed;
+  * a fleet summary line (process/host counts, the DCN round-trip
+    probe, ranks joined, hosts dropped by the ladder's host rung) when
+    the trace came from a multi-host mesh or the fleet launcher
+    (``tools/mesh_launch.py``);
   * a memory-tiering summary line (spills, keys evicted to the host
     tier, the tier population and hot-set size after the last spill)
     when the run hit its HBM budget;
@@ -162,7 +166,8 @@ def report(events, out=None):
                    "crash", "restart", "partition",
                    "job_submit", "job_start", "job_pause",
                    "job_resume", "job_done",
-                   "bucket_flush", "batch_form", "lane_retire")]
+                   "bucket_flush", "batch_form", "lane_retire",
+                   "mesh_init", "host_join", "host_drop")]
         if inters:
             out.write("\ninterventions:\n")
             for ev in inters:
@@ -194,6 +199,32 @@ def report(events, out=None):
                 parts.append(
                     f"final_mesh={degrades[-1]['to_shards']}")
             out.write("\nresilience: " + " ".join(parts) + "\n")
+
+        # fleet summary (stateright_tpu/cluster + multi-host meshes):
+        # the mesh's host/process decomposition, the DCN round-trip
+        # probe, which ranks joined, and any hosts the degradation
+        # ladder dropped mid-run
+        mesh_evs = [e for e in evs if e["ev"] == "mesh_init"]
+        joins = [e for e in evs if e["ev"] == "host_join"]
+        drops = [e for e in evs if e["ev"] == "host_drop"]
+        if mesh_evs or joins or drops:
+            parts = []
+            if mesh_evs:
+                last = mesh_evs[-1]
+                parts += [f"procs={last.get('procs')}",
+                          f"hosts={last.get('hosts')}",
+                          f"shards={last.get('shards')}"]
+                if last.get("dcn_exchange_s") is not None:
+                    parts.append(
+                        f"dcn_exchange_s={last['dcn_exchange_s']}")
+            if joins:
+                parts.append(
+                    f"joined={sorted(e.get('host') for e in joins)}")
+            if drops:
+                parts.append(
+                    "host_drops="
+                    f"{sorted((str(e.get('host')) for e in drops))}")
+            out.write("\nfleet: " + " ".join(parts) + "\n")
 
         # memory-tiering summary: how the run survived its HBM budget —
         # spills taken, keys evicted to the host tier, and the tier
