@@ -260,6 +260,13 @@ class Checker:
                 parts.append(f"mesh={int(prof['mesh_shards'])}")
             if "fault_device" in prof:
                 parts.append(f"fault_device={int(prof['fault_device'])}")
+        if prof.get("fused_unsupported"):
+            # a fused='auto' run stayed staged because the config is
+            # outside the kernel's support matrix — name the reason
+            # (also a one-time fused_unsupported trace event)
+            reason = getattr(self, "_fused_unsupported_reason", None)
+            parts.append("fused=unsupported"
+                         + (f" ({reason})" if reason else ""))
         if elapsed > 0 and "sync_stall" in prof:
             parts.append(f"stall={prof['sync_stall'] / elapsed:.0%}")
         if elapsed > 0 and "host_overlap" in prof:

@@ -217,7 +217,8 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                    symmetry: bool = False, sound: bool = False,
                    hcap: int = 0, n_init: int = 0, kraw: int = 0,
                    hint_eff: int = 0, ecap: int = 0,
-                   fused: bool = False, fused_interpret: bool = False):
+                   fused: bool = False, fused_interpret: bool = False,
+                   cc: int = 0):
     """Compile the K-level chunk runner for fixed buffer shapes.
 
     Returned callable: ``chunk(carry, target_remaining, grow_limit,
@@ -248,14 +249,14 @@ def build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
     """
     mkey = model_cache_key(model)
     key = (mkey, qcap, capacity, fmax, kmax, symmetry, sound, hcap,
-           n_init, kraw, hint_eff, ecap, fused, fused_interpret)
+           n_init, kraw, hint_eff, ecap, fused, fused_interpret, cc)
     if mkey is not None:
         cached = _CHUNK_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_chunk_fn(model, qcap, capacity, fmax, kmax, symmetry,
                          sound, hcap, n_init, kraw, hint_eff, ecap,
-                         fused, fused_interpret)
+                         fused, fused_interpret, cc)
     if mkey is not None:
         _CHUNK_CACHE[key] = fn
     return fn
@@ -265,19 +266,22 @@ def _build_chunk_fn(model, qcap: int, capacity: int, fmax: int, kmax: int,
                     symmetry: bool, sound: bool = False, hcap: int = 0,
                     n_init: int = 0, kraw: int = 0, hint_eff: int = 0,
                     ecap: int = 0, fused: bool = False,
-                    fused_interpret: bool = False):
+                    fused_interpret: bool = False, cc: int = 0):
     return jax.jit(
         build_chunk_core(model, qcap, capacity, fmax, kmax, symmetry,
                          sound, hcap, n_init, kraw, hint_eff, ecap,
-                         fused, fused_interpret),
-        donate_argnums=(0,))
+                         fused, fused_interpret, cc),
+        # the fused+cc chunk additionally donates the cross-chunk ring
+        # halves it threads through (args 1 and 2)
+        donate_argnums=(0, 1, 2) if (fused and cc) else (0,))
 
 
 def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
                      kmax: int, symmetry: bool, sound: bool = False,
                      hcap: int = 0, n_init: int = 0, kraw: int = 0,
                      hint_eff: int = 0, ecap: int = 0,
-                     fused: bool = False, fused_interpret: bool = False):
+                     fused: bool = False, fused_interpret: bool = False,
+                     cc: int = 0):
     """The UN-jitted chunk program: ``chunk(carry, target_remaining,
     grow_limit, h_base) -> (carry, stats)``. ``build_chunk_fn`` wraps
     it in the solo engines' donating ``jax.jit``; the batch engine
@@ -289,6 +293,8 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
         # sound / host-property / hint configs to the staged build
         assert not sound and not hcap and not hint_eff and not ecap, \
             "fused chunk build outside its support matrix"
+    else:
+        assert not cc, "cc dedup ring is a fused-path structure"
     n_actions = model.max_actions
     width = model.packed_width
     properties = model.properties()
@@ -345,7 +351,11 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
 
     def make_cond(lo_water, hi_water):
         def cond(state):
-            c, target_remaining, grow_limit = state
+            # the fused+cc state threads (carry, ring_hi, ring_lo, cch)
+            # ahead of the scalars; index from both ends so one cond
+            # covers both layouts
+            c, target_remaining, grow_limit = (state[0], state[-2],
+                                               state[-1])
             avail = c.q_tail - c.q_head
             # [lo, hi] is the loop's frontier-size window: the small loop
             # (hi = fmax_small) yields once the frontier outgrows it, the
@@ -623,23 +633,32 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
 
     def make_fused_step(fmax_b: int):
         """The fused analog of ``make_step``: ONE Pallas kernel
-        (ops/fused.py) expands, fingerprints, pre-dedups AND probes the
-        visited table — duplicate lanes die inside the kernel, so there
-        is no kraw/kmax candidate staging (and no kovf protocol: appends
-        gather the fresh-lane mask at the raw F*A width, covered by the
-        fa queue margin). Everything after the kernel — discovery
-        registers, the candidate-matrix assembly for the two block
-        appends — is the staged code on the kernel's outputs."""
-        from ..ops.expand import Expansion
+        (ops/fused.py) expands, fingerprints, evaluates the property
+        predicates (discovery lanes flagged in-register — only the
+        per-property sticky registers leave the kernel), pre-dedups
+        (against the in-batch arena AND the cross-chunk recent-key
+        ring, when ``cc``) and probes the visited table — duplicate
+        lanes die inside the kernel, so there is no kraw/kmax candidate
+        staging (and no kovf protocol: appends gather the fresh-lane
+        mask at the raw F*A width, covered by the fa queue margin).
+        Everything after the kernel — the sticky discovery merge, the
+        candidate-matrix assembly for the two block appends — is the
+        staged code on the kernel's outputs."""
         from ..ops.fused import build_fused_block_fn
 
         blk = build_fused_block_fn(model, fmax_b, capacity,
                                    symmetry=symmetry, probe=True,
-                                   interpret=fused_interpret)
+                                   interpret=fused_interpret,
+                                   props=bool(prop_count), cc=cc)
         fa_b = fmax_b * n_actions
 
         def step(state):
-            c, target_remaining, grow_limit = state
+            if cc:
+                (c, rhi, rlo, cch, target_remaining,
+                 grow_limit) = state
+            else:
+                c, target_remaining, grow_limit = state
+                rhi = rlo = None
             sl = jax.lax.dynamic_slice(
                 c.q, (c.q_head, 0), (fmax_b, width + 3))
             frontier = sl[:, :width]
@@ -648,24 +667,22 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
             take = jnp.minimum(c.q_tail - c.q_head, fmax_b)
             fvalid = jnp.arange(fmax_b, dtype=jnp.int32) < take
 
-            out = blk(frontier, ebits, fvalid, c.key_hi, c.key_lo)
+            out = blk(frontier, ebits, fvalid, c.key_hi, c.key_lo,
+                      pfp=(phi, plo) if prop_count else None,
+                      ring=(rhi, rlo) if cc else None)
             vcount = out.cvalid.sum(dtype=jnp.int32)
             dcount = out.dvalid.sum(dtype=jnp.int32)
             cnt = out.inserted.sum(dtype=jnp.int32)
 
             disc_hit, disc_hi, disc_lo = c.disc_hit, c.disc_hi, c.disc_lo
             if prop_count:
-                exp_like = Expansion(
-                    pbits=out.pbits, ebits=out.ebits, flat=None,
-                    avalid=None, cvalid=None, chi=None, clo=None,
-                    ohi=None, olo=None, phi=phi, plo=plo,
-                    terminal=out.terminal, xovf=out.xovf)
-                new_hit, cand_hi, cand_lo = discovery_candidates(
-                    properties, exp_like, fvalid, whi=phi, wlo=plo)
-                keep = disc_hit | ~new_hit
-                disc_hi = jnp.where(keep, disc_hi, cand_hi)
-                disc_lo = jnp.where(keep, disc_lo, cand_lo)
-                disc_hit = disc_hit | new_hit
+                # in-kernel property eval: the kernel's per-call sticky
+                # registers merge into the carry with the same
+                # first-hit-wins rule the staged path uses
+                keep = disc_hit | ~out.disc_hit
+                disc_hi = jnp.where(keep, disc_hi, out.disc_hi)
+                disc_lo = jnp.where(keep, disc_lo, out.disc_lo)
+                disc_hit = disc_hit | out.disc_hit
 
             # parent-side columns broadcast along the action axis;
             # assemble_candidates keeps the staged column layout so the
@@ -683,7 +700,7 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
             log = jax.lax.dynamic_update_slice(
                 c.log, n_all[:, log_off:log_off + c.log.shape[1]],
                 (c.log_n, 0))
-            return c._replace(
+            nc = c._replace(
                 q=q, q_head=c.q_head + take, q_tail=c.q_tail + cnt,
                 key_hi=out.key_hi, key_lo=out.key_lo,
                 log=log, log_n=c.log_n + cnt,
@@ -694,8 +711,16 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
                 steps=c.steps - 1,
                 vmax=jnp.maximum(c.vmax, vcount),
                 dmax=jnp.maximum(c.dmax, dcount),
-                pdh=c.pdh + (vcount - dcount),
+                # dvalid already excludes ring hits, so the in-batch
+                # share is (raw - survivors - ring hits) — keeps
+                # predup_hits bit-identical to the staged counter while
+                # cc_dedup_hits rides its own stats slot
+                pdh=c.pdh + (vcount - dcount - out.cch),
                 prb=c.prb + out.rounds)
+            if cc:
+                return (nc, out.ring_hi, out.ring_lo, cch + out.cch,
+                        target_remaining, grow_limit)
+            return nc
         return step
 
     # thin BFS frontiers (a few hundred pending states) are common at the
@@ -723,16 +748,16 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
             step_small = make_step(fmax_small, kraw_small,
                                    min(kmax_small, kraw_small))
 
+    full_state = bool(fused and cc)
+
     def make_body(step):
+        if full_state:
+            return step  # the fused+cc step returns the whole state
         def body(state):
             return (step(state), state[1], state[2])
         return body
 
-    def chunk(carry: ChunkCarry, target_remaining, grow_limit, h_base):
-        # h_base anchors the representative window at the host's pulled
-        # count (NOT this launch's entry h_n), covering everything the
-        # whole small/large loop sequence logged
-        state = (carry, target_remaining, grow_limit)
+    def run_loops(state):
         imax = jnp.int32(2**31 - 1)
         if two_size:
             # outer loop over the [small-loop, large-loop] pair: a
@@ -749,13 +774,13 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
                 return jax.lax.while_loop(
                     make_cond(*large), make_body(step_large), state)
 
-            state = jax.lax.while_loop(
+            return jax.lax.while_loop(
                 make_cond(jnp.int32(0), imax), outer_body, state)
-        else:
-            state = jax.lax.while_loop(
-                make_cond(jnp.int32(0), imax),
-                make_body(step_large), state)
-        out, _, _ = state
+        return jax.lax.while_loop(
+            make_cond(jnp.int32(0), imax),
+            make_body(step_large), state)
+
+    def base_stats(out):
         # ALL host-read scalars packed into ONE uint32 vector: on a
         # tunneled device every device->host transfer is a round trip
         # (profiler-measured ~10-60 ms each), and a per-leaf device_get
@@ -764,12 +789,13 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
         # [q_head, q_tail, log_n, gen, ovf, xovf, kovf, h_n, hovf,
         #  vmax, dmax, rmax, e_n, pdh, prb,
         #  disc_hit[P], disc_hi[P], disc_lo[P],
-        #  recent queue row (W+3), hist window (hist_on only)]
+        #  recent queue row (W+3),
+        #  then hist window (hist_on) | cc ring hits (fused+cc)]
         # the most recently enqueued state's queue row rides the sync
         # for free (the Explorer decodes it as live progress — the
         # chunk loop has no per-state visitation to sample from)
         recent = out.q[jnp.maximum(out.q_tail - 1, 0)]
-        stats = jnp.concatenate([
+        return jnp.concatenate([
             jnp.stack([out.q_head, out.q_tail, out.log_n, out.gen,
                        out.ovf.astype(jnp.int32),
                        out.xovf.astype(jnp.int32),
@@ -780,6 +806,33 @@ def build_chunk_core(model, qcap: int, capacity: int, fmax: int,
                        out.e_n, out.pdh, out.prb]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo, recent])
+
+    if full_state:
+        def chunk_cc(carry: ChunkCarry, ring_hi, ring_lo,
+                     target_remaining, grow_limit, h_base):
+            # the cross-chunk dedup ring threads OUTSIDE ChunkCarry:
+            # adding carry fields would change the STAGED programs'
+            # traced signatures and invalidate the persistent compile
+            # cache for the whole non-fused matrix (the seed_carry
+            # 5-arg caveat, CHANGES.md PR 9). cch (ring hits) is
+            # chunk-local telemetry — re-zeroed per dispatch — and
+            # rides the stats vector as one trailing element.
+            state = run_loops((carry, ring_hi, ring_lo, jnp.int32(0),
+                               target_remaining, grow_limit))
+            out, rhi, rlo, cch = state[0], state[1], state[2], state[3]
+            stats = jnp.concatenate([
+                base_stats(out),
+                jnp.reshape(cch, (1,)).astype(jnp.uint32)])
+            return out, rhi, rlo, stats
+        return chunk_cc
+
+    def chunk(carry: ChunkCarry, target_remaining, grow_limit, h_base):
+        # h_base anchors the representative window at the host's pulled
+        # count (NOT this launch's entry h_n), covering everything the
+        # whole small/large loop sequence logged
+        state = run_loops((carry, target_remaining, grow_limit))
+        out = state[0]
+        stats = base_stats(out)
         if not hist_on:
             return out, stats
         # window over the representatives logged this chunk: rides the

@@ -350,6 +350,28 @@ class TpuChecker(HostChecker):
             raise ValueError(
                 f"unknown tpu_options fused {self._fused_mode!r}; "
                 "expected True, False, or 'auto'")
+        # cross-chunk in-kernel dedup tier (ops/fused.py): a small
+        # device-resident recent-key ring probed before the main table,
+        # killing the re-expanded duplicates in-batch dedup cannot see
+        # (2pc7's ~9x gen/uniq). True = default capacity, an int = ring
+        # slots (power of two), False = off. Rides the fused path only.
+        cc_opt = opts.get("cc_dedup", True)
+        if cc_opt is True:
+            from ..ops.fused import CC_DEFAULT
+            self._cc_cap = CC_DEFAULT
+        elif cc_opt is False:
+            self._cc_cap = 0
+        else:
+            self._cc_cap = int(cc_opt)
+            if self._cc_cap & (self._cc_cap - 1) or self._cc_cap < 4:
+                raise ValueError(
+                    f"tpu_options(cc_dedup={cc_opt!r}) must be True, "
+                    "False, or a power-of-two slot count >= 4 (the "
+                    "ring is direct-mapped by the fingerprint hash)")
+        #: why a fused='auto' run stayed staged (None when it fused or
+        #: was never eligible to) — surfaced by report()'s metrics line
+        #: next to the fused_unsupported gauge
+        self._fused_unsupported_reason = None
         # host-evaluated properties (e.g. the linearizability search):
         # declared by the model, evaluated per level on newly inserted
         # states, memoized by model.host_property_key(row)
@@ -517,12 +539,17 @@ class TpuChecker(HostChecker):
 
     # --- fused-kernel selection (ops/fused.py) -------------------------
     def _fused_resolve(self, *, sharded: bool, fmax: int,
-                       capacity: int) -> "tuple":
+                       capacity: int, probe_lanes: int = 0) -> "tuple":
         """Resolve ``tpu_options(fused=...)`` into ``(on, interpret)``.
 
-        ``'auto'``: configurations outside the support matrix quietly
-        stay staged; on a TPU backend the build is attempted via
-        ``ops.fused.verify_build`` (memoized) and ANY failure is
+        ``'auto'``: configurations outside the support matrix stay
+        staged — announced by a one-time ``fused_unsupported`` trace
+        event naming the reason plus the ``fused_unsupported`` gauge
+        (so profile()/report() say WHY a run didn't fuse, instead of
+        quietly downgrading); on a TPU backend the build is attempted
+        via ``ops.fused.verify_build`` (and, sharded, the owner-side
+        probe kernel via ``verify_probe_build``, timed under the
+        ``probe_kernel_s`` metric; both memoized) and ANY failure is
         classified through the resilience taxonomy, counted
         (``fused_fallbacks``) and traced (``fused_fallback`` event) —
         never a hard error. Off-TPU, 'auto' resolves to staged without
@@ -546,6 +573,12 @@ class TpuChecker(HostChecker):
                 raise ValueError(
                     f"tpu_options(fused=True) is unsupported for this "
                     f"configuration: {reason}")
+            # satellite: say WHY the run stayed staged, once per run
+            if self._fused_unsupported_reason is None:
+                self._fused_unsupported_reason = reason
+                self._metrics.set("fused_unsupported", 1)
+                if self._trace:
+                    self._trace.emit("fused_unsupported", reason=reason)
             return False, False
         import jax
         interpret = jax.default_backend() != "tpu"
@@ -557,7 +590,16 @@ class TpuChecker(HostChecker):
             fused_mod.verify_build(self._model, fmax, capacity,
                                    symmetry=self._symmetry,
                                    probe=not sharded,
-                                   interpret=interpret)
+                                   interpret=interpret,
+                                   props=bool(self._properties),
+                                   cc=self._cc_cap)
+            if sharded and probe_lanes:
+                # the pipeline's second kernel: its verify/compile wall
+                # time is the probe_kernel_s obs key (kernel_bench
+                # reports the per-dispatch timings)
+                with self._metrics.timed("probe_kernel_s"):
+                    fused_mod.verify_probe_build(
+                        probe_lanes, capacity, interpret=interpret)
         except Exception as exc:
             from .resilience import classify_error
             cause = classify_error(exc).value
@@ -1139,6 +1181,18 @@ class TpuChecker(HostChecker):
         fused_on, fused_interp = self._fused_resolve(
             sharded=False, fmax=fmax, capacity=self._capacity)
         self._metrics.set("fused", 1 if fused_on else 0)
+        # cross-chunk dedup ring (fused path only): the ring halves
+        # thread OUTSIDE the carry — adding ChunkCarry fields would
+        # change every STAGED program's traced signature and invalidate
+        # the persistent compile cache (the seed_carry 5-arg caveat).
+        # cc_ring[0] holds the live (hi, lo) device pair between
+        # dispatches; None = re-zeroed lazily (fresh run, post-fault
+        # re-seed, spill epoch), which is always sound — the ring is a
+        # cache whose misses only cost a table probe.
+        cc_cap = self._cc_cap if fused_on else 0
+        cc_ring = [None]
+        if cc_cap:
+            self._metrics.set("cc_dedup_capacity", cc_cap)
 
         def mk_chunk(reason: str = "initial"):
             # every rebuild implies an XLA retrace (unless the shapes
@@ -1146,13 +1200,28 @@ class TpuChecker(HostChecker):
             self._metrics.inc("compiles")
             if self._trace:
                 self._trace.emit("compile", reason=reason)
-            return build_chunk_fn(model, qcap, self._capacity, fmax,
-                                  kmax, symmetry=self._symmetry,
-                                  sound=self._sound, hcap=hcap,
-                                  n_init=n_init, kraw=kraw,
-                                  hint_eff=hint_eff, ecap=ecap,
-                                  fused=fused_on,
-                                  fused_interpret=fused_interp)
+            fn = build_chunk_fn(model, qcap, self._capacity, fmax,
+                                kmax, symmetry=self._symmetry,
+                                sound=self._sound, hcap=hcap,
+                                n_init=n_init, kraw=kraw,
+                                hint_eff=hint_eff, ecap=ecap,
+                                fused=fused_on,
+                                fused_interpret=fused_interp,
+                                cc=cc_cap)
+            if not cc_cap:
+                return fn
+
+            def chunk_with_ring(carry_, remaining_, grow_, h_base_):
+                if cc_ring[0] is None:
+                    cc_ring[0] = (jnp.zeros((cc_cap,), jnp.uint32),
+                                  jnp.zeros((cc_cap,), jnp.uint32))
+                carry2, rhi, rlo, stats_d = fn(
+                    carry_, cc_ring[0][0], cc_ring[0][1], remaining_,
+                    grow_, h_base_)
+                cc_ring[0] = (rhi, rlo)
+                return carry2, stats_d
+
+            return chunk_with_ring
 
         chunk_fn = mk_chunk()
         pipeline = bool(opts.get("pipeline", True))
@@ -1269,6 +1338,9 @@ class TpuChecker(HostChecker):
             if q_tail > 0:
                 # most recently enqueued state (live Explorer progress)
                 self._recent_row = stats[tail0:tail0 + width3].copy()
+            # cross-chunk dedup ring hits ride one trailing stats
+            # element on the fused+cc path (chunk-local, like gen)
+            cch = int(stats[tail0 + width3]) if cc_cap else 0
             if shadow is not None:
                 # fold this chunk's appends into the host shadow (the
                 # queue/log suffixes are append-only, so gathering them
@@ -1312,6 +1384,8 @@ class TpuChecker(HostChecker):
                 metrics.inc("predup_hits", pdh)
             if prb:
                 metrics.inc("probe_rounds", prb)
+            if cch:
+                metrics.inc("cc_dedup_hits", cch)
             if size_key is not None:
                 _SIZE_MEMO.merge_max(size_key, (vmax, dmax))
             self._state_count += gen
@@ -1333,6 +1407,9 @@ class TpuChecker(HostChecker):
                     # hash-table load factor (growth trips near grow_at)
                     load=round(log_n / self._capacity, 4),
                     vmax=vmax, dmax=dmax,
+                    # cross-chunk ring hits this chunk (fused+cc only;
+                    # trace_report's fused summary totals them)
+                    cc_hits=(cch if cc_cap else None),
                     # dispatch->ready / ready->materialized split (see
                     # _materialize_stats: device compute vs transfer)
                     device_s=(round(timing[0], 6) if timing else None),
@@ -1610,6 +1687,11 @@ class TpuChecker(HostChecker):
             cur.update(q_size=n_init, q_tail=n_init, log_n=0, e_n=0)
             hgrow_pend.update(on=False, hovf=False, h_n=0)
             kovf_pend[:] = [0, 0, 0]
+            # fresh epoch: re-zero the cc ring lazily (its entries stay
+            # sound across a spill, but the epoch invariant — ring ⊆
+            # this epoch's committed inserts — is the simplest one to
+            # keep airtight)
+            cc_ring[0] = None
             self._metrics.inc("spills")
             if ecount:
                 self._metrics.inc("evicted_keys", ecount)
@@ -1690,6 +1772,9 @@ class TpuChecker(HostChecker):
             cur.update(q_size=n_init, q_tail=n_init, log_n=0, e_n=0)
             hgrow_pend.update(on=False, hovf=False, h_n=0)
             kovf_pend[:] = [0, 0, 0]
+            # the old ring arrays may be poisoned by the fault that got
+            # us here; re-zero lazily on the next dispatch
+            cc_ring[0] = None
             chunk_fn = mk_chunk("retry")
 
         fault_attempt = 0

@@ -114,6 +114,16 @@ GLOSSARY: Dict[str, str] = {
                     "the run (claim-retry pressure: rising rounds per "
                     "chunk mean duplicate lanes or load factor are "
                     "stressing the open-addressed table)",
+    "cc_dedup_hits": "duplicate lanes killed by the cross-chunk "
+                     "in-kernel recent-key ring BEFORE the table probe "
+                     "(or the sharded exchange) — the tier that "
+                     "attacks the re-expansion share of gen/uniq the "
+                     "in-batch pre-dedup cannot see "
+                     "(tpu_options(cc_dedup=...), fused path only)",
+    "probe_kernel_s": "verify/compile wall time of the owner-side "
+                      "post-exchange probe kernel (the sharded fused "
+                      "pipeline's second Pallas kernel; per-dispatch "
+                      "timings come from tools/kernel_bench.py)",
     # --- soak harness (actor/chaos.py + tools/soak.py) ----------------
     "ops": "client operations completed (returned) during a soak run "
            "against the spawned UDP cluster",
@@ -164,6 +174,15 @@ GLOSSARY: Dict[str, str] = {
     "fused": "1 when the run's chunk program took the fused Pallas "
              "path, 0 when staged (bench tags its contract lines from "
              "this so the perf trajectory can't silently mix paths)",
+    "fused_unsupported": "1 when a fused='auto' run stayed staged "
+                         "because the configuration is outside the "
+                         "kernel's support matrix (the one-time "
+                         "fused_unsupported trace event carries the "
+                         "reason; report()'s metrics line renders it)",
+    "cc_dedup_capacity": "slot count of the cross-chunk recent-key "
+                         "ring when enabled (gauge; "
+                         "tpu_options(cc_dedup=True|N|False), 0/absent "
+                         "when off or staged)",
     "host_tier_keys": "keys resident ONLY in the host tier after the "
                       "most recent spill (decremented as evicted keys "
                       "are rediscovered and re-promoted); 0 until the "
@@ -244,7 +263,7 @@ GLOSSARY: Dict[str, str] = {
 GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
     "shard_balance", "host_tier_keys", "queue_depth", "lanes",
-    "hosts", "procs",
+    "hosts", "procs", "fused_unsupported", "cc_dedup_capacity",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

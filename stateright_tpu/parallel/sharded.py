@@ -201,7 +201,8 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                            kraw: int = 0, exchange: str = "ring",
                            kb: int = 0, ecap: int = 0,
                            fused: bool = False,
-                           fused_interpret: bool = False):
+                           fused_interpret: bool = False,
+                           cc: int = 0):
     """Compile the K-iteration SPMD chunk runner for fixed buffer shapes.
 
     ``qcap``/``capacity`` are **global**; each shard works on its
@@ -226,14 +227,14 @@ def build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
     if mkey is not None:
         key = ("chunk", mkey, mesh, axis, qcap, capacity, fmax, kmax,
                symmetry, sound, kraw, exchange, kb, ecap, fused,
-               fused_interpret)
+               fused_interpret, cc)
         cached = _SHARDED_CACHE.get(key)
         if cached is not None:
             return cached
     fn = _build_sharded_chunk_fn(model, mesh, axis, qcap, capacity,
                                  fmax, kmax, symmetry, sound, kraw,
                                  exchange, kb, ecap, fused,
-                                 fused_interpret)
+                                 fused_interpret, cc)
     if key is not None:
         _SHARDED_CACHE[key] = fn
     return fn
@@ -245,13 +246,20 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                             sound: bool = False, kraw: int = 0,
                             exchange: str = "ring", kb: int = 0,
                             ecap: int = 0, fused: bool = False,
-                            fused_interpret: bool = False):
+                            fused_interpret: bool = False,
+                            cc: int = 0):
     from ..checker.device_loop import shrink_indices
     if fused:
-        # the sharded fusion boundary is the exchange: expand, hash and
-        # pre-dedup run in one kernel; probe/append stay staged on the
-        # owner shard (ops/fused.py supports() keeps sound staged)
+        # the sharded fusion boundary is the exchange: expand, hash,
+        # property eval and pre-dedup (in-batch arena + the cross-chunk
+        # ring) run in the step kernel; the post-exchange probe/insert
+        # runs as a SECOND Pallas kernel on the owner shard
+        # (ops/fused.py build_probe_block_fn), so a chunk iteration is
+        # two kernel dispatches around one collective
+        # (supports() keeps sound staged)
         assert not sound, "fused sharded build outside its support matrix"
+    else:
+        assert not cc, "cc dedup ring is a fused-path structure"
 
     D = mesh.shape[axis]
     kbits = _owner_bits(D)
@@ -324,19 +332,29 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
     def make_step(fmax_b: int, kraw_b: int, kfin_b: int):
       if fused:
-        from ..ops.expand import Expansion
-        from ..ops.fused import build_fused_block_fn
+        from ..ops.fused import (build_fused_block_fn,
+                                 build_probe_block_fn, cc_ring_update)
         fused_blk = build_fused_block_fn(
             model, fmax_b, 0, symmetry=symmetry, probe=False,
-            interpret=fused_interpret)
+            interpret=fused_interpret, props=bool(prop_count), cc=cc)
         # the kernel's in-register dedup subsumes the kraw staging: the
         # stage-two compaction (and the kovf abort, still pre-mutation
         # here — the probe runs after the exchange) works off the raw
         # F*A lane masks
         kraw_b = fmax_b * n_actions
+        # the SECOND kernel of the fused pipeline: the owner-side
+        # post-exchange probe/insert (model-independent, sized to the
+        # received lane width and the per-shard table slice)
+        probe_blk = build_probe_block_fn(
+            D * kb if bucket else kfin_b, closc,
+            interpret=fused_interpret)
 
       def step(state):
-        c, target_remaining, grow_limit = state
+        if fused and cc:
+            c, rhi, rlo, cchv, target_remaining, grow_limit = state
+        else:
+            c, target_remaining, grow_limit = state
+            rhi = rlo = cchv = None
         me = lax.axis_index(axis).astype(jnp.uint32)
         me_i = me.astype(jnp.int32)
         q_head, q_tail, log_n = c.q_head[0], c.q_tail[0], c.log_n[0]
@@ -351,24 +369,27 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
 
         if fused:
             # fused front-end (ops/fused.py): ONE Pallas kernel expands,
-            # fingerprints and pre-dedups this shard's frontier block in
-            # register — the staged exchange/probe below consumes its
-            # lane masks directly
-            fout = fused_blk(frontier, ebits, fvalid)
-            exp = Expansion(pbits=fout.pbits, ebits=fout.ebits,
-                            flat=fout.flat, avalid=None,
-                            cvalid=fout.cvalid, chi=None, clo=None,
-                            ohi=None, olo=None, phi=pfp[0], plo=pfp[1],
-                            terminal=fout.terminal, xovf=fout.xovf)
+            # fingerprints, evaluates the property predicates (discovery
+            # lanes flagged in-register — only the per-property sticky
+            # registers leave the kernel) and pre-dedups this shard's
+            # frontier block — against the in-batch arena AND, with
+            # ``cc``, the cross-chunk recent-key ring, so a duplicate
+            # re-generated chunks apart dies BEFORE it costs an
+            # exchange hop. The staged exchange below consumes the
+            # kernel's lane masks directly.
+            fout = fused_blk(frontier, ebits, fvalid,
+                             pfp=pfp if prop_count else None,
+                             ring=(rhi, rlo) if cc else None)
             cvalid = fout.cvalid
             gen_count = cvalid.sum(dtype=jnp.int32)
             vcount = gen_count
-            p_whi, p_wlo = exp.phi, exp.plo
+            xovf_it = fout.xovf
+            p_whi, p_wlo = pfp
             disc_hit, disc_hi, disc_lo = (c.disc_hit, c.disc_hi,
                                           c.disc_lo)
             if prop_count:
-                hit_l, cand_hi, cand_lo = discovery_candidates(
-                    properties, exp, fvalid, whi=p_whi, wlo=p_wlo)
+                hit_l = fout.disc_hit
+                cand_hi, cand_lo = fout.disc_hi, fout.disc_lo
                 negsel = jnp.where(hit_l, jnp.int32(D - 1) - me_i,
                                    jnp.int32(-1))
             else:
@@ -383,6 +404,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 n_actions, axis=0)
             ebits_k = par3[:, 0]
             dvalid = fout.dvalid
+            cch_it = fout.cch
             k_chi, k_clo = s_chi, s_clo
         else:
             # shared check_block analog (ops/expand.py) on local rows;
@@ -439,6 +461,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             else:
                 dvalid = pre_dedup(s_chi, s_clo, rvalid)
                 k_chi, k_clo = s_chi, s_clo
+            xovf_it = exp.xovf
+            cch_it = jnp.int32(0)
         dcount = dvalid.sum(dtype=jnp.int32)
         if bucket:
             # exact per-destination counts (the dedup key's top bits
@@ -455,7 +479,7 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         # --- fused collective 1 of 3 (pre-ring): every reduction the
         # abort gating needs, in ONE pmax
         pm1 = lax.pmax(jnp.concatenate([
-            jnp.stack([vcount, dcount, exp.xovf.astype(jnp.int32),
+            jnp.stack([vcount, dcount, xovf_it.astype(jnp.int32),
                        bmax_it]),
             negsel]), axis)
         vshard, dshard, bshard = pm1[0], pm1[1], pm1[3]
@@ -517,9 +541,17 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 sendbuf.reshape(D, kb, -1), axis, split_axis=0,
                 concat_axis=0, tiled=True).reshape(D * kb, -1)
             mine = recv[:, -1] == 1
-            inserted, key_hi, key_lo, t_ovf, prb_it = table_insert(
-                key_hi, key_lo, recv[:, log_off], recv[:, log_off + 1],
-                mine, with_rounds=True)
+            if fused:
+                # the owner-side probe/insert as the pipeline's second
+                # Pallas kernel (same jaxpr as table_insert — same
+                # bucket-probe invariant, same benign race)
+                inserted, key_hi, key_lo, t_ovf, prb_it = probe_blk(
+                    recv[:, log_off], recv[:, log_off + 1], mine,
+                    key_hi, key_lo)
+            else:
+                inserted, key_hi, key_lo, t_ovf, prb_it = table_insert(
+                    key_hi, key_lo, recv[:, log_off],
+                    recv[:, log_off + 1], mine, with_rounds=True)
             cnt = inserted.sum(dtype=jnp.int32)
             if sound and eloc:
                 # cross edges for the lasso sweep: dedup hits whose
@@ -549,9 +581,14 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             for hop in range(D):
                 k_c, val_c, own_c = rc
                 mine = val_c & (own_c == me)
-                inserted, key_hi, key_lo, o, rnds = table_insert(
-                    key_hi, key_lo, k_c[:, log_off],
-                    k_c[:, log_off + 1], mine, with_rounds=True)
+                if fused:
+                    inserted, key_hi, key_lo, o, rnds = probe_blk(
+                        k_c[:, log_off], k_c[:, log_off + 1], mine,
+                        key_hi, key_lo)
+                else:
+                    inserted, key_hi, key_lo, o, rnds = table_insert(
+                        key_hi, key_lo, k_c[:, log_off],
+                        k_c[:, log_off + 1], mine, with_rounds=True)
                 prb_it = prb_it + rnds
                 t_ovf = t_ovf | o
                 cnt = inserted.sum(dtype=jnp.int32)
@@ -584,7 +621,22 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         pavail, max_tail, max_log, max_e = pm2[0], pm2[1], pm2[2], pm2[3]
         ovf = c.ovf | ((pm2[4] > 0) & ~kovf)
         xovf = c.xovf | xovf_any
-        pdh_it = vcount - dcount  # in-batch duplicate lanes this shard
+        # in-batch duplicate lanes this shard (dvalid already excludes
+        # the cross-chunk ring hits, counted separately as cch)
+        pdh_it = vcount - dcount - cch_it
+        if fused and cc:
+            # cross-chunk ring update, STAGED and post-commit: ring
+            # entries must stay a subset of the committed visited set,
+            # so only iterations that neither kovf-aborted (nothing
+            # mutated) nor hit a table probe overflow (some exchanged
+            # lanes unresolved at their owner) cache their exchanged
+            # keys. A key this shard sent was claimed by its owner —
+            # fresh or duplicate, it is in the visited set either way.
+            commit = ~kovf & (pm2[4] == 0)
+            rhi, rlo = cc_ring_update(
+                rhi, rlo, k_all[:, log_off], k_all[:, log_off + 1],
+                kvalid & commit, cc)
+            cchv = cchv + jnp.where(kovf, 0, cch_it)
         if prop_count:
             ps = lax.psum(jnp.concatenate([
                 jnp.stack([gen_count, pdh_it,
@@ -622,6 +674,8 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
             gen=gen, ovf=ovf, xovf=xovf, kovf=kovf, vmax=vmax,
             dmax=dmax, bmax=bmax_c, steps=steps, go=go, pavail=pavail,
             pdh=pdh, prb=prb)
+        if fused and cc:
+            return (nc, rhi, rlo, cchv, target_remaining, grow_limit)
         return (nc, target_remaining, grow_limit)
       return step
 
@@ -630,15 +684,9 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
         step_small = make_step(fmax_small, kraw_small,
                                min(kmax_small, kraw_small))
 
-    def local_chunk(carry, target_remaining, grow_limit):
-        pm = lax.pmax(jnp.stack([carry.q_tail[0] - carry.q_head[0],
-                                 carry.q_tail[0], carry.log_n[0],
-                                 carry.e_n[0]]), axis)
-        go = go_from(pm[0], pm[1], pm[2], pm[3], carry.disc_hit,
-                     carry.gen, carry.ovf, carry.xovf, carry.kovf,
-                     carry.steps, target_remaining, grow_limit)
-        state = (carry._replace(go=go, pavail=pm[0]), target_remaining,
-                 grow_limit)
+    cc_state = bool(fused and cc)
+
+    def run_loops(state):
         # sequenced small/large while_loops gated on the REPLICATED
         # pending maximum (carried in pavail, so the loop conditions
         # stay collective-free), wrapped in an outer loop so a frontier
@@ -657,20 +705,30 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                 s = lax.while_loop(cond_small, step_small, s)
                 return lax.while_loop(cond_large, step_large, s)
 
-            state = lax.while_loop(lambda s: s[0].go, outer_body, state)
-        else:
-            state = lax.while_loop(lambda s: s[0].go, step_large, state)
-        out = state[0]
+            return lax.while_loop(lambda s: s[0].go, outer_body, state)
+        return lax.while_loop(lambda s: s[0].go, step_large, state)
+
+    def entry_carry(carry, target_remaining, grow_limit):
+        pm = lax.pmax(jnp.stack([carry.q_tail[0] - carry.q_head[0],
+                                 carry.q_tail[0], carry.log_n[0],
+                                 carry.e_n[0]]), axis)
+        go = go_from(pm[0], pm[1], pm[2], pm[3], carry.disc_hit,
+                     carry.gen, carry.ovf, carry.xovf, carry.kovf,
+                     carry.steps, target_remaining, grow_limit)
+        return carry._replace(go=go, pavail=pm[0])
+
+    def base_stats(out):
         # ONE replicated sync vector for everything the host reads per
         # chunk (layout parsed by parallel/engine.py — keep in sync):
         # [q_head[D], q_tail[D], log_n[D],
         #  gen, ovf, xovf, kovf, vmax, dmax, bmax, pdh, prb,
-        #  disc_hit[P], disc_hi[P], disc_lo[P], e_n[D]]
+        #  disc_hit[P], disc_hi[P], disc_lo[P], e_n[D],
+        #  cc ring hits (fused+cc only)]
         hs = lax.all_gather(out.q_head, axis, tiled=True)
         ts = lax.all_gather(out.q_tail, axis, tiled=True)
         ls = lax.all_gather(out.log_n, axis, tiled=True)
         es = lax.all_gather(out.e_n, axis, tiled=True)
-        stats = jnp.concatenate([
+        return jnp.concatenate([
             hs.astype(jnp.uint32), ts.astype(jnp.uint32),
             ls.astype(jnp.uint32),
             jnp.stack([out.gen,
@@ -682,9 +740,38 @@ def _build_sharded_chunk_fn(model, mesh: Mesh, axis: str, qcap: int,
                        out.prb]).astype(jnp.uint32),
             out.disc_hit.astype(jnp.uint32),
             out.disc_hi, out.disc_lo, es.astype(jnp.uint32)])
-        return out, stats
 
     specs = carry_specs(axis)
+    if cc_state:
+        def local_chunk_cc(carry, rhi, rlo, target_remaining,
+                           grow_limit):
+            # the cross-chunk ring threads OUTSIDE ShardedCarry (adding
+            # carry fields would change the staged programs' traced
+            # signatures — the persistent-compile-cache caveat); cch is
+            # chunk-local telemetry re-zeroed per dispatch
+            state = run_loops((
+                entry_carry(carry, target_remaining, grow_limit),
+                rhi, rlo, jnp.int32(0), target_remaining, grow_limit))
+            out, rhi2, rlo2 = state[0], state[1], state[2]
+            cch = lax.psum(state[3], axis)
+            stats = jnp.concatenate([
+                base_stats(out),
+                jnp.reshape(cch, (1,)).astype(jnp.uint32)])
+            return out, rhi2, rlo2, stats
+
+        s = P(axis)
+        fn = shard_map_compat(
+            local_chunk_cc, mesh=mesh,
+            in_specs=(specs, s, s, P(), P()),
+            out_specs=(specs, s, s, P()))
+        return jax.jit(fn, donate_argnums=(0, 1, 2))
+
+    def local_chunk(carry, target_remaining, grow_limit):
+        state = run_loops((
+            entry_carry(carry, target_remaining, grow_limit),
+            target_remaining, grow_limit))
+        return state[0], base_stats(state[0])
+
     fn = shard_map_compat(
         local_chunk, mesh=mesh,
         in_specs=(specs, P(), P()), out_specs=(specs, P()))

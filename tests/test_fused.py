@@ -32,9 +32,13 @@ class TestFusedParity:
     def test_2pc_full_parity(self, host_2pc3):
         # full enumeration: the fused kernel must reproduce the staged
         # path's reached set, discoveries and counts exactly (2pc n=3:
-        # 288 unique, `2pc.rs:128`)
+        # 288 unique, `2pc.rs:128`). cc_dedup=False isolates the
+        # kernel itself so even the probe-round telemetry is
+        # bit-identical (the ring legitimately SHRINKS probe rounds —
+        # its own pins live in TestCcDedup)
         staged = _run(TwoPhaseSys(3), False, capacity=1 << 12, fmax=64)
-        fused = _run(TwoPhaseSys(3), True, capacity=1 << 12, fmax=64)
+        fused = _run(TwoPhaseSys(3), True, capacity=1 << 12, fmax=64,
+                     cc_dedup=False)
         assert staged.unique_state_count() == 288
         assert fused.unique_state_count() == 288
         assert (fused.generated_fingerprints()
@@ -48,10 +52,13 @@ class TestFusedParity:
         assert pf["fused_chunks"] == pf["chunks"] > 0
         assert pf["predup_hits"] == ps["predup_hits"] > 0
         assert pf["probe_rounds"] == ps["probe_rounds"] > 0
+        assert not pf.get("cc_dedup_hits")
 
     def test_discovery_paths_replay_fused(self):
         # mirror integrity: witness reconstruction through the fused
         # path's (fp -> parent fp) log must replay real transitions
+        # (the witnesses are now selected by the IN-KERNEL property
+        # eval — the sticky per-block registers)
         model = TwoPhaseSys(3)
         fused = _run(model, True, capacity=1 << 12, fmax=64)
         for name, path in fused.discoveries().items():
@@ -213,6 +220,222 @@ class TestFusedSelection:
             fused_mod.build_fused_block_fn = orig
 
 
+class TestInKernelProps:
+    """Property-predicate evaluation fused INTO the step kernel: the
+    per-block sticky (hit, witness fp) registers must reproduce the
+    staged path's discovery selection exactly — same properties, same
+    witness paths, not just the same names."""
+
+    def test_witness_replay_identical_to_staged(self, host_2pc3):
+        model = TwoPhaseSys(3)
+        staged = _run(TwoPhaseSys(3), False, capacity=1 << 12, fmax=64)
+        fused = _run(model, True, capacity=1 << 12, fmax=64)
+        assert set(fused.discoveries()) == set(staged.discoveries())
+        for name, path in fused.discoveries().items():
+            # identical witness REPLAY: the same state sequence, ending
+            # in a state that really satisfies/violates the property
+            assert (path.into_states()
+                    == staged.discoveries()[name].into_states()), name
+            assert model.property(name).condition(model,
+                                                  path.last_state())
+
+    def test_eventually_terminal_flush_in_kernel(self):
+        # EVENTUALLY discoveries come from the terminal-flush mask
+        # (terminal rows with pending ebits) — evaluated in-kernel too
+        from stateright_tpu.actor.test_util import PackedTimerCount
+        host = PackedTimerCount(2, 2).checker().spawn_bfs().join()
+        fused = _run(PackedTimerCount(2, 2), True, capacity=1 << 12)
+        assert set(fused.discoveries()) == set(host.discoveries())
+        assert (fused.generated_fingerprints()
+                == host.generated_fingerprints())
+
+
+class TestShardedProbeKernel:
+    """The sharded fused pipeline's SECOND Pallas kernel: the owner-side
+    post-exchange probe/insert (previously a staged program between the
+    all-to-all and the append) must be digest-identical to the staged
+    path on every mesh width and both exchanges."""
+
+    @staticmethod
+    def _mesh(d):
+        from jax.sharding import Mesh
+        devices = jax.devices()
+        if len(devices) < d:
+            pytest.skip(f"need {d} devices")
+        return Mesh(np.array(devices[:d]), ("shards",))
+
+    def _digest(self, ck):
+        import hashlib
+        fps = sorted(ck.generated_fingerprints())
+        return hashlib.sha256(
+            ",".join(str(f) for f in fps).encode()).hexdigest()
+
+    def test_d2_bucket_digest_identical_to_staged(self, host_2pc3):
+        mesh = self._mesh(2)
+        staged = _run(TwoPhaseSys(3), False, mesh=mesh,
+                      capacity=1 << 12, fmax=64)
+        fused = _run(TwoPhaseSys(3), True, mesh=mesh,
+                     capacity=1 << 12, fmax=64)
+        assert fused.unique_state_count() == 288
+        assert self._digest(fused) == self._digest(staged) \
+            == self._digest(host_2pc3)
+        assert set(fused.discoveries()) == set(staged.discoveries())
+        # probe telemetry rides the second kernel's flags
+        assert fused.profile()["probe_rounds"] > 0
+
+    @pytest.mark.slow
+    def test_d4_digest_identical_to_staged(self, host_2pc3):
+        mesh = self._mesh(4)
+        staged = _run(TwoPhaseSys(3), False, mesh=mesh,
+                      capacity=1 << 12, fmax=64)
+        fused = _run(TwoPhaseSys(3), True, mesh=mesh,
+                     capacity=1 << 12, fmax=64)
+        assert self._digest(fused) == self._digest(staged) \
+            == self._digest(host_2pc3)
+
+    @pytest.mark.slow
+    def test_d2_ring_exchange_probe_kernel(self, host_2pc3):
+        # the ring exchange probes per hop — the kernel replaces every
+        # hop's staged insert
+        mesh = self._mesh(2)
+        fused = _run(TwoPhaseSys(3), True, mesh=mesh, exchange="ring",
+                     capacity=1 << 12, fmax=64)
+        assert self._digest(fused) == self._digest(host_2pc3)
+
+
+class TestCcDedup:
+    """Cross-chunk in-kernel dedup ring (`tpu_options(cc_dedup=...)`):
+    soundness property — the cache may only kill lanes whose
+    fingerprint already committed to the visited set, so the enumerated
+    fingerprint set, unique counts and discoveries are IDENTICAL to the
+    staged path (a false miss only costs a table probe, never drops a
+    fresh key — `pre_dedup`'s argument, one tier up)."""
+
+    def test_never_drops_fresh_fingerprint_2pc(self, host_2pc3):
+        staged = _run(TwoPhaseSys(3), False, capacity=1 << 12, fmax=64)
+        fused = _run(TwoPhaseSys(3), True, capacity=1 << 12, fmax=64)
+        assert fused.unique_state_count() == 288
+        assert (fused.generated_fingerprints()
+                == staged.generated_fingerprints()
+                == host_2pc3.generated_fingerprints())
+        assert set(fused.discoveries()) == set(staged.discoveries())
+        pf, ps = fused.profile(), staged.profile()
+        # the ring actually fired on this duplicate-heavy model, the
+        # in-batch share stayed exact, and ring kills can only REDUCE
+        # table probe pressure
+        assert pf["cc_dedup_hits"] > 0
+        assert pf["cc_dedup_capacity"] > 0
+        assert pf["predup_hits"] == ps["predup_hits"]
+        assert pf["probe_rounds"] <= ps["probe_rounds"]
+        # generated counts are pre-dedup semantics: untouched by cc
+        assert fused.state_count() == staged.state_count()
+
+    def test_sharded_cc_kills_before_exchange(self, host_2pc3):
+        from jax.sharding import Mesh
+        devices = jax.devices()
+        if len(devices) < 2:
+            pytest.skip("need 2 devices")
+        mesh = Mesh(np.array(devices[:2]), ("shards",))
+        fused = _run(TwoPhaseSys(3), True, mesh=mesh,
+                     capacity=1 << 12, fmax=64)
+        assert (fused.generated_fingerprints()
+                == host_2pc3.generated_fingerprints())
+        assert fused.profile()["cc_dedup_hits"] > 0
+
+    def test_cc_option_validation(self):
+        with pytest.raises(ValueError, match="cc_dedup"):
+            (TwoPhaseSys(3).checker()
+             .tpu_options(race=False, cc_dedup=1000)  # not a pow2
+             .spawn_tpu())
+
+    def test_custom_ring_size(self, host_2pc3):
+        # a deliberately TINY ring: heavy slot eviction, so most probes
+        # miss — misses must only cost table probes, never keys
+        fused = _run(TwoPhaseSys(3), True, capacity=1 << 12, fmax=64,
+                     cc_dedup=64)
+        assert (fused.generated_fingerprints()
+                == host_2pc3.generated_fingerprints())
+        assert fused.profile()["cc_dedup_capacity"] == 64
+
+    @pytest.mark.slow
+    def test_2pc6_full_parity(self):
+        # a bigger duplicate-heavy space (2pc n=6, 35k unique): host
+        # oracle + staged + fused-with-ring all agree, and the ring
+        # catches a meaningful share of the cross-chunk re-expansion.
+        # (paxos models declare the host-evaluated `linearizable`
+        # property, which supports() keeps staged — pinned by
+        # TestFusedUnsupported::test_paxos_auto_reports_host_props.)
+        host = TwoPhaseSys(6).checker().spawn_bfs().join()
+        fused = _run(TwoPhaseSys(6), True, capacity=1 << 16, fmax=128)
+        assert (fused.generated_fingerprints()
+                == host.generated_fingerprints())
+        assert set(fused.discoveries()) == set(host.discoveries())
+        assert fused.profile()["cc_dedup_hits"] > 0
+
+    @pytest.mark.slow
+    @pytest.mark.faults
+    def test_crash_restart_cc_parity(self):
+        # crash-nibble lanes + the ring: a restart re-reaches earlier
+        # states (genuine cross-chunk duplicates) — parity must hold
+        from stateright_tpu.actor.test_util import PackedTimerCount
+
+        def mk():
+            return PackedTimerCount(2, 2).crash_restart(2)
+
+        host = mk().checker().spawn_bfs().join()
+        fused = _run(mk(), True, capacity=1 << 14, cc_dedup=256)
+        assert (host.generated_fingerprints()
+                == fused.generated_fingerprints())
+        assert set(fused.discoveries()) == set(host.discoveries())
+
+
+class TestFusedUnsupported:
+    def test_auto_unsupported_emits_reason_once(self):
+        # supports() exclusions no longer "quietly stay staged": one
+        # fused_unsupported event names the reason, the gauge rides
+        # profile(), and report()'s metrics line renders it
+        import io
+        trace = []
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, fused="auto", hint=2,
+                           capacity=1 << 12, trace=trace)
+              .spawn_tpu().join())
+        assert ck.unique_state_count() == 288
+        prof = ck.profile()
+        assert prof["fused"] == 0
+        assert prof["fused_unsupported"] == 1
+        events = [e for e in trace if e["ev"] == "fused_unsupported"]
+        assert len(events) == 1
+        assert "hint" in events[0]["reason"]
+        out = io.StringIO()
+        ck.report(out)
+        assert "fused=unsupported" in out.getvalue()
+
+    def test_supported_auto_run_has_no_unsupported_marker(self):
+        trace = []
+        ck = _run(TwoPhaseSys(3), "auto", capacity=1 << 12,
+                  trace=trace)
+        assert "fused_unsupported" not in ck.profile()
+        assert not [e for e in trace
+                    if e["ev"] == "fused_unsupported"]
+
+    def test_paxos_auto_reports_host_props(self):
+        # the real-world exclusion: register-protocol models (paxos,
+        # abd, single-copy) declare the host-evaluated `linearizable`
+        # property — 'auto' stays staged and now SAYS so
+        from stateright_tpu.examples.paxos_packed import PackedPaxos
+        trace = []
+        ck = (PackedPaxos(2).checker()
+              .tpu_options(race=False, fused="auto", trace=trace,
+                           capacity=1 << 14)
+              .target_state_count(2000)
+              .spawn_tpu().join())
+        events = [e for e in trace if e["ev"] == "fused_unsupported"]
+        assert len(events) == 1
+        assert "host-evaluated" in events[0]["reason"]
+        assert ck.profile()["fused_unsupported"] == 1
+
+
 class TestPreDedupSoundness:
     """`ops.expand.pre_dedup` arena-collision property: a lane is ONLY
     dropped when an earlier valid lane carries the SAME fingerprint —
@@ -304,7 +527,13 @@ def test_kernel_bench_emits_json(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = json.loads(out.read_text())
     assert line["interpret"] is True
-    for key in ("expand_ms", "hash_ms", "pre_dedup_ms", "probe_ms"):
+    for key in ("expand_ms", "hash_ms", "pre_dedup_ms", "probe_ms",
+                "probe_kernel_ms"):
         assert line["stages"][key] >= 0
     assert line["fused_ms"] > 0 and line["staged_ms"] > 0
+    # the sharded two-kernel path (step kernel + owner-side probe
+    # kernel, exchange excluded) reports its own composed numbers
+    assert line["sharded_fused_ms"] > 0
+    assert line["sharded_staged_ms"] > 0
+    assert line["sharded_fused_over_staged"] > 0
     assert 0 <= line["dup_lane_frac"] <= 1

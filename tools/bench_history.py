@@ -135,6 +135,10 @@ def parse_round(path: str) -> Dict[str, Any]:
             "unit": row.get("unit"),
             "uniq": row.get("uniq"),
             "gen_per_uniq": row.get("gen_per_uniq"),
+            # duplicate-expansion factor AFTER the cross-chunk dedup
+            # ring's in-register kills (PR 13) — the g/u vs g/u_cc gap
+            # is the cache's measured bite, tracked as its own trend
+            "gen_per_uniq_cc": row.get("gen_per_uniq_cc"),
             "tags": sorted(
                 t for t, on in (
                     ("fused", row.get("fused")),
@@ -229,6 +233,12 @@ def compute_flags(rounds: List[Dict[str, Any]],
         for wname, pw in prev["workloads"].items():
             cw = cur["workloads"].get(wname)
             if cw is None:
+                if not comparable:
+                    # a backend switch legitimately changes the matrix
+                    # (a CPU-fallback round skips the device-budget
+                    # context workloads) — a "missing" flag there is
+                    # noise, same reasoning as the regression gate
+                    continue
                 flags.append({
                     "kind": "missing_workload", "round": cur["round"],
                     "workload": wname,
@@ -304,6 +314,8 @@ def render_markdown(report: Dict[str, Any], out) -> None:
                     if isinstance(e["best"], (int, float)) else "?"
                 if e.get("gen_per_uniq"):
                     cell += f", g/u={e['gen_per_uniq']}"
+                if e.get("gen_per_uniq_cc"):
+                    cell += f", g/u_cc={e['gen_per_uniq_cc']}"
                 if e["tags"]:
                     cell += " [" + ",".join(e["tags"]) + "]"
                 cells.append(cell)

@@ -1,4 +1,4 @@
-"""Microbenchmark: staged expand→hash→dedup→probe vs the fused kernel.
+"""Microbenchmark: staged expand→hash→dedup→probe vs the fused kernels.
 
 Usage:
     python tools/kernel_bench.py [--model 2pc7|2pc4|paxos3] [--fmax N]
@@ -13,16 +13,31 @@ frontier drawn from the model's real reachable states (BFS prefix):
     ``probe`` (``ops.hashtable.table_insert``) — each stage jitted
     standalone so the per-stage costs are visible, plus the composed
     staged pipeline in one jit (what the engines actually run);
-  * **fused** (``ops.fused``): the one-kernel
-    expand→fingerprint→pre-dedup→probe path.
+  * **fused single-chip** (``ops.fused``): the one-kernel
+    expand→fingerprint→props→pre-dedup→probe path (in-kernel property
+    eval + the cross-chunk dedup ring, the production config);
+  * **fused sharded two-kernel path**: the step kernel at the exchange
+    boundary (``probe=False``) composed with the owner-side
+    post-exchange probe kernel (``build_probe_block_fn``) — what a
+    sharded fused chunk iteration dispatches around its all-to-all (the
+    collective itself is excluded: this is a single-process microbench
+    of the kernels, not the interconnect).
 
-Emits ONE JSON line on stdout: per-stage milliseconds (median of
-``--iters`` timed reps after a compile warm-up), the composed
-staged-vs-fused ratio, and the workload's duplicate-lane fraction (the
-quantity the fusion attacks). On non-TPU backends the fused path runs
-through the Pallas **interpreter** — correctness-representative, NOT
-perf-representative; the line carries ``"interpret": true`` so nobody
-reads a CPU ratio as a TPU result.
+JSON fields (one line on stdout):
+  ``stages.expand_ms/hash_ms/pre_dedup_ms/probe_ms`` — staged stages;
+  ``stages.probe_kernel_ms`` — the owner-side probe kernel standalone,
+  the direct A/B against ``stages.probe_ms`` at identical lanes/table;
+  ``staged_ms``/``fused_ms``/``fused_over_staged`` — composed
+  single-chip pipelines; ``sharded_staged_ms``/``sharded_fused_ms``/
+  ``sharded_fused_over_staged`` — the sharded two-kernel path vs its
+  staged equivalent (exchange excluded on both sides);
+  ``dup_lane_frac`` — the workload's duplicate-lane fraction (the
+  quantity the fusion attacks).
+
+On non-TPU backends the fused paths run through the Pallas
+**interpreter** — correctness-representative, NOT perf-representative;
+the line carries ``"interpret": true`` so nobody reads a CPU ratio as a
+TPU result.
 """
 
 from __future__ import annotations
@@ -106,7 +121,8 @@ def main(argv) -> int:
     from stateright_tpu.checker.device_loop import shrink_indices
     from stateright_tpu.ops.expand import (eventually_indices,
                                            expand_frontier, pre_dedup)
-    from stateright_tpu.ops.fused import build_fused_block_fn
+    from stateright_tpu.ops.fused import (build_fused_block_fn,
+                                          build_probe_block_fn)
     from stateright_tpu.ops.hash_kernel import fp64_device
     from stateright_tpu.ops.hashtable import _BUCKET, table_insert
 
@@ -120,13 +136,18 @@ def main(argv) -> int:
     width = model.packed_width
     n_actions = model.max_actions
     fa = fmax * n_actions
+    props = len(model.properties()) > 0
+    cc = 1 << 12  # a small production-shaped ring for the bench
     ev_idx = eventually_indices(model.properties())
 
     frontier = jnp.asarray(_frontier(model, fmax))
     ebits = jnp.zeros((fmax,), jnp.uint32)
     fvalid = jnp.ones((fmax,), bool)
+    pfp0 = fp64_device(frontier)
     khi0 = jnp.zeros((capacity // _BUCKET, _BUCKET), jnp.uint32)
     klo0 = jnp.zeros((capacity // _BUCKET, _BUCKET), jnp.uint32)
+    rhi0 = jnp.zeros((cc,), jnp.uint32)
+    rlo0 = jnp.zeros((cc,), jnp.uint32)
 
     # --- staged stages, each standalone ------------------------------
     def stage_expand(rows):
@@ -160,10 +181,39 @@ def main(argv) -> int:
     j_probe = jax.jit(stage_probe)
     j_staged = jax.jit(staged_all)
 
-    # --- fused kernel ------------------------------------------------
-    fused_fn = jax.jit(build_fused_block_fn(
+    # --- fused single-chip kernel (props + cc, the production shape) --
+    blk = build_fused_block_fn(
         model, fmax, capacity, symmetry=False, probe=True,
-        interpret=interpret))
+        interpret=interpret, props=props, cc=cc)
+
+    def fused_one(rows, khi, klo, rhi, rlo):
+        return blk(rows, ebits, fvalid, key_hi=khi, key_lo=klo,
+                   pfp=pfp0 if props else None, ring=(rhi, rlo))
+
+    fused_fn = jax.jit(fused_one)
+
+    # --- the sharded two-kernel path: step kernel at the exchange
+    # boundary + the owner-side probe kernel (exchange excluded) -------
+    step_blk = build_fused_block_fn(
+        model, fmax, 0, symmetry=False, probe=False,
+        interpret=interpret, props=props, cc=cc)
+    probe_blk = build_probe_block_fn(fa, capacity, interpret=interpret)
+
+    def sharded_fused(rows, khi, klo, rhi, rlo):
+        out = step_blk(rows, ebits, fvalid,
+                       pfp=pfp0 if props else None, ring=(rhi, rlo))
+        return probe_blk(out.chi, out.clo, out.dvalid, khi, klo)
+
+    j_sharded_fused = jax.jit(sharded_fused)
+    # its staged equivalent is the composed staged pipeline (the real
+    # sharded staged path interleaves the exchange between dedup and
+    # probe; the op content is identical)
+    j_sharded_staged = j_staged
+
+    def probe_kernel_one(khi, klo, chi_, clo_, dvalid_):
+        return probe_blk(chi_, clo_, dvalid_, khi, klo)
+
+    j_probe_kernel = jax.jit(probe_kernel_one)
 
     stages = {
         "expand_ms": _timed(j_expand, (frontier,), iters),
@@ -171,10 +221,19 @@ def main(argv) -> int:
         "pre_dedup_ms": _timed(j_dedup, (chi, clo, cvalid), iters),
         "probe_ms": _timed(j_probe, (khi0, klo0, chi, clo, dvalid),
                            iters),
+        # the owner-side probe kernel, same lanes/table as probe_ms —
+        # the direct per-stage A/B the sharded fused path rides
+        "probe_kernel_ms": _timed(
+            j_probe_kernel, (khi0, klo0, chi, clo, dvalid), iters),
     }
     staged_ms = _timed(j_staged, (frontier, khi0, klo0), iters)
-    fused_ms = _timed(fused_fn, (frontier, ebits, fvalid, khi0, klo0),
+    fused_ms = _timed(fused_fn, (frontier, khi0, klo0, rhi0, rlo0),
                       iters)
+    sharded_staged_ms = _timed(j_sharded_staged,
+                               (frontier, khi0, klo0), iters)
+    sharded_fused_ms = _timed(j_sharded_fused,
+                              (frontier, khi0, klo0, rhi0, rlo0),
+                              iters)
 
     n_valid = int(np.asarray(cvalid).sum())
     n_dedup = int(np.asarray(dvalid).sum())
@@ -188,6 +247,11 @@ def main(argv) -> int:
         "fused_ms": fused_ms,
         "fused_over_staged": round(fused_ms / staged_ms, 3)
         if staged_ms else None,
+        "sharded_staged_ms": sharded_staged_ms,
+        "sharded_fused_ms": sharded_fused_ms,
+        "sharded_fused_over_staged": round(
+            sharded_fused_ms / sharded_staged_ms, 3)
+        if sharded_staged_ms else None,
     }
     out = json.dumps(line)
     print(out)

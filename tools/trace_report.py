@@ -161,7 +161,8 @@ def report(events, out=None):
         inters = [e for e in evs if e["ev"] in
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
-                   "degrade", "fused_fallback", "recorder_dump",
+                   "degrade", "fused_fallback", "fused_unsupported",
+                   "recorder_dump",
                    "spill", "evict", "pause",
                    "crash", "restart", "partition",
                    "job_submit", "job_start", "job_pause",
@@ -320,8 +321,10 @@ def report(events, out=None):
                          if e.get("bucket") == b]
                 out.write(f"  bucket {b}: lanes={lanes[0]}\n")
 
-        # fused-kernel summary: which path the run took, and why a
-        # fused='auto' attempt fell back (the classified cause)
+        # fused-kernel summary: which path the run took, why a
+        # fused='auto' attempt fell back (the classified cause) or
+        # never fired (the supports() exclusion), and what the
+        # cross-chunk dedup ring killed
         fb = [e for e in evs if e["ev"] == "fused_fallback"]
         if fb:
             causes = sorted({e.get("cause", "?") for e in fb})
@@ -329,6 +332,16 @@ def report(events, out=None):
                       f"causes={causes} "
                       f"(staged path ran; first error: "
                       f"{fb[0].get('error', '?')!r})\n")
+        unsup = [e for e in evs if e["ev"] == "fused_unsupported"]
+        if unsup:
+            out.write(f"\nfused: unsupported — "
+                      f"{unsup[0].get('reason', '?')}\n")
+        cc_hits = sum(e.get("cc_hits") or 0
+                      for e in evs if e["ev"] == "chunk")
+        if cc_hits:
+            out.write(f"\nfused: cc_dedup_hits={cc_hits} "
+                      "(cross-chunk ring kills before the table "
+                      "probe/exchange)\n")
 
         for ev in evs:
             if ev["ev"] == "discovery":
