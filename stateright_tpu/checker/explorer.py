@@ -39,14 +39,18 @@ from __future__ import annotations
 import json
 import os
 import queue as _queue
+import sys
 import threading
 import time
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from typing import NamedTuple
 
+# MetricsRing moved to obs/metrics.py in PR 14 (the service's
+# utilization accounting shares it); re-exported here so existing
+# imports keep working
+from ..obs.metrics import MetricsRing  # noqa: F401 (compat re-export)
 from .path import Path
 from .visitor import CheckerVisitor
 
@@ -149,43 +153,33 @@ def metrics_view(checker) -> Dict[str, Any]:
     }
 
 
-class MetricsRing:
-    """Bounded time series of periodic ``/.metrics`` snapshots.
+class _SseClient:
+    """One SSE consumer's bounded event queue.
 
-    A daemon sampler (started by :func:`serve`) appends one snapshot
-    per ``interval`` seconds while the run is live; the ring keeps the
-    most recent ``limit`` samples, so a dashboard attaching mid-run can
-    plot the trend it missed without having polled from the start."""
+    The engine's emit path feeds :meth:`feed` (as a trace subscriber);
+    a client too slow to drain its queue DROPS events instead of ever
+    blocking the writer. Drops are counted per client, accumulated
+    into the producer's ``sse_dropped`` metric, and announced ONCE on
+    the server's stderr — silent drops made "my console is missing
+    events" undiagnosable."""
 
-    def __init__(self, limit: int = 512, interval: float = 1.0):
-        self.interval = interval
-        self._buf: deque = deque(maxlen=max(4, int(limit)))
-        self._lock = threading.Lock()
+    def __init__(self, qsize: int, metrics=None, label: str = "?"):
+        self.q: "_queue.Queue" = _queue.Queue(maxsize=qsize)
+        self.dropped = 0
+        self._metrics = metrics
+        self._label = label
 
-    def add(self, sample: Dict[str, Any]) -> None:
-        sample = dict(sample)
-        sample["wall"] = time.time()
-        with self._lock:
-            self._buf.append(sample)
-
-    def snapshot(self) -> List[Dict[str, Any]]:
-        with self._lock:
-            return list(self._buf)
-
-    def run_sampler(self, checker) -> None:
-        """Sampler loop body (run on a daemon thread): one snapshot
-        immediately, then one per interval until the run completes —
-        plus a final post-done sample so the series ends at the
-        terminal counts."""
-        while True:
-            done = checker.is_done()
-            try:
-                self.add(metrics_view(checker))
-            except Exception:
-                pass  # a mid-teardown snapshot race must not kill it
-            if done:
-                return
-            time.sleep(self.interval)
+    def feed(self, ev) -> None:
+        try:
+            self.q.put_nowait(ev)
+        except _queue.Full:
+            self.dropped += 1
+            if self._metrics is not None:
+                self._metrics.inc("sse_dropped")
+            if self.dropped == 1:
+                print(f"stateright-tpu: SSE client {self._label} is "
+                      "slow; dropping events (counted in the "
+                      "sse_dropped metric)", file=sys.stderr)
 
 
 def serve_events(handler, checker, qsize: int = 256) -> None:
@@ -203,21 +197,17 @@ def serve_events(handler, checker, qsize: int = 256) -> None:
                       b"(tpu_options(flight=False) with no trace sink)",
                       "text/plain")
         return
-    q: "_queue.Queue" = _queue.Queue(maxsize=qsize)
-    dropped = [0]
-
-    def sub(ev):
-        try:
-            q.put_nowait(ev)
-        except _queue.Full:
-            dropped[0] += 1  # slow client: drop, never block the engine
+    client = _SseClient(
+        qsize, metrics=getattr(checker, "_metrics", None),
+        label=str(getattr(handler, "client_address", ("?",))[0]))
+    q = client.q
 
     # backlog BEFORE subscribing: a client may then miss an event
     # emitted in the gap, but never sees duplicates (the lesser evil
     # for a console tailing deltas)
     recorder = getattr(checker, "_recorder", None)
     backlog = recorder.snapshot() if recorder is not None else []
-    trace.subscribe(sub)
+    trace.subscribe(client.feed)
     try:
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
@@ -243,9 +233,9 @@ def serve_events(handler, checker, qsize: int = 256) -> None:
                 continue
             write_ev(ev)
             handler.wfile.flush()
-        if dropped[0]:
+        if client.dropped:
             handler.wfile.write(
-                f": dropped {dropped[0]} events (slow client)\n\n"
+                f": dropped {client.dropped} events (slow client)\n\n"
                 .encode())
         handler.wfile.flush()
     except (BrokenPipeError, ConnectionResetError, OSError):
@@ -253,7 +243,7 @@ def serve_events(handler, checker, qsize: int = 256) -> None:
     finally:
         unsub = getattr(trace, "unsubscribe", None)
         if unsub is not None:
-            unsub(sub)
+            unsub(client.feed)
 
 
 def parse_fingerprints(fingerprints_str: str) -> List[int]:

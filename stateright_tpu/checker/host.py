@@ -19,7 +19,8 @@ from typing import Dict, Optional
 from ..core import Expectation, Model
 from ..fingerprint import fp64_node
 from ..obs import (FlightRecorder, Metrics, apply_artifact_dir,
-                   default_flight_path, fault_info, make_trace)
+                   default_flight_path, fault_info, identity_fields,
+                   make_trace, new_run_id)
 from .builder import Checker, CheckerBuilder
 
 
@@ -80,6 +81,13 @@ class HostChecker(Checker):
         self._trace = make_trace(obs_opts.get("trace"),
                                  engine=type(self).__name__,
                                  recorder=self._recorder)
+        # correlation identity (obs/trace.py): every run is born with a
+        # run_id; the job service injects its job id through
+        # tpu_options(job_id=...) so the engine's own trace stream is
+        # join-able with the scheduler's service.jsonl without guessing
+        # from file paths. Stamped onto run_start by _step_wrapper.
+        self._run_id = obs_opts.get("run_id") or new_run_id()
+        self._job_id = obs_opts.get("job_id")
 
     def _timed(self, name: str):
         """Accumulate wall time under a glossary phase key."""
@@ -92,6 +100,11 @@ class HostChecker(Checker):
         rendered in README.md § Observability) — rather than restated
         per engine; engines report only the phases they run."""
         return self._metrics.snapshot()
+
+    def run_id(self) -> str:
+        """This run's correlation id (stamped on its ``run_start``
+        trace event and every artifact derived from it)."""
+        return self._run_id
 
     def subscribe(self, fn) -> None:
         """Register a live progress callback on the run trace; ``fn``
@@ -305,9 +318,16 @@ class HostChecker(Checker):
         background-thread contract."""
         trace = self._trace
         if trace:
+            # the correlation header rides run_start: run_id, the
+            # stream's wall anchor, this process's host/rank, and the
+            # owning job when the service drives the run — any single
+            # artifact is then self-describing on the fleet timeline
+            header = identity_fields(trace, self._run_id)
+            if self._job_id is not None:
+                header["job"] = self._job_id
             trace.emit("run_start", model=type(self._model).__name__,
                        wall=time.time(),
-                       properties=len(self._properties))
+                       properties=len(self._properties), **header)
             faults = fault_info(self._model)
             if faults is not None:
                 trace.emit("fault_injection", **faults)

@@ -14,7 +14,8 @@
 from .launch import FleetResult, launch_fleet, pick_port, worker_env
 from .mesh import (FleetContext, dcn_probe, device_host, fleet_mesh,
                    force_cpu_devices, init_from_env, init_process,
-                   mesh_hosts, mesh_spans_processes, pull_global)
+                   mesh_hosts, mesh_spans_processes, process_identity,
+                   pull_global)
 
 __all__ = [
     "FleetContext",
@@ -29,6 +30,7 @@ __all__ = [
     "mesh_hosts",
     "mesh_spans_processes",
     "pick_port",
+    "process_identity",
     "pull_global",
     "worker_env",
 ]

@@ -119,6 +119,13 @@ def launch_fleet(cmd: Sequence[str], num_procs: int, *,
     markers land.
     """
     os.makedirs(out_dir, exist_ok=True)
+    if trace is not None:
+        # the correlation header (obs/trace.py): fleet.jsonl carries
+        # its own run id + wall anchor so obs/aggregate.py can place
+        # the launcher's host_join/mesh_init events on the same
+        # timeline as the ranks' engine traces
+        from ..obs import emit_trace_header
+        emit_trace_header(trace, prefix="fleet", procs=int(num_procs))
     coordinator = coordinator or f"127.0.0.1:{pick_port()}"
     procs: List[subprocess.Popen] = []
     logs: List[str] = []

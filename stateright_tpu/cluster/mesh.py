@@ -146,6 +146,35 @@ def init_from_env() -> Optional[FleetContext]:
 # ----------------------------------------------------------------------
 # host identity
 # ----------------------------------------------------------------------
+def process_identity() -> tuple:
+    """``(rank, host)`` of THIS process — the fields every trace
+    stream's correlation header carries (``obs/trace.py``), so a rank's
+    artifact names its own position in the fleet.
+
+    Rank resolution order: the launcher's ``STPU_RANK`` env contract
+    (set before any backend exists, so it is authoritative for
+    launcher-spawned workers), else ``jax.process_index()`` — but ONLY
+    when a backend is already live: a trace header must never be the
+    thing that initializes JAX (host engines run backend-free). Host is
+    the OS hostname (ranks of a real pod land on distinct machines; CPU
+    dry-run ranks share one, which is why the rank rides alongside)."""
+    import socket
+    import sys
+    host = socket.gethostname()
+    rank = os.environ.get(ENV_RANK)
+    if rank is not None:
+        return int(rank), host
+    try:
+        jaxmod = sys.modules.get("jax")
+        if jaxmod is not None:
+            from jax._src import xla_bridge
+            if getattr(xla_bridge, "_backends", None):
+                return int(jaxmod.process_index()), host
+    except Exception:
+        pass
+    return 0, host
+
+
 def device_host(device, host_map=None):
     """The host label of a device: the injected ``host_map`` (a
     ``{device_id: label}`` dict — the simulated-fleet knob

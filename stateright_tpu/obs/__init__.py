@@ -14,16 +14,26 @@
   a JSONL postmortem artifact when a run dies (README.md
   § Observability, "Flight recorder").
 
+* :mod:`~stateright_tpu.obs.aggregate` — the fleet timeline: merge any
+  set of engine/job/service/fleet JSONL streams into one wall-anchored,
+  identity-resolved event list (``tools/trace_report.py --fleet``).
+* :mod:`~stateright_tpu.obs.prom` — Prometheus text exposition of
+  ``Metrics`` registries (the service's ``GET /metrics`` scrape
+  endpoint).
+
 See README.md § Observability for the trace format and how to read a
 stall; ``tools/trace_report.py`` renders a trace as a per-phase table.
+(``aggregate`` and ``prom`` are imported lazily by their consumers —
+not re-exported here — so ``import stateright_tpu.obs`` stays light.)
 """
 
 from .artifacts import (ARTIFACT_NAMES, apply_artifact_dir,
                         artifact_paths)
-from .metrics import GAUGES, GLOSSARY, MAXIMA, Metrics
+from .metrics import GAUGES, GLOSSARY, MAXIMA, Metrics, MetricsRing
 from .recorder import FlightRecorder, default_flight_path
 from .trace import (EVENT_SCHEMA, NULL_TRACE, NullTrace, RunTrace,
-                    fault_info, make_trace, validate_event)
+                    emit_trace_header, fault_info, identity_fields,
+                    make_trace, new_run_id, validate_event)
 
 __all__ = [
     "ARTIFACT_NAMES",
@@ -33,13 +43,17 @@ __all__ = [
     "GLOSSARY",
     "MAXIMA",
     "Metrics",
+    "MetricsRing",
     "NULL_TRACE",
     "NullTrace",
     "RunTrace",
     "apply_artifact_dir",
     "artifact_paths",
     "default_flight_path",
+    "emit_trace_header",
     "fault_info",
+    "identity_fields",
     "make_trace",
+    "new_run_id",
     "validate_event",
 ]

@@ -225,6 +225,29 @@ GLOSSARY: Dict[str, str] = {
                    "checkpoint, typically on a smaller subset)",
     "queue_depth": "jobs currently waiting for a device subset "
                    "(gauge; sampled after every scheduling pass)",
+    # --- utilization + SLO accounting (PR 14) --------------------------
+    "queue_wait_s": "cumulative submit->grant wall seconds across jobs "
+                    "(the queueing SLO numerator; divide by "
+                    "jobs_submitted-queue_depth for the mean wait; "
+                    "per-job values ride job_grant events and "
+                    "result.json lifecycle)",
+    "first_chunk_s": "cumulative start->first-materialized-chunk wall "
+                     "seconds across jobs — the compile/seed latency a "
+                     "tenant pays before any progress (per-job values "
+                     "ride job_first_chunk events)",
+    "pool_busy_frac": "fraction of the device pool currently leased "
+                      "(gauge; 1 - free_width/width, sampled by the "
+                      "scheduler's utilization sampler — the per-host "
+                      "split rides pool_util events and "
+                      "Scheduler.utilization())",
+    "jobs_per_min": "completions in the trailing 60s window (gauge; "
+                    "the service-throughput SLO the batch lane engine "
+                    "exists to move)",
+    "sse_dropped": "events dropped across SSE clients too slow to "
+                   "drain their bounded queues (Explorer /.events and "
+                   "the service's per-job /events; the engine writer "
+                   "never blocks — a rising count means a console is "
+                   "starved, not the run)",
     # --- batch lane engine (service/batch.py + checker/batch_loop.py) --
     "batched_jobs": "jobs completed as lanes of a vmapped batch chunk "
                     "program (vs solo engine runs) — the "
@@ -264,6 +287,7 @@ GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
     "shard_balance", "host_tier_keys", "queue_depth", "lanes",
     "hosts", "procs", "fused_unsupported", "cc_dedup_capacity",
+    "pool_busy_frac", "jobs_per_min",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).
@@ -337,3 +361,57 @@ class Metrics:
 
     def __repr__(self) -> str:  # debugging aid
         return f"Metrics({self._data!r})"
+
+
+class MetricsRing:
+    """Bounded time series of periodic metric snapshots.
+
+    Lived in ``checker/explorer.py`` through PR 13 as the
+    ``/.metrics?history`` backing store; it is an obs concern (moved
+    here in PR 14) because the service's utilization accounting needs
+    the same shape — a daemon sampler appends one snapshot per
+    ``interval`` seconds while the producer is live, the ring keeps
+    the most recent ``limit`` samples, and a consumer attaching
+    mid-run can plot the trend it missed without having polled from
+    the start. Every sample is stamped with its ``wall`` time."""
+
+    def __init__(self, limit: int = 512, interval: float = 1.0):
+        import threading as _threading
+        from collections import deque as _deque
+        self.interval = interval
+        self._buf = _deque(maxlen=max(4, int(limit)))
+        self._lock = _threading.Lock()
+
+    def add(self, sample: Dict) -> None:
+        sample = dict(sample)
+        sample["wall"] = time.time()
+        with self._lock:
+            self._buf.append(sample)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._buf)
+
+    def sample_until(self, sample_fn, done_fn) -> None:
+        """Generic sampler loop body (run on a daemon thread): one
+        snapshot immediately, then one per interval until ``done_fn()``
+        is true — plus a final post-done sample so the series ends at
+        the terminal values. Sampling exceptions are swallowed (a
+        mid-teardown race must not kill the sampler's owner)."""
+        while True:
+            done = bool(done_fn())
+            try:
+                self.add(sample_fn())
+            except Exception:
+                pass
+            if done:
+                return
+            time.sleep(self.interval)
+
+    def run_sampler(self, checker) -> None:
+        """The Explorer's historical entry point: snapshot a checker's
+        ``/.metrics`` view until the run completes (kept here so the
+        ``checker.explorer`` re-export stays drop-in compatible)."""
+        from ..checker.explorer import metrics_view
+        self.sample_until(lambda: metrics_view(checker),
+                          checker.is_done)

@@ -49,7 +49,8 @@ _DUMP_COUNTER = itertools.count()
 class FlightRecorder:
     """Bounded, thread-safe ring buffer of trace-event dicts."""
 
-    __slots__ = ("limit", "recorded", "dropped", "_buf", "_lock")
+    __slots__ = ("limit", "recorded", "dropped", "_buf", "_lock",
+                 "_header")
 
     def __init__(self, limit: int = DEFAULT_LIMIT):
         self.limit = max(16, int(limit))
@@ -57,6 +58,11 @@ class FlightRecorder:
         self.dropped = 0   # events evicted by the bound
         self._buf: deque = deque(maxlen=self.limit)
         self._lock = threading.Lock()
+        # the run's identity header (the first run_start/trace_header
+        # seen): PINNED outside the ring, so a long run whose ring has
+        # evicted the opening events still dumps a self-describing
+        # artifact that obs/aggregate.py can place on a fleet timeline
+        self._header: "Dict[str, Any] | None" = None
 
     def record(self, event: Dict[str, Any]) -> None:
         """Append one event (called from ``RunTrace.emit`` under its
@@ -64,15 +70,26 @@ class FlightRecorder:
         thread — the SSE backlog replay, a crashing engine — is safe).
         """
         with self._lock:
+            if (self._header is None
+                    and event.get("ev") in ("run_start",
+                                            "trace_header")):
+                self._header = event
             if len(self._buf) == self.limit:
                 self.dropped += 1
             self._buf.append(event)
             self.recorded += 1
 
     def snapshot(self) -> List[Dict[str, Any]]:
-        """A copy of the ring's current contents, oldest first."""
+        """A copy of the ring's current contents, oldest first — with
+        the pinned identity header prepended when the ring's bound has
+        already evicted it."""
         with self._lock:
-            return list(self._buf)
+            out = list(self._buf)
+            if self._header is not None and (
+                    not out or out[0] is not self._header):
+                if self._header not in out:
+                    out.insert(0, self._header)
+            return out
 
     def dump(self, path) -> int:
         """Write the ring as JSONL to ``path`` (overwrites — repeated

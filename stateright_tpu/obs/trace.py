@@ -43,8 +43,20 @@ from typing import Any, Callable, Dict, List, Optional
 #: Optional fields (per-shard vectors, rates, reasons) may ride along;
 #: consumers must ignore fields they do not know.
 EVENT_SCHEMA: Dict[str, frozenset] = {
-    # one per run
+    # one per run. Since the fleet observability plane (PR 14) engine
+    # run_start events additionally carry the CORRELATION HEADER —
+    # `run_id` (unique per run), `t0_unix` (the trace's wall-clock
+    # anchor: wall(event) = t0_unix + event.t), `host`/`rank`
+    # (cluster/mesh.py process_identity), and `job`/`lane` when the
+    # service or batch engine drives the run — optional in the schema
+    # so pre-header artifacts still validate, but obs/aggregate.py
+    # needs them to place a stream on the fleet timeline
     "run_start": frozenset({"model", "wall"}),
+    # the header twin for streams with no run_start of their own
+    # (service.jsonl, fleet.jsonl): emitted once when the stream opens
+    # (emit_trace_header), so obs/aggregate.py can anchor and identify
+    # every stream the same way
+    "trace_header": frozenset({"run_id", "t0_unix", "host", "rank"}),
     "done": frozenset({"gen", "unique"}),
     "error": frozenset({"error"}),
     # chunk-loop progress (device engines); sharded runs add
@@ -129,10 +141,21 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # transition (`state`: done / failed / cancelled; optional fields
     # ride along — unique counts, error strings, the blamed job)
     "job_submit": frozenset({"job", "model", "priority"}),
+    # SLO lifecycle stamps (PR 14): `job_grant` — the pool granted the
+    # job its device subset (the queue-wait clock stops here);
+    # `job_first_chunk` — the job's engine materialized its first
+    # chunk (compile/seed latency ends; carries `first_chunk_s`)
+    "job_grant": frozenset({"job", "width"}),
     "job_start": frozenset({"job", "width"}),
+    "job_first_chunk": frozenset({"job"}),
     "job_pause": frozenset({"job", "reason"}),
     "job_resume": frozenset({"job", "width"}),
     "job_done": frozenset({"job", "state"}),
+    # device-pool utilization sample (engine="service"): the busy
+    # fraction of the whole pool plus the per-host split, emitted on
+    # change by the scheduler's utilization sampler — the series
+    # tools/fleetboard.py and the fleet timeline read
+    "pool_util": frozenset({"busy_frac", "per_host"}),
     # the batch lane engine (service/batch.py + checker/batch_loop.py):
     # `bucket_flush` — a bucket queue launched as a batch (reason:
     # "full" | "max_wait"); `batch_form` — the batch's initial lane
@@ -217,6 +240,11 @@ class RunTrace:
                  recorder=None):
         self._engine = engine
         self._t0 = time.monotonic()
+        #: wall-clock anchor: every event's absolute time is
+        #: ``t0_unix + event["t"]`` — what the correlation header
+        #: publishes so obs/aggregate.py can join streams from
+        #: different processes/hosts onto one fleet timeline
+        self.t0_unix = time.time()
         self._lock = threading.Lock()
         self._subs: List[Callable[[Dict[str, Any]], None]] = []
         self._recorder = recorder
@@ -309,6 +337,42 @@ def make_trace(sink: Any, engine: str,
             sink._recorder = recorder
         return sink
     return RunTrace(sink, engine=engine, recorder=recorder)
+
+
+def new_run_id(prefix: str = "run") -> str:
+    """A fresh correlation id for one trace stream/run. Short (12 hex
+    chars of entropy) but collision-safe at fleet scale; the prefix
+    tags the stream kind (``run``/``svc``/``fleet``/``soak``) so a
+    merged timeline reads without a legend."""
+    import uuid
+    return f"{prefix}-{uuid.uuid4().hex[:12]}"
+
+
+def identity_fields(trace, run_id: str) -> Dict[str, Any]:
+    """The correlation-header fields stamped onto ``run_start`` (engine
+    streams) or a ``trace_header`` event (service/fleet streams):
+    ``run_id``, the stream's wall-clock anchor ``t0_unix``, and this
+    process's ``host``/``rank`` (``cluster/mesh.py``)."""
+    from ..cluster.mesh import process_identity
+    rank, host = process_identity()
+    return {"run_id": run_id,
+            "t0_unix": getattr(trace, "t0_unix", None),
+            "host": host, "rank": rank}
+
+
+def emit_trace_header(trace, run_id: Optional[str] = None,
+                      prefix: str = "run", **extra) -> Optional[str]:
+    """Stamp the correlation header on a stream with no ``run_start``
+    of its own (the scheduler's ``service.jsonl``, the launcher's
+    ``fleet.jsonl``). Returns the run id used (None when the trace is
+    disabled). Engine streams do NOT call this — their header rides
+    ``run_start`` (``HostChecker._step_wrapper``)."""
+    if not trace:
+        return None
+    run_id = run_id or new_run_id(prefix)
+    trace.emit("trace_header", **identity_fields(trace, run_id),
+               **extra)
+    return run_id
 
 
 def fault_info(model) -> Optional[Dict[str, Any]]:

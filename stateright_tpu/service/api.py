@@ -23,9 +23,19 @@ Endpoints (JSON unless noted):
                                 trace.jsonl replays and the stream ends
     GET  /jobs/<id>/metrics     live engine metrics (RUNNING) or the
                                 recorded result profile
+    GET  /metrics               Prometheus text exposition (0.0.4):
+                                the scheduler registry merged with
+                                every LIVE per-job registry under
+                                job/host labels (obs/prom.py) — the
+                                fleet's ONE scrape target; the
+                                Explorer keeps its JSON endpoints
+    GET  /utilization           device-pool occupancy: current busy
+                                fraction, per-host split, queue depth,
+                                plus the sampler's bounded time series
 
 ``tools/jobs.py`` is the CLI client (serve / submit / watch / result /
-list / pause / resume / cancel).
+list / pause / resume / cancel) and ``tools/fleetboard.py`` the live
+operator console over /jobs + /utilization.
 """
 
 from __future__ import annotations
@@ -117,7 +127,15 @@ def _make_handler(scheduler: Scheduler):
             path, _, _query = self.path.partition("?")
             parts = [p for p in path.split("/") if p]
             try:
-                if parts == ["jobs"]:
+                if parts == ["metrics"]:
+                    from ..obs import prom
+                    body = prom.render(scheduler.prom_rows())
+                    self._send(200, body.encode(),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif parts == ["utilization"]:
+                    self._send_json(200, scheduler.utilization())
+                elif parts == ["jobs"]:
                     self._send_json(200, {
                         "jobs": [j.view() for j in scheduler.jobs()],
                         "profile": scheduler.profile()})

@@ -44,7 +44,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from ..obs import Metrics, make_trace
+from ..obs import Metrics, identity_fields, make_trace, new_run_id
 from . import jobs as jobstates
 from .jobs import Job
 
@@ -392,10 +392,14 @@ class BatchRun:
             sched_trace.emit("job_start", job=job.id, width=1,
                              batch=self.id, lane=lane)
         if tr:
+            # the correlation header (obs/trace.py): a lane-job's
+            # stream is self-describing on the fleet timeline exactly
+            # like a solo engine run's
             tr.emit("run_start", model=type(self._model).__name__,
                     wall=time.time(),
                     properties=len(self._model.properties()),
-                    batch=self.id, lane=lane)
+                    **identity_fields(tr, new_run_id("lane")),
+                    job=job.id, batch=self.id, lane=lane)
         return True
 
     def _lanes_disc_live(self, lane: int) -> Dict[str, int]:
@@ -450,6 +454,7 @@ class BatchRun:
             view.finish()
             self._metrics.inc("batched_jobs")
             sched._metrics.inc("jobs_done")
+            sched._note_done()  # the jobs/min window counts lanes too
             job.set_state(jobstates.DONE,
                           unique=result["unique_state_count"])
             self._lane_retire_event(job, lane, "done",

@@ -14,8 +14,11 @@ standalone run's ``tpu_options(artifact_dir=...)``):
         trace.jsonl    the run-trace JSONL stream
         flight.jsonl   the flight-recorder postmortem dump (on crash)
         result.json    the final result summary (properties,
-                       unique_state_count, discoveries, profile, and a
-                       fingerprint-set digest for parity checks)
+                       unique_state_count, discoveries, profile, the
+                       submit→grant→start→first-chunk→done lifecycle
+                       stamps with derived queue_wait_s/first_chunk_s/
+                       run_s, and a fingerprint-set digest for parity
+                       checks)
 
 Jobs survive a service restart: ``JobStore.load_all`` re-reads every
 directory, and the scheduler's recovery pass re-enqueues ``queued``
@@ -258,8 +261,9 @@ class Job:
         if self.spec.batch:
             out["batch_requested"] = self.spec.batch
         for key in ("seq", "granted_width", "resume", "preempted",
-                    "batch", "lane", "batch_fallback",
-                    "error", "queued_at", "running_at", "paused_at",
+                    "batch", "lane", "batch_fallback", "hosts",
+                    "unique", "error", "queued_at", "granted_at",
+                    "running_at", "first_chunk_at", "paused_at",
                     "done_at", "failed_at", "cancelled_at"):
             if key in self.status:
                 out[key] = self.status[key]

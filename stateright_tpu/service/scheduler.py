@@ -46,7 +46,7 @@ import time
 from collections import deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
-from ..obs import Metrics, make_trace
+from ..obs import Metrics, MetricsRing, emit_trace_header, make_trace
 from . import jobs as jobstates
 from .driver import DONE, FAILED, RUNNING, StepDriver
 from .jobs import Job, JobSpec, JobStore, TERMINAL_STATES
@@ -272,7 +272,7 @@ class _JobRuntime:
     one-slot control channel (pause / preempt / shutdown / cancel)."""
 
     __slots__ = ("lease", "thread", "checker", "driver", "_control",
-                 "_ctl_lock")
+                 "_ctl_lock", "granted_at", "first_chunk_seen")
 
     def __init__(self, lease: DeviceLease):
         self.lease = lease
@@ -281,6 +281,10 @@ class _JobRuntime:
         self.driver: Optional[StepDriver] = None
         self._control: Optional[str] = None
         self._ctl_lock = threading.Lock()
+        # SLO lifecycle stamps (PR 14): when the pool granted the
+        # subset, and whether the first-chunk latency has been recorded
+        self.granted_at = time.time()
+        self.first_chunk_seen = False
 
     def set_control(self, ctl: str) -> None:
         with self._ctl_lock:
@@ -338,6 +342,20 @@ class Scheduler:
         self._trace = make_trace(
             self._store.service_trace_path if trace is None else trace,
             engine="service")
+        # correlation header: service.jsonl has no run_start of its
+        # own, so the scheduler stamps a trace_header at boot (a
+        # restarted scheduler appends a new header — obs/aggregate.py
+        # segments the stream on it)
+        self._run_id = emit_trace_header(self._trace, prefix="svc")
+        # --- utilization + SLO accounting (PR 14) ----------------------
+        #: completion wall times inside the trailing 60s window (the
+        #: jobs_per_min gauge)
+        self._done_times: deque = deque()
+        #: bounded busy-fraction time series (per-host split included
+        #: in every sample; obs/metrics.py MetricsRing)
+        self._util_ring = MetricsRing(limit=512, interval=1.0)
+        self._util_prev: Optional[tuple] = None
+        self._util_thread: Optional[threading.Thread] = None
         self._devices = None if devices is None else list(devices)
         #: per-device host labels (simulated fleets / real
         #: process_index grouping) — the two-level pool's second level
@@ -533,6 +551,84 @@ class Scheduler:
                 self._devices = list(jax.devices())
             self._pool = DevicePool(self._devices, hosts=self._hosts)
             self._metrics.set("hosts", self._pool.host_count)
+            # the utilization sampler: one busy-fraction sample per
+            # second while the service lives (plus a synchronous
+            # sample after every placement pass, so tests and bursty
+            # schedulers see every occupancy step without sleeping)
+            self._util_thread = threading.Thread(
+                target=self._util_ring.sample_until,
+                args=(self._util_sample, lambda: self._closed),
+                name="stateright-util-sampler", daemon=True)
+            self._util_thread.start()
+
+    # --- utilization accounting (PR 14) --------------------------------
+    def _util_sample(self) -> dict:
+        """One busy-fraction sample of the device pool (called under
+        no lock by the sampler thread; takes the scheduler lock for a
+        consistent pool view). Sets the ``pool_busy_frac`` gauge and
+        emits a ``pool_util`` event when occupancy changed."""
+        with self._lock:
+            if self._pool is None:
+                return {"busy_frac": 0.0, "per_host": {},
+                        "queue_depth": 0}
+            per_free = self._pool.per_host_free()
+            hw = self._pool.host_width
+            width = self._pool.width
+            free = self._pool.free_width()
+            depth = int(self._metrics.get("queue_depth", 0) or 0)
+        per_host = {str(h): round(1.0 - f / hw, 4)
+                    for h, f in per_free.items()}
+        busy = round(1.0 - free / width, 4) if width else 0.0
+        self._metrics.set("pool_busy_frac", busy)
+        fingerprint = (busy, tuple(sorted(per_host.items())))
+        if fingerprint != self._util_prev:
+            self._util_prev = fingerprint
+            self._trace.emit("pool_util", busy_frac=busy,
+                             per_host=per_host, queue_depth=depth)
+        return {"busy_frac": busy, "per_host": per_host,
+                "queue_depth": depth}
+
+    def utilization(self) -> dict:
+        """The live utilization view (`GET /utilization`): current
+        pool occupancy plus the sampler's bounded time series."""
+        current = self._util_sample()
+        self._util_ring.add(current)
+        return {"width": self._pool.width if self._pool else 0,
+                "hosts": (self._pool.host_count if self._pool
+                          else 0),
+                **current,
+                "samples": self._util_ring.snapshot()}
+
+    def prom_rows(self) -> list:
+        """``(labels, registry)`` rows for the Prometheus exposition
+        (``obs/prom.py``): the scheduler's own registry unlabeled,
+        plus every LIVE per-job registry under ``job``/``host``
+        labels (batches export one row under their batch id — the
+        lanes share one registry)."""
+        rows = [({}, self._metrics.snapshot())]
+        with self._lock:
+            running = [(jid, rt.checker,
+                        ",".join(str(h) for h in rt.lease.hosts))
+                       for jid, rt in self._running.items()]
+            batches = [(brt.run, ",".join(str(h) for h in
+                                          brt.lease.hosts))
+                       for brt in self._batch_running.values()
+                       if brt.run is not None]
+        for jid, checker, hosts in running:
+            if checker is None:
+                continue
+            try:
+                rows.append(({"job": jid, "host": hosts},
+                             checker.profile()))
+            except Exception:
+                continue  # a mid-teardown profile race drops one row
+        for run, hosts in batches:
+            try:
+                rows.append(({"job": run.id, "host": hosts},
+                             run._metrics.snapshot()))
+            except Exception:
+                continue
+        return rows
 
     # --- batch lane engine plumbing (service/batch.py) -----------------
     def _batch_rt_for(self, job_id: str) -> Optional[_BatchRuntime]:
@@ -740,6 +836,13 @@ class Scheduler:
                         if j.state == jobstates.QUEUED
                         and j.id not in self._running)
             self._metrics.set("queue_depth", depth)
+        # synchronous utilization step: every placement pass lands a
+        # sample, so occupancy edges are never lost between the 1 Hz
+        # sampler ticks. OUTSIDE the lock: the pool_util emit writes a
+        # line to service.jsonl, and a finishing job's lease release
+        # queues behind this critical section — holding the lock
+        # across file I/O visibly delayed buddy merge-back
+        self._util_ring.add(self._util_sample())
 
     def _maybe_preempt(self, job: Job) -> None:
         """Nothing is free and ``job`` waits: pause the lowest-priority
@@ -760,6 +863,19 @@ class Scheduler:
         # concurrent _schedule pass can never double-place the job
         rt = _JobRuntime(lease)
         self._running[job.id] = rt
+        # SLO stamp: the queue-wait clock stops the moment the pool
+        # GRANTS the subset (compile/seed latency is first_chunk_s's
+        # problem, not queueing's)
+        job.status["granted_at"] = rt.granted_at
+        queued_at = job.status.get("queued_at")
+        if queued_at is not None:
+            self._metrics.add_time(
+                "queue_wait_s", max(0.0, rt.granted_at - queued_at))
+        self._trace.emit(
+            "job_grant", job=job.id, width=lease.width,
+            hosts=[str(h) for h in lease.hosts],
+            queue_wait_s=(round(rt.granted_at - queued_at, 6)
+                          if queued_at is not None else None))
         thread = threading.Thread(
             target=self._run_job, args=(job, lease, rt),
             name=f"stateright-job-{job.id}", daemon=True)
@@ -801,7 +917,8 @@ class Scheduler:
             model = job.spec.build()
             builder = (model.checker()
                        .tpu_options(**job.spec.options)
-                       .tpu_options(race=False, artifact_dir=job.dir))
+                       .tpu_options(race=False, artifact_dir=job.dir,
+                                    job_id=job.id))
             if lease.width > 1:
                 from jax.sharding import Mesh
                 builder.tpu_options(mesh=Mesh(
@@ -859,6 +976,18 @@ class Scheduler:
                                      state="cancelled")
                     return
                 status = driver.step(self._step_budget)
+                if not rt.first_chunk_seen \
+                        and checker.state_count() > 0:
+                    # the engine materialized its first chunk: the
+                    # compile/seed latency a tenant pays before any
+                    # progress ends here
+                    rt.first_chunk_seen = True
+                    now = time.time()
+                    job.status["first_chunk_at"] = now
+                    elapsed = max(0.0, now - rt.granted_at)
+                    self._metrics.add_time("first_chunk_s", elapsed)
+                    self._trace.emit("job_first_chunk", job=job.id,
+                                     first_chunk_s=round(elapsed, 6))
                 if delay:
                     time.sleep(delay)
                 if status != RUNNING:
@@ -878,17 +1007,51 @@ class Scheduler:
         assert driver.status == DONE, driver.status
         result = write_result(job, checker)
         self._metrics.inc("jobs_done")
+        self._note_done()
         job.set_state(jobstates.DONE,
                       unique=result["unique_state_count"])
         self._trace.emit("job_done", job=job.id, state="done",
                          unique=result["unique_state_count"])
 
+    def _note_done(self) -> None:
+        """Roll the jobs/min window forward by one completion."""
+        now = time.time()
+        self._done_times.append(now)
+        while self._done_times and now - self._done_times[0] > 60.0:
+            self._done_times.popleft()
+        self._metrics.set("jobs_per_min", len(self._done_times))
+
+
+def job_lifecycle(job: Job, done_wall: Optional[float] = None) -> dict:
+    """The submit→grant→start→first-chunk→done stamps (absolute wall
+    seconds) plus the derived SLO intervals, from the job's status
+    dict — what ``result.json`` records so a postmortem reads queueing
+    vs compile vs run time without re-deriving from events."""
+    status = job.status
+    out = {}
+    for key, stamp in (("submit", "queued_at"),
+                       ("grant", "granted_at"),
+                       ("start", "running_at"),
+                       ("first_chunk", "first_chunk_at")):
+        if status.get(stamp) is not None:
+            out[key] = status[stamp]
+    done = done_wall if done_wall is not None else time.time()
+    out["done"] = done
+    if "submit" in out and "grant" in out:
+        out["queue_wait_s"] = round(out["grant"] - out["submit"], 6)
+    if "grant" in out and "first_chunk" in out:
+        out["first_chunk_s"] = round(
+            out["first_chunk"] - out["grant"], 6)
+    if "start" in out:
+        out["run_s"] = round(done - out["start"], 6)
+    return out
+
 
 def write_result(job: Job, checker) -> dict:
     """The durable result summary: property verdicts, counts, the
-    discoveries (encoded fingerprint paths), the metrics profile, and
-    a sha256 digest of the sorted reached fingerprint set — the
-    restart/parity tests' bit-identity hook."""
+    discoveries (encoded fingerprint paths), the metrics profile, the
+    lifecycle/SLO stamps, and a sha256 digest of the sorted reached
+    fingerprint set — the restart/parity tests' bit-identity hook."""
     import hashlib
     import json as _json
 
@@ -912,10 +1075,13 @@ def write_result(job: Job, checker) -> dict:
     result = {
         "job": job.id,
         "model": job.spec.model_name,
+        "run_id": (checker.run_id()
+                   if hasattr(checker, "run_id") else None),
         "state_count": checker.state_count(),
         "unique_state_count": checker.unique_state_count(),
         "properties": properties,
         "profile": profile,
+        "lifecycle": job_lifecycle(job),
         "fingerprint_count": len(fps),
         "fingerprints_sha256": digest,
     }

@@ -406,6 +406,42 @@ class TestSchemaDriftLint:
                if key not in GLOSSARY]
         assert not bad, f"metrics keys missing from GLOSSARY: {bad}"
 
+    def test_schema_events_all_have_emit_sites(self):
+        """The reverse lint (PR 14): every EVENT_SCHEMA entry must be
+        emitted somewhere in the source tree — schema entries nothing
+        emits are dead weight that silently bless typo'd names. This
+        is what guarantees the lint actually COVERS the service
+        (job_*), fleet (host_join/mesh_init), and lifecycle/
+        aggregation emit sites rather than merely not rejecting
+        them."""
+        import re
+        emit_re = re.compile(r'\.emit\(\s*[\'"]([a-z_0-9]+)[\'"]')
+        emitted = set()
+        for _path, src in self._sources():
+            emitted.update(emit_re.findall(src))
+        dead = set(EVENT_SCHEMA) - emitted
+        assert not dead, f"EVENT_SCHEMA events nothing emits: {dead}"
+
+    def test_lifecycle_and_fleet_families_are_pinned(self):
+        """The service/fleet/observability-plane event families and
+        the PR-14 glossary keys must stay registered — a drive-by
+        rename breaks every recorded artifact's consumers."""
+        for ev in ("trace_header",
+                   "job_submit", "job_grant", "job_start",
+                   "job_first_chunk", "job_pause", "job_resume",
+                   "job_done", "pool_util",
+                   "mesh_init", "host_join", "host_drop",
+                   "bucket_flush", "batch_form", "lane_retire"):
+            assert ev in EVENT_SCHEMA, ev
+        for key in ("queue_wait_s", "first_chunk_s", "pool_busy_frac",
+                    "jobs_per_min", "sse_dropped", "queue_depth",
+                    "jobs_submitted", "jobs_done", "hosts", "procs"):
+            assert key in GLOSSARY, key
+        # the exposition typing derives from GAUGES: the new gauges
+        # must be registered there or /metrics would type them counter
+        from stateright_tpu.obs import GAUGES
+        assert {"pool_busy_frac", "jobs_per_min"} <= GAUGES
+
 
 # --- consumers -------------------------------------------------------------
 

@@ -203,7 +203,14 @@ class TestTwoHostScheduler:
         prof = sched.profile()
         assert prof["jobs_done"] == 4
         assert prof["hosts"] == 2
-        # completion merged the carves back through both levels
+        # completion merged the carves back through both levels. The
+        # state flip deliberately precedes the worker's lease release
+        # (wait() unblocks on the status artifact), so give the last
+        # finally block a moment to land its release
+        deadline = time.monotonic() + 5.0
+        while sched._pool.largest_free() != 4 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert sched._pool.largest_free() == 4
         assert sched._pool.per_host_free() == {"h0": 2, "h1": 2}
         sched.shutdown()
@@ -488,9 +495,12 @@ class TestServiceApi:
             for line in f:
                 if line.strip():
                     service_events.append(json.loads(line))
+        # the full SLO lifecycle (PR 14): submit -> grant -> start ->
+        # first-chunk -> done
         assert [e["ev"] for e in service_events
                 if e["ev"].startswith("job_")] == \
-            ["job_submit", "job_start", "job_done"]
+            ["job_submit", "job_grant", "job_start",
+             "job_first_chunk", "job_done"]
         for ev in service_events:
             validate_event(ev)
             assert ev["engine"] == "service"

@@ -1,0 +1,240 @@
+"""Live fleet console for the job service: pool, queue, jobs, SLOs.
+
+Usage:
+    python tools/fleetboard.py --url http://host:port [--interval 2]
+    python tools/fleetboard.py SERVICE_ROOT [--once]
+
+One frame per interval (or one frame with ``--once``):
+
+    == fleetboard 12:00:01  jobs run=2 queued=1 done=5 failed=0 ...
+    pool  62% busy  [0] ####---- 4/8   [1] ##------ 2/8   trend _.:=+#
+    jobs:
+      j0003-twopc  running  w=2 host=0  uniq=12,345  +8.2k/s
+    slo: queue_wait 0.41s/job  first_chunk 1.92s/job
+    interventions: preemptions=1 retries=0 sse_dropped=0
+
+``--url`` polls the service HTTP API (``GET /jobs`` +
+``GET /utilization``); a SERVICE_ROOT argument reads the durable
+artifacts offline (job directories + ``service.jsonl`` via
+``tools/watch.py``'s file follower) — the postmortem twin of the live
+board. Rendering reuses ``tools/watch.py``'s console sources
+(rate formatting, JSONL tailing); per-job throughput is the delta of
+``unique`` between frames.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import watch  # noqa: E402  (the shared console sources)
+
+#: ASCII sparkline levels for the busy-fraction trend
+_SPARK = "_.:-=+*#"
+
+
+def spark(values: List[float]) -> str:
+    """An ASCII sparkline of 0..1 values."""
+    out = []
+    for v in values:
+        v = min(max(float(v), 0.0), 1.0)
+        out.append(_SPARK[min(int(v * len(_SPARK)), len(_SPARK) - 1)])
+    return "".join(out)
+
+
+def _bar(busy_frac: float, width: int = 8) -> str:
+    filled = int(round(busy_frac * width))
+    return "#" * filled + "-" * (width - filled)
+
+
+class Board:
+    """Stateful frame renderer: feed() it snapshots, get frames back.
+
+    A snapshot is ``{"jobs": [job views], "profile": scheduler
+    profile, "utilization": {...}}`` — exactly what the HTTP API
+    serves, so the offline reader fabricates the same shape."""
+
+    def __init__(self):
+        self._prev_uniq: Dict[str, int] = {}
+        self._prev_t: Optional[float] = None
+        self.frames = 0
+
+    def feed(self, snap: Dict[str, Any]) -> str:
+        now = time.time()
+        jobs = snap.get("jobs") or []
+        prof = snap.get("profile") or {}
+        util = snap.get("utilization") or {}
+        by_state: Dict[str, int] = {}
+        for j in jobs:
+            by_state[j.get("state", "?")] = \
+                by_state.get(j.get("state", "?"), 0) + 1
+        lines = [
+            "== fleetboard {}  jobs run={} queued={} paused={} done={}"
+            " failed={}  depth={}  {} jobs/min".format(
+                time.strftime("%H:%M:%S"),
+                by_state.get("running", 0), by_state.get("queued", 0),
+                by_state.get("paused", 0), by_state.get("done", 0),
+                by_state.get("failed", 0),
+                int(util.get("queue_depth",
+                             prof.get("queue_depth", 0)) or 0),
+                int(prof.get("jobs_per_min", 0) or 0))]
+        # pool occupancy + per-host bars + busy trend
+        per_host = util.get("per_host") or {}
+        busy = util.get("busy_frac")
+        if busy is not None:
+            hw = (util.get("width", 0) // max(len(per_host), 1)
+                  if per_host else util.get("width", 0))
+            bars = "   ".join(
+                f"[{h}] {_bar(f)} {f:.0%}"
+                for h, f in sorted(per_host.items()))
+            trend = [s.get("busy_frac", 0.0)
+                     for s in (util.get("samples") or [])[-32:]]
+            line = f"pool  {busy:4.0%} busy  {bars}"
+            if trend:
+                line += f"   trend {spark(trend)}"
+            lines.append(line)
+        # per-job rows with throughput deltas
+        active = [j for j in jobs
+                  if j.get("state") in ("running", "queued", "paused")]
+        if active:
+            lines.append("jobs:")
+        dt = (now - self._prev_t) if self._prev_t is not None else None
+        for j in active:
+            jid = j.get("id", "?")
+            row = (f"  {jid:<24} {j.get('state', '?'):<8} "
+                   f"w={j.get('granted_width', j.get('width', '?'))}")
+            hosts = j.get("hosts")
+            if hosts:
+                row += f" host={','.join(map(str, hosts))}"
+            if j.get("batch"):
+                row += f" batch={j['batch']}/l{j.get('lane')}"
+            uniq = (j.get("result") or {}).get("unique_state_count",
+                                               j.get("unique"))
+            if uniq is not None:
+                row += f"  uniq={int(uniq):,}"
+                prev = self._prev_uniq.get(jid)
+                if prev is not None and dt and dt > 0:
+                    rate = (int(uniq) - prev) / dt
+                    row += f"  +{watch.Console._rate(rate)}/s"
+                self._prev_uniq[jid] = int(uniq)
+            lines.append(row)
+        # SLO aggregates (cumulative seconds / completions)
+        done = by_state.get("done", 0) or int(prof.get("jobs_done",
+                                                       0) or 0)
+        slo = []
+        if prof.get("queue_wait_s") is not None:
+            denom = max(int(prof.get("jobs_submitted", done) or 1), 1)
+            slo.append(
+                f"queue_wait {prof['queue_wait_s'] / denom:.2f}s/job")
+        if prof.get("first_chunk_s") is not None and done:
+            slo.append(
+                f"first_chunk {prof['first_chunk_s'] / done:.2f}s/job")
+        if slo:
+            lines.append("slo: " + "  ".join(slo))
+        inter = {k: int(prof[k]) for k in
+                 ("preemptions", "retries", "degrades", "spills",
+                  "jobs_failed", "sse_dropped", "recorder_dumps")
+                 if prof.get(k)}
+        lines.append("interventions: " + (" ".join(
+            f"{k}={v}" for k, v in sorted(inter.items()))
+            if inter else "none"))
+        self._prev_t = now
+        self.frames += 1
+        return "\n".join(lines) + "\n"
+
+
+# --- snapshot sources -------------------------------------------------------
+
+def poll_url(url: str) -> Dict[str, Any]:
+    """One live snapshot from the service HTTP API."""
+    import urllib.request
+    base = url.rstrip("/")
+    with urllib.request.urlopen(base + "/jobs") as r:
+        jobs_payload = json.loads(r.read())
+    with urllib.request.urlopen(base + "/utilization") as r:
+        util = json.loads(r.read())
+    return {"jobs": jobs_payload.get("jobs") or [],
+            "profile": jobs_payload.get("profile") or {},
+            "utilization": util}
+
+
+def load_offline(root: str) -> Dict[str, Any]:
+    """One snapshot from a service root's durable artifacts: job
+    status/result files plus the ``service.jsonl`` event stream
+    (tailed through ``watch.follow_file``) for the profile-ish counts
+    and the last pool_util sample."""
+    from stateright_tpu.service.jobs import JobStore
+    store = JobStore(root)
+    jobs = [j.view() for j in store.jobs()]
+    profile: Dict[str, Any] = {}
+    util: Dict[str, Any] = {}
+    samples: List[Dict[str, Any]] = []
+    svc = store.service_trace_path
+    if os.path.isfile(svc):
+        counts: Dict[str, int] = {}
+        for ev in watch.follow_file(svc, follow=False):
+            kind = ev.get("ev")
+            counts[kind] = counts.get(kind, 0) + 1
+            if kind == "pool_util":
+                util = {"busy_frac": ev.get("busy_frac"),
+                        "per_host": ev.get("per_host") or {},
+                        "queue_depth": ev.get("queue_depth", 0)}
+                samples.append({"busy_frac": ev.get("busy_frac", 0.0)})
+            elif kind == "job_pause" \
+                    and ev.get("reason") == "preempt":
+                profile["preemptions"] = \
+                    profile.get("preemptions", 0) + 1
+        profile["jobs_submitted"] = counts.get("job_submit", 0)
+        profile["jobs_done"] = sum(
+            1 for j in jobs if j.get("state") == "done")
+        wait = [((j.get("result") or {}).get("lifecycle") or {})
+                .get("queue_wait_s") for j in jobs]
+        wait = [w for w in wait if w is not None]
+        if wait:
+            profile["queue_wait_s"] = sum(wait)
+        util["samples"] = samples
+    return {"jobs": jobs, "profile": profile, "utilization": util}
+
+
+def main(argv) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    once = "--once" in argv
+    interval = 2.0
+    if "--interval" in argv:
+        interval = float(argv[argv.index("--interval") + 1])
+    url = None
+    if "--url" in argv:
+        url = argv[argv.index("--url") + 1]
+    paths = [a for a in argv if not a.startswith("--")
+             and (not url or a != url)]
+    board = Board()
+    try:
+        while True:
+            if url is not None:
+                snap = poll_url(url)
+            elif paths:
+                snap = load_offline(paths[0])
+            else:
+                print("fleetboard: need --url or a service root",
+                      file=sys.stderr)
+                return 2
+            sys.stdout.write(board.feed(snap))
+            sys.stdout.flush()
+            if once:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -586,8 +586,10 @@ def run_soak(cfg: SoakConfig) -> dict:
     schedule = _fault_schedule(cfg)
     shared.gate = schedule[0][0] if schedule else cfg.ops
     if trace:
+        from stateright_tpu.obs import identity_fields, new_run_id
         trace.emit("run_start", model=f"soak:{proto.name}",
-                   wall=time.time())
+                   wall=time.time(),
+                   **identity_fields(trace, new_run_id("soak")))
         trace.emit("fault_injection", max_crashes=cfg.crashes,
                    actors=[int(proto.crash_target)])
     t0 = time.monotonic()

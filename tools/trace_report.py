@@ -3,6 +3,18 @@
 Usage:
     python tools/trace_report.py TRACE.jsonl [--validate]
     python tools/trace_report.py --job JOB_DIR [--validate]
+    python tools/trace_report.py --fleet DIR_OR_TRACES... [--validate]
+
+``--fleet`` merges ANY set of trace artifacts (directories expand to
+their ``fleet.jsonl`` / ``service.jsonl`` / per-job and per-rank
+``trace.jsonl`` / ``flight.jsonl`` — ``stateright_tpu.obs.aggregate``)
+into ONE wall-anchored timeline and renders per-host / per-job
+swimlanes: one row per lane, ~64 time buckets, progress density as
+``.``/``:``/``#`` and interventions as letter marks (G row, R etry,
+D egrade, H ost_drop, P ause, S pill, E rror, * discovery, ...),
+followed by the merged intervention list with fleet-relative
+timestamps and the cross-host skew bound (the ``dcn_probe`` round
+trip) below which cross-host ordering is not meaningful.
 
 ``--job`` accepts a job directory (the service's per-job layout, or any
 ``tpu_options(artifact_dir=...)`` run) and auto-locates its artifacts:
@@ -357,6 +369,79 @@ def report(events, out=None):
         out.write("\n")
 
 
+def render_fleet(timeline, out=None, width: int = 64):
+    """Per-host / per-job swimlanes over one merged fleet timeline."""
+    from stateright_tpu.obs.aggregate import INTERVENTIONS
+    out = sys.stdout if out is None else out
+    events = timeline.events
+    if not events:
+        out.write("fleet timeline: no events\n")
+        return
+    lanes = timeline.lanes()
+    span = max(timeline.span_s, 1e-9)
+    t_min = min(e["fleet_t"] for e in events if e.get("anchored")) \
+        if any(e.get("anchored") for e in events) else 0.0
+    out.write(
+        f"=== fleet timeline: {len(timeline.segments)} streams, "
+        f"{len(events)} events, span {span:.3f}s, "
+        f"skew_bound={timeline.skew_bound_s * 1e3:.3f}ms ===\n")
+    unanchored = sum(1 for e in events if not e.get("anchored"))
+    if unanchored:
+        out.write(f"(!) {unanchored} events from pre-header streams "
+                  "have no wall anchor; placed at relative time\n")
+    # per-lane bucket rows: progress density beneath intervention marks
+    label_w = max(len(lane) for lane in lanes)
+    label_w = min(max(label_w, 4), 36)
+    for lane in lanes:
+        marks = [" "] * width
+        density = [0] * width
+        for ev in events:
+            if ev["lane_key"] != lane:
+                continue
+            idx = min(int((ev["fleet_t"] - t_min) / span * width),
+                      width - 1)
+            kind = ev.get("ev")
+            if kind in ("chunk", "level", "progress", "ops",
+                        "pool_util"):
+                density[idx] += 1
+            else:
+                mark = INTERVENTIONS.get(kind)
+                if mark and marks[idx] == " ":
+                    marks[idx] = mark
+        row = []
+        for i in range(width):
+            if marks[i] != " ":
+                row.append(marks[i])
+            elif density[i] >= 4:
+                row.append("#")
+            elif density[i] >= 2:
+                row.append(":")
+            elif density[i] >= 1:
+                row.append(".")
+            else:
+                row.append(" ")
+        out.write(f"{lane[:label_w]:<{label_w}} |{''.join(row)}|\n")
+    # the merged intervention list, fleet-relative
+    inters = [e for e in events
+              if e.get("ev") in INTERVENTIONS
+              and e["ev"] not in ("compile", "discovery")]
+    if inters:
+        out.write("\ninterventions (fleet_t):\n")
+        for ev in inters:
+            detail = {k: v for k, v in ev.items()
+                      if k not in ("t", "ev", "engine", "wall",
+                                   "fleet_t", "lane_key", "src",
+                                   "anchored", "run_id", "host",
+                                   "rank")}
+            out.write(f"  t={ev['fleet_t']:9.3f}  "
+                      f"{ev['ev']:<14} [{ev['lane_key']}] {detail}\n")
+    for ev in events:
+        if ev.get("ev") == "discovery":
+            out.write(f"\ndiscovered {ev.get('property')!r} on "
+                      f"[{ev['lane_key']}] at t={ev['fleet_t']:.3f}\n")
+    out.write("\n")
+
+
 def job_traces(directory):
     """Locate a job directory's (or a service root's) trace artifacts
     by the canonical layout (``stateright_tpu.obs.artifact_paths``)."""
@@ -389,6 +474,38 @@ def main(argv):
         return 0
     validate = "--validate" in argv
     paths = [a for a in argv if not a.startswith("--")]
+    if "--fleet" in argv:
+        from stateright_tpu.obs import aggregate, validate_event
+        if not paths:
+            print("--fleet requires trace files or artifact "
+                  "directories", file=sys.stderr)
+            return 2
+        sources = []
+        for p in paths:
+            if os.path.isdir(p):
+                located = aggregate.collect_artifacts(p)
+                if not located:
+                    print(f"{p}: no trace artifacts found",
+                          file=sys.stderr)
+                    return 2
+                sources.extend(located)
+            else:
+                sources.append(p)
+        timeline = aggregate.merge(sources)
+        if validate:
+            # annotated events are supersets of the originals, and the
+            # schema only pins REQUIRED fields — validate them directly
+            for i, ev in enumerate(timeline.events):
+                try:
+                    validate_event(ev)
+                except ValueError as exc:
+                    print(f"fleet event {i}: {exc}", file=sys.stderr)
+                    return 1
+            print(f"fleet: {len(timeline.events)} events from "
+                  f"{len(timeline.segments)} streams, schema OK",
+                  file=sys.stderr)
+        render_fleet(timeline)
+        return 0
     if "--job" in argv:
         job_dirs = [paths.pop(paths.index(a))
                     for a in list(paths) if os.path.isdir(a)]

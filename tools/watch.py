@@ -182,21 +182,82 @@ def follow_file(path, follow: bool = True,
             time.sleep(poll)
 
 
-def follow_url(url: str) -> Iterable[Dict[str, Any]]:
-    """Yield events from an Explorer's ``GET /.events`` SSE stream."""
+def follow_url(url: str, reconnect: bool = True, retries: int = 5,
+               base_delay: float = 0.5, max_delay: float = 8.0,
+               _sleep=time.sleep,
+               _rng=None) -> Iterable[Dict[str, Any]]:
+    """Yield events from an SSE stream (Explorer ``GET /.events`` or a
+    service job's ``/events``), surviving dropped connections.
+
+    A dropped connection (server restart, proxy timeout, network blip)
+    used to END the console mid-run. Now the client reconnects with
+    jittered exponential backoff (up to ``retries`` consecutive
+    failures); the server replays its flight-ring backlog on
+    reconnect, and a bounded already-seen window (sized past the
+    flight ring, so the whole replay is coverable) suppresses events
+    this generator already yielded — the console resumes exactly where
+    it left off, without duplicated lines. The retry counter resets
+    whenever a connection delivers events, so a long flaky run is
+    bounded per-outage, not per-lifetime.
+
+    Ends when a terminal ``done``/``error`` event has been seen and
+    the stream closes, when a clean close delivers nothing new (a
+    finished trace replay), or when ``retries`` consecutive attempts
+    fail. ``_sleep``/``_rng`` are test seams."""
+    import http.client
+    import random
+    import urllib.error
     import urllib.request
 
-    if not url.rstrip("/").endswith("/.events"):
-        url = url.rstrip("/") + "/.events"
-    with urllib.request.urlopen(url) as resp:
-        for raw in resp:
-            line = raw.decode("utf-8", "replace").strip()
-            if not line.startswith("data:"):
-                continue  # keep-alive / drop-count comments
-            try:
-                yield json.loads(line[len("data:"):].strip())
-            except json.JSONDecodeError:
-                continue
+    from collections import deque
+
+    rng = random.Random() if _rng is None else _rng
+    stripped = url.rstrip("/")
+    if not (stripped.endswith("/.events")
+            or stripped.endswith("/events")):
+        url = stripped + "/.events"
+    seen: set = set()
+    order: deque = deque()
+    seen_limit = 4096  # > the flight ring bound: full replay coverage
+    ended = False
+    failures = 0
+    while True:
+        fresh = 0
+        try:
+            with urllib.request.urlopen(url) as resp:
+                for raw in resp:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue  # keep-alive / drop-count comments
+                    payload = line[len("data:"):].strip()
+                    try:
+                        ev = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+                    key = json.dumps(ev, sort_keys=True, default=str)
+                    if key in seen:
+                        continue  # reconnect backlog replay
+                    seen.add(key)
+                    order.append(key)
+                    if len(order) > seen_limit:
+                        seen.discard(order.popleft())
+                    fresh += 1
+                    failures = 0
+                    if ev.get("ev") in ("done", "error"):
+                        ended = True
+                    yield ev
+            # clean close: finished run/replay, or a server going away
+            if ended or not reconnect or fresh == 0:
+                return
+        except (OSError, urllib.error.URLError,
+                http.client.HTTPException):
+            if ended or not reconnect:
+                return
+            failures += 1
+            if failures > retries:
+                return
+        delay = min(max_delay, base_delay * (2 ** max(failures - 1, 0)))
+        _sleep(delay * (0.5 + rng.random() / 2))  # jittered backoff
 
 
 def attach(checker, out=None, interval: float = 0.0,
