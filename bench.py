@@ -67,7 +67,13 @@ counts (the trend line tools/bench_history.py tracks for ROADMAP's
 2-process CPU fleet mesh through tools/mesh_launch.py plus the
 two-level DevicePool over two simulated hosts, and lands a
 ``"hosts": N`` contract line (uniq/s across DCN +
-jobs-granted-per-host) — bench_history tags it ``multihost``.
+jobs-granted-per-host) — bench_history tags it ``multihost``;
+``--burnin-smoke`` runs the continuous verification fleet (scheduler
+burn-in mode: low-priority seeded fuzz jobs saturating a 2-device CPU
+pool, a real checking job preempting a fuzz lane at an op boundary)
+and lands a ``"burnin": true`` contract line with ``jobs_per_min`` for
+both the burn-in and real-job lanes — bench_history tags it
+``burnin``.
 """
 
 from __future__ import annotations
@@ -517,6 +523,123 @@ def _multihost_smoke() -> None:
         print(json.dumps(contract))
 
 
+def _burnin_smoke() -> None:
+    """``--burnin-smoke``: a seconds-scale proof of the continuous
+    verification fleet (README § Continuous verification) under the
+    crash-proof contract — a 2-device CPU scheduler in burn-in mode
+    saturates the pool with low-priority seeded fuzz jobs (SOAK_REGISTRY
+    write_once, online linearizability cross-check live), a REAL
+    checking job is submitted into the saturated pool and must preempt
+    a fuzz lane at an op boundary and complete, and the fuzz lanes keep
+    completing around it. The contract line is tagged ``"burnin": true``
+    and carries ``jobs_per_min`` for BOTH lanes (burn-in completions
+    and real-job completions over the same wall window) plus the
+    preemption/violation counts — ``tools/bench_history.py`` learns the
+    burnin tag. Emitted from a ``finally`` path with ``"partial"``/
+    ``"failed"`` on any error; rc=0 regardless. Needs no device beyond
+    CPU."""
+    import os
+    import tempfile
+    import time as _time
+
+    contract = {
+        "metric": "burn-in fleet smoke (fuzz saturation + real-job "
+                  "preemption on a 2-device CPU pool)",
+        "value": None,
+        "unit": "jobs/min",
+        "burnin": True,
+        "jobs_per_min": {"burnin": None, "real": None},
+        "preemptions": None,
+        "violations": None,
+    }
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from stateright_tpu.service import JobSpec, JobStore, Scheduler
+
+        root = tempfile.mkdtemp(prefix="stateright_burnin_smoke_")
+        corpus = tempfile.mkdtemp(prefix="stateright_burnin_corpus_")
+        t0 = _time.perf_counter()
+        sched = Scheduler(
+            JobStore(root), devices=jax.devices()[:2],
+            corpus_dir=corpus,
+            burnin={"kind": "fuzz", "config": "write_once",
+                    "overrides": {"ops": 250, "deadline": 30.0,
+                                  "op_timeout": 0.15},
+                    "max_jobs": 4})
+        # the pool must saturate with burn-in lanes before real work
+        deadline = _time.monotonic() + 15.0
+        while _time.monotonic() < deadline:
+            if sum(1 for j in sched.jobs()
+                   if j.state == "running") >= 2:
+                break
+            _time.sleep(0.05)
+        real = sched.submit(JobSpec(
+            "twopc", args=[3],
+            options={"capacity": 1 << 12, "fmax": 64,
+                     "retries": 1, "backoff": 0.0}))
+        state = sched.wait(real.id, timeout=180.0)
+        if state != "done":
+            FAILED.append(f"burnin-real-{real.id}")
+        # let the capped burn-in fleet drain so both lanes report
+        # completions over the same window
+        deadline = _time.monotonic() + 60.0
+        while _time.monotonic() < deadline:
+            burn = [j for j in sched.jobs() if j.spec.burnin]
+            if burn and all(j.state in ("done", "failed", "cancelled")
+                            for j in burn):
+                break
+            _time.sleep(0.1)
+        wall = _time.perf_counter() - t0
+        prof = sched.profile()
+        burn_jobs = [j for j in sched.jobs() if j.spec.burnin]
+        burn_done = sum(1 for j in burn_jobs if j.state == "done")
+        real_done = 1 if state == "done" else 0
+        contract["jobs_per_min"] = {
+            "burnin": round(burn_done / wall * 60.0, 1),
+            "real": round(real_done / wall * 60.0, 1)}
+        contract["value"] = contract["jobs_per_min"]["burnin"]
+        contract["preemptions"] = int(prof.get("preemptions", 0))
+        contract["violations"] = int(prof.get("violations", 0))
+        contract["fuzz_ops"] = int(prof.get("fuzz_ops", 0))
+        contract["soak_jobs"] = int(prof.get("soak_jobs", 0))
+        result = real.read_result() or {}
+        row = {"workload": "burnin real-job",
+               "state": state, "wall_s": round(wall, 3),
+               "uniq": result.get("unique_state_count"),
+               "digest": result.get("fingerprints_sha256"),
+               "preemptions": contract["preemptions"]}
+        print(json.dumps(row), file=sys.stderr)
+        print(json.dumps({"workload": "burnin fuzz-lane",
+                          "done": burn_done,
+                          "jobs_per_min":
+                          contract["jobs_per_min"]["burnin"],
+                          "fuzz_ops": contract["fuzz_ops"],
+                          "violations": contract["violations"]}),
+              file=sys.stderr)
+        if burn_done == 0:
+            FAILED.append("burnin-fuzz-lane")
+        if contract["preemptions"] == 0:
+            FAILED.append("burnin-no-preemption")
+        sched.shutdown()
+    except BaseException as exc:
+        print(json.dumps({"workload": "burnin", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("burnin")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
 def _storm_specs(n: int, seed: int, models: str):
     """The randomized tiny-spec generator both storm modes share:
     per-user shape drift (randomized fmax, small capacities) that
@@ -673,6 +796,9 @@ def main() -> None:
     INJECT_FAULT = "--inject-fault" in sys.argv
     if "--soak-smoke" in sys.argv:
         _soak_smoke()
+        return
+    if "--burnin-smoke" in sys.argv:
+        _burnin_smoke()
         return
     if "--job-storm" in sys.argv:
         _job_storm()

@@ -52,7 +52,8 @@ INTERVENTIONS = {
     "failover": "F", "degrade": "D", "spill": "S", "evict": "S",
     "pause": "P", "recorder_dump": "!", "fused_fallback": "f",
     "fused_unsupported": "f", "crash": "C", "restart": "C",
-    "partition": "C", "host_drop": "H", "mesh_init": "M",
+    "partition": "C", "violation": "V", "burnin_preempt": "B",
+    "host_drop": "H", "mesh_init": "M",
     "host_join": "M", "job_submit": "j", "job_grant": "j",
     "job_start": "J", "job_first_chunk": "j", "job_pause": "P",
     "job_resume": "J", "job_done": "J", "bucket_flush": "b",

@@ -151,6 +151,11 @@ GLOSSARY: Dict[str, str] = {
                   "consistency cross-check (LinearizabilityTester / "
                   "SequentialConsistencyTester), 0 when it was "
                   "rejected (a dumped seed artifact reproduces it)",
+    "violations": "consistency violations flagged by the cross-check "
+                  "— online (the incremental Wing&Gong checker aborts "
+                  "the soak at the offending op) or post-hoc; every "
+                  "one auto-files a seed artifact under its "
+                  "(protocol, tester, sha256(ops)) dedup key",
     # --- observed maxima (buffer autotuning inputs) -------------------
     "vmax": "max raw-valid candidate lanes in one iteration (sizes "
             "kraw; compare against fmax*max_actions)",
@@ -225,6 +230,18 @@ GLOSSARY: Dict[str, str] = {
                    "checkpoint, typically on a smaller subset)",
     "queue_depth": "jobs currently waiting for a device subset "
                    "(gauge; sampled after every scheduling pass)",
+    # --- continuous verification fleet (soak/fuzz as service load) -----
+    "soak_jobs": "soak/fuzz service jobs run to completion (kind: "
+                 "soak|fuzz specs over SOAK_REGISTRY — the standing "
+                 "chaos/fuzz lane beside checking jobs)",
+    "fuzz_ops": "client operations completed across the scheduler's "
+                "soak/fuzz jobs (all segments; the burn-in lane's "
+                "work measure, the ops/s numerator per job rides the "
+                "job's result.json)",
+    "burnin_frac": "fraction of the device pool currently leased to "
+                   "burn-in (low-priority background soak/fuzz) jobs "
+                   "(gauge; sampled with pool_busy_frac — burn-in "
+                   "load is visible, not invisible)",
     # --- utilization + SLO accounting (PR 14) --------------------------
     "queue_wait_s": "cumulative submit->grant wall seconds across jobs "
                     "(the queueing SLO numerator; divide by "
@@ -287,7 +304,7 @@ GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
     "shard_balance", "host_tier_keys", "queue_depth", "lanes",
     "hosts", "procs", "fused_unsupported", "cc_dedup_capacity",
-    "pool_busy_frac", "jobs_per_min",
+    "pool_busy_frac", "jobs_per_min", "burnin_frac",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

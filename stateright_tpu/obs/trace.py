@@ -124,6 +124,17 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "restart": frozenset({"actor"}),
     "partition": frozenset({"groups"}),
     "ops": frozenset({"op_invoke", "op_return", "op_timeouts"}),
+    # one per soak run/segment: the resolved configuration summary
+    # (protocol, op budget, seed; optional clients/online) — the soak
+    # twin of run_start's model field, emitted by the driver so
+    # service-scheduled soak/fuzz segments self-describe
+    "soak_start": frozenset({"protocol", "ops", "seed"}),
+    # the consistency cross-check REJECTED the recorded history:
+    # `tester` names which semantics failed; optional `op_index` pins
+    # the offending operation when the ONLINE checker flagged it
+    # mid-run (None for post-hoc rejections), optional `artifact` the
+    # auto-filed seed-corpus path
+    "violation": frozenset({"tester"}),
     "soak_done": frozenset({"ops", "history_ok"}),
     # the flight recorder (obs/recorder.py) wrote its ring as a JSONL
     # artifact (on error / watchdog expiry / exhausted retries / a
@@ -151,6 +162,12 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "job_pause": frozenset({"job", "reason"}),
     "job_resume": frozenset({"job", "width"}),
     "job_done": frozenset({"job", "state"}),
+    # burn-in mode (README § Continuous verification): a low-priority
+    # background soak/fuzz job was preempted at an op-count boundary to
+    # free its device subset for a real checking job — it re-queues and
+    # resumes its remaining op budget later (optional fields: ops_done,
+    # the preempting context)
+    "burnin_preempt": frozenset({"job"}),
     # device-pool utilization sample (engine="service"): the busy
     # fraction of the whole pool plus the per-host split, emitted on
     # change by the scheduler's utilization sampler — the series

@@ -15,6 +15,7 @@ host, not per state on device.
 from .core import ConsistencyTester, SequentialSpec
 from .history import HistoryRecorder, RecordedHistory
 from .linearizability import LinearizabilityTester
+from .online import OnlineLinearizabilityChecker, replay_online
 from .register import Read, ReadOk, Register, Write, WriteOk
 from .sequential_consistency import SequentialConsistencyTester
 from .vec import Len, LenOk, Pop, PopOk, Push, PushOk, VecSpec
@@ -22,8 +23,8 @@ from .write_once_register import WORegister, WriteFail
 
 __all__ = [
     "ConsistencyTester", "HistoryRecorder", "LinearizabilityTester",
-    "Len", "LenOk", "Pop", "PopOk", "Push", "PushOk", "Read", "ReadOk",
-    "RecordedHistory", "Register", "SequentialConsistencyTester",
-    "SequentialSpec", "VecSpec", "WORegister", "Write", "WriteFail",
-    "WriteOk",
+    "Len", "LenOk", "OnlineLinearizabilityChecker", "Pop", "PopOk",
+    "Push", "PushOk", "Read", "ReadOk", "RecordedHistory", "Register",
+    "SequentialConsistencyTester", "SequentialSpec", "VecSpec",
+    "WORegister", "Write", "WriteFail", "WriteOk", "replay_online",
 ]

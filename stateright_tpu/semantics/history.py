@@ -4,9 +4,9 @@ checker uses.
 
 The checker records invoke/return pairs via the ``record_msg_out``/
 ``record_msg_in`` hooks while enumerating the model; the soak harness
-(``tools/soak.py``) records them from live client threads driving a
-spawned UDP cluster. Both feed the identical
-:class:`~stateright_tpu.semantics.LinearizabilityTester` /
+(``stateright_tpu/soak.py``, CLI ``tools/soak.py``) records them from
+live client threads driving a spawned UDP cluster. Both feed the
+identical :class:`~stateright_tpu.semantics.LinearizabilityTester` /
 :class:`~stateright_tpu.semantics.SequentialConsistencyTester`
 semantics (Herlihy & Wing), closing the loop between "model checked"
 and "serves real traffic": a runtime history the tester rejects is a
@@ -20,26 +20,35 @@ Pieces:
   a timed-out operation must retire that logical thread id (the op
   stays in flight forever — linearizability permits an incomplete op to
   take effect or not) and continue under a fresh one; see
-  :meth:`HistoryRecorder.abandon`.
+  :meth:`HistoryRecorder.abandon`. The recorder is STRICT: a return (or
+  a re-invoke) on a retired thread id is rejected with a clear error
+  instead of silently corrupting the per-thread bookkeeping — the
+  resend-after-abandon client pattern the soak driver uses must record
+  the resent op under a fresh epoch id. An ``observer`` (typically an
+  :class:`~stateright_tpu.semantics.OnlineLinearizabilityChecker`)
+  receives every event in append order, which is how the consistency
+  cross-check runs ONLINE — a violation surfaces at the offending
+  operation, mid-soak, instead of post-hoc.
 * :class:`RecordedHistory` — an immutable event list with JSONL
   (de)serialization over the register op vocabulary and
-  :meth:`replay`/:meth:`check` against any tester. ``check`` raises the
-  recursion limit for the serialization search: the tester recurses
-  once per serialized operation, and soak histories run to thousands of
-  ops (far past the default 1000-frame limit).
+  :meth:`replay`/:meth:`check` against any tester. The serialization
+  search in both testers is ITERATIVE (one explicit frame per op, no
+  Python recursion), so multi-thousand-op burn-in histories check
+  without any ``sys.setrecursionlimit`` games.
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import threading
 from typing import Any, Iterable, List, Optional, Tuple
 
 from .register import Read, ReadOk, Write, WriteOk
 from .write_once_register import WriteFail
 
-#: recorded event: ("inv", thread_id, op) or ("ret", thread_id, ret)
+#: recorded event: ("inv", thread_id, op), ("ret", thread_id, ret), or
+#: ("abd", thread_id, None) — the thread retired its in-flight op (the
+#: op stays in flight forever; the id must never be reused)
 Event = Tuple[str, Any, Any]
 
 
@@ -75,32 +84,84 @@ def op_from_json(data: list) -> Any:
 
 
 class HistoryRecorder:
-    """Thread-safe operation-history recorder for live client threads."""
+    """Thread-safe operation-history recorder for live client threads.
 
-    def __init__(self):
+    ``observer`` (optional) receives ``on_invoke``/``on_return``/
+    ``abandon`` calls in exactly the recorded order (under the
+    recorder's lock, so the stream an online checker sees IS the
+    history) — the hook the incremental consistency cross-check rides.
+    """
+
+    def __init__(self, observer: Any = None):
         self._lock = threading.Lock()
         self._events: List[Event] = []
+        #: thread ids with an op currently in flight
+        self._live: set = set()
+        #: thread ids retired by :meth:`abandon` — never valid again
+        self._retired: set = set()
+        self._observer = observer
         self.invoked = 0
         self.returned = 0
         self.abandoned = 0
 
     def invoke(self, thread_id: Any, op: Any) -> None:
         with self._lock:
+            if thread_id in self._retired:
+                raise ValueError(
+                    f"thread id {thread_id!r} was retired by abandon() "
+                    "and must not be reused; a client that abandoned a "
+                    "timed-out op must continue under a fresh logical "
+                    "thread id (e.g. bump its epoch)")
+            if thread_id in self._live:
+                raise ValueError(
+                    f"thread id {thread_id!r} already has an operation "
+                    "in flight; one invoke per thread id until ret() "
+                    "or abandon()")
+            self._live.add(thread_id)
             self._events.append(("inv", thread_id, op))
             self.invoked += 1
+            if self._observer is not None:
+                self._observer.on_invoke(thread_id, op)
 
     def ret(self, thread_id: Any, ret: Any) -> None:
         with self._lock:
+            if thread_id in self._retired:
+                raise ValueError(
+                    f"return recorded on retired thread id "
+                    f"{thread_id!r} (ret={ret!r}): the op was "
+                    "abandoned and stays in flight forever — a late "
+                    "reply for an abandoned op must be dropped, and a "
+                    "resend must run under a fresh thread id (the "
+                    "resend-after-abandon pattern)")
+            if thread_id not in self._live:
+                raise ValueError(
+                    f"return without an in-flight invocation: "
+                    f"thread_id={thread_id!r}, ret={ret!r}")
+            self._live.discard(thread_id)
             self._events.append(("ret", thread_id, ret))
             self.returned += 1
+            if self._observer is not None:
+                self._observer.on_return(thread_id, ret)
 
     def abandon(self, thread_id: Any) -> None:
-        """Mark a timed-out operation abandoned: no event is recorded
-        (the op stays in flight), but the caller must not reuse
-        ``thread_id`` — the tester rejects a second in-flight op on the
-        same thread."""
+        """Mark a timed-out operation abandoned: the op stays in flight
+        (linearizability permits an incomplete op to take effect or
+        not), and ``thread_id`` is RETIRED — any later ``ret`` or
+        ``invoke`` on it is rejected. The retirement is recorded as an
+        ``("abd", thread_id, None)`` event so replays (and the online
+        checker) can prune configurations for ops that will provably
+        never return."""
         with self._lock:
+            if thread_id not in self._live:
+                raise ValueError(
+                    f"abandon() on thread id {thread_id!r} with no "
+                    "in-flight invocation")
+            self._live.discard(thread_id)
+            self._retired.add(thread_id)
+            self._events.append(("abd", thread_id, None))
             self.abandoned += 1
+            if self._observer is not None:
+                self._observer.abandon(thread_id)
 
     def completed(self) -> int:
         return self.returned
@@ -122,59 +183,81 @@ class RecordedHistory:
     def events(self) -> List[Event]:
         return list(self._events)
 
+    def op_count(self) -> int:
+        """Invoked operations in the history (``inv`` events)."""
+        return sum(1 for kind, _t, _p in self._events if kind == "inv")
+
+    def ops_digest(self) -> str:
+        """Content-derived identity of the operation stream: the
+        sha256 over the canonical event encoding. Together with the
+        protocol and tester names this is the seed-corpus dedup key —
+        a re-found violation maps to the same artifact file instead of
+        piling duplicates."""
+        import hashlib
+        h = hashlib.sha256()
+        for kind, thread_id, payload in self._events:
+            if kind == "abd":
+                line = f"abd|{thread_id}"
+            else:
+                line = f"{kind}|{thread_id}|{op_to_json(payload)}"
+            h.update(line.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
     # --- the cross-check --------------------------------------------------
     def replay(self, tester):
         """Feed the events into ``tester`` in recorded (real-time)
         order; returns the tester, or ``None`` if the event stream
         itself is malformed (double in-flight, return without invoke —
         a recorder bug or a corrupt artifact, not a consistency
-        verdict)."""
+        verdict). ``abd`` retirement events are skipped for the batch
+        testers (the op simply stays in flight); an online checker
+        with an ``abandon`` hook receives them."""
+        online = hasattr(tester, "abandon")
         try:
             for kind, thread_id, payload in self._events:
                 if kind == "inv":
                     tester.on_invoke(thread_id, payload)
-                else:
+                elif kind == "ret":
                     tester.on_return(thread_id, payload)
+                elif online:
+                    tester.abandon(thread_id)
         except ValueError:
             return None
         return tester
 
     def check(self, tester) -> bool:
         """Replay into ``tester`` and run its consistency search. The
-        recursion limit is raised to cover the search's one-frame-per-
-        serialized-op depth on long soak histories."""
+        search is iterative (one explicit frame per serialized op), so
+        arbitrarily long burn-in histories check without touching the
+        interpreter recursion limit."""
         replayed = self.replay(tester)
         if replayed is None:
             return False
-        need = 4 * len(self._events) + 1000
-        old = sys.getrecursionlimit()
-        if need > old:
-            sys.setrecursionlimit(need)
-        try:
-            return replayed.is_consistent()
-        finally:
-            if need > old:
-                sys.setrecursionlimit(old)
+        return replayed.is_consistent()
 
     # --- artifact (de)serialization ---------------------------------------
     def to_jsonl(self, meta: Optional[dict] = None) -> str:
         """JSONL artifact: an optional ``{"meta": ...}`` header line,
-        then one ``{"k", "th", "v"}`` line per event. Thread ids must be
-        JSON-serializable (the soak driver uses strings)."""
+        then one ``{"k", "th", "v"}`` line per event (``abd`` lines
+        carry no ``"v"``). Thread ids must be JSON-serializable (the
+        soak driver uses strings)."""
         lines = []
         if meta is not None:
             lines.append(json.dumps({"meta": meta},
                                     separators=(",", ":")))
         for kind, thread_id, payload in self._events:
-            lines.append(json.dumps(
-                {"k": kind, "th": thread_id, "v": op_to_json(payload)},
-                separators=(",", ":")))
+            obj = {"k": kind, "th": thread_id}
+            if kind != "abd":
+                obj["v"] = op_to_json(payload)
+            lines.append(json.dumps(obj, separators=(",", ":")))
         return "\n".join(lines) + "\n"
 
     @classmethod
     def from_jsonl(cls, text: str) -> Tuple[Optional[dict],
                                             "RecordedHistory"]:
-        """Inverse of :meth:`to_jsonl`; returns ``(meta, history)``."""
+        """Inverse of :meth:`to_jsonl`; returns ``(meta, history)``.
+        Pre-retirement artifacts (no ``abd`` lines) load unchanged."""
         meta = None
         events: List[Event] = []
         for line in text.splitlines():
@@ -185,7 +268,11 @@ class RecordedHistory:
             if "meta" in obj and "k" not in obj:
                 meta = obj["meta"]
                 continue
-            events.append((obj["k"], obj["th"], op_from_json(obj["v"])))
+            if obj["k"] == "abd":
+                events.append(("abd", obj["th"], None))
+            else:
+                events.append((obj["k"], obj["th"],
+                               op_from_json(obj["v"])))
         return meta, cls(events)
 
     def dump(self, path, meta: Optional[dict] = None) -> None:

@@ -168,18 +168,12 @@ def _config_key(ref_obj, remaining, in_flight):
             frozenset(in_flight))
 
 
-def _serialize(valid_history, ref_obj, remaining, in_flight,
-               failed=None):
-    if all(not h for h in remaining.values()):
-        return valid_history
-    key = None
-    if failed is not None:
-        # (spec, per-thread next index, in-flight threads) pins the
-        # whole subtree: in_flight entries only ever *leave* the dict,
-        # so the thread set identifies their content
-        key = _config_key(ref_obj, remaining, in_flight)
-        if key in failed:
-            return None
+def _branches(ref_obj, remaining, in_flight):
+    """Candidate next steps from one search node: for each thread,
+    either its next completed op (validated against the spec) or its
+    in-flight op (the spec decides the return). Yields
+    ``(op, ret, obj, branch_remaining, branch_in_flight)``; node dicts
+    are never mutated, only replaced."""
     for thread_id in list(remaining):
         history = remaining[thread_id]
         if not history:
@@ -193,7 +187,7 @@ def _serialize(valid_history, ref_obj, remaining, in_flight,
             ret = obj.invoke(op)
             branch_in_flight = {t: v for t, v in in_flight.items()
                                 if t != thread_id}
-            branch_remaining = remaining
+            yield op, ret, obj, remaining, branch_in_flight
         else:
             # Case 2: interleave this thread's next completed op.
             _index, (last_completed, op, ret) = history[0]
@@ -204,11 +198,55 @@ def _serialize(valid_history, ref_obj, remaining, in_flight,
                 continue
             branch_remaining = dict(remaining)
             branch_remaining[thread_id] = history[1:]
-            branch_in_flight = in_flight
-        result = _serialize(valid_history + [(op, ret)], obj,
-                            branch_remaining, branch_in_flight, failed)
-        if result is not None:
-            return result
-    if key is not None and len(failed) < _FAILED_MAX:
-        failed.add(key)
+            yield op, ret, obj, branch_remaining, in_flight
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight,
+               failed=None):
+    """Iterative DFS over the interleavings (one explicit frame per
+    serialized op — a multi-thousand-op runtime history must not burn
+    a Python stack frame per op; the old recursive form needed
+    ``sys.setrecursionlimit`` past ~10k ops and hard-crashed beyond
+    the C stack)."""
+    if all(not h for h in remaining.values()):
+        return list(valid_history)
+    path = list(valid_history)
+
+    def open_node(obj, rem, flight):
+        """A new search frame, or None when the configuration is a
+        memoized dead end. (spec, per-thread next index, in-flight
+        threads) pins the whole subtree: in_flight entries only ever
+        *leave* the dict, so the thread set identifies their
+        content."""
+        key = None
+        if failed is not None:
+            key = _config_key(obj, rem, flight)
+            if key in failed:
+                return None
+        return (key, _branches(obj, rem, flight))
+
+    stack = [open_node(ref_obj, remaining, in_flight)]
+    if stack[0] is None:
+        return None
+    while stack:
+        key, branches = stack[-1]
+        pushed = False
+        for op, ret, obj, b_rem, b_flight in branches:
+            path.append((op, ret))
+            if all(not h for h in b_rem.values()):
+                return path
+            child = open_node(obj, b_rem, b_flight)
+            if child is None:
+                path.pop()
+                continue
+            stack.append(child)
+            pushed = True
+            break
+        if not pushed:
+            # every branch failed: this configuration is dead
+            if key is not None and len(failed) < _FAILED_MAX:
+                failed.add(key)
+            stack.pop()
+            if stack:
+                path.pop()  # the op that led into the dead frame
     return None

@@ -1,0 +1,261 @@
+"""Online (incremental) linearizability checking.
+
+The batch :class:`~stateright_tpu.semantics.LinearizabilityTester`
+answers "is this COMPLETE history linearizable?" with a post-hoc
+interleaving search. This module maintains the Wing & Gong
+configuration set ACROSS operations instead (Lowe's just-in-time
+linearization): after every recorded event the checker knows the set
+of states the sequential spec could be in, so a violation surfaces at
+the offending operation — mid-soak, with a pinned op index — rather
+than after the run ends.
+
+A **configuration** is ``(spec state, which in-flight ops have already
+taken effect)``. The real-time rule of linearizability says an op's
+linearization point lies between its invoke and return events, so the
+event stream drives a simple automaton:
+
+* ``on_invoke`` adds the op to the pending pool (configurations are
+  untouched — the op has not taken effect anywhere yet);
+* ``on_return(t, ret)`` forces the op to have taken effect: from every
+  configuration, explore all ways of linearizing pending ops (the
+  closure), keep exactly the configurations where thread ``t``'s op
+  produced ``ret``; an EMPTY survivor set is a violation at this
+  event, and the rejection is final — a non-linearizable prefix can
+  never be repaired by later events (restricting a full-history
+  witness to linearization points before any cut yields a prefix
+  witness);
+* ``abandon(t)`` retires an op that will never return: its stored
+  return value can never be checked, so configurations collapse onto a
+  canonical form keyed by the MULTISET of applied abandoned ops (two
+  abandoned ``Write('A')``\\ s are interchangeable in any witness) —
+  without this, long chaos soaks with many client timeouts would blow
+  the configuration set up exponentially. Abandoned ops whose
+  application would not change the spec state are never applied at all
+  (observationally void, hence WLOG skippable).
+
+Accepting at end-of-history is equivalent to the batch tester's
+verdict (each surviving configuration is a witness over all completed
+ops); rejecting mid-stream is sound by prefix monotonicity. Parity is
+pinned by ``tests/test_history_online.py`` over the committed soak
+corpus plus randomized recorded histories.
+
+The configuration set is bounded by ``max_configs``; a pathological
+history that exceeds it degrades to verdict ``None`` ("unknown" — run
+the post-hoc tester) instead of wrong answers or unbounded memory,
+mirroring the batch testers' ``_FAILED_MAX`` discipline.
+
+NOTE: sequential consistency has no sound online early-abort — without
+real-time constraints an op invoked LATER may legitimately serialize
+before a prefix op, so a "violating" prefix can be repaired by future
+events. SC stays a post-hoc check.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_MISS = object()
+
+
+class OnlineLinearizabilityChecker:
+    """Incremental linearizability checker over a live event stream.
+
+    Speaks the recorder's observer protocol (``on_invoke`` /
+    ``on_return`` / ``abandon``) and the testers' error contract (a
+    malformed stream raises ``ValueError`` and poisons the checker).
+    ``violation`` is ``None`` until the first rejected event, then a
+    dict with ``op_index`` (completed ops before the offending one),
+    ``event_index``, ``thread_id`` and ``ret``.
+    """
+
+    def __init__(self, spec, max_configs: int = 1 << 14):
+        self._init = spec
+        self._max = int(max_configs)
+        start = spec.clone()
+        # config key -> (spec, live_done: {thread: ret}, ab_applied:
+        # {op: count}); stored specs are never mutated (clone-before-
+        # invoke), so using them in keys is safe
+        self._configs: Dict[tuple, tuple] = {
+            self._ckey(start, {}, {}): (start, {}, {})}
+        #: thread -> op for live (invoked, not returned/abandoned) ops
+        self._live: Dict[Any, Any] = {}
+        #: op -> count of abandoned in-flight instances
+        self._ab: Dict[Any, int] = {}
+        self._events = 0
+        self._returns = 0
+        self.violation: Optional[dict] = None
+        self.overflowed = False
+        self._valid = True
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _ckey(spec, live_done: dict, ab_applied: dict) -> tuple:
+        return (spec, frozenset(live_done.items()),
+                frozenset(ab_applied.items()))
+
+    def _check_valid(self) -> None:
+        if not self._valid:
+            raise ValueError("Earlier history was invalid.")
+
+    @property
+    def config_count(self) -> int:
+        return len(self._configs)
+
+    @property
+    def checked_ops(self) -> int:
+        """Completed (returned) ops processed so far."""
+        return self._returns
+
+    def verdict(self) -> Optional[bool]:
+        """``False`` once a violation is flagged, ``True`` while the
+        history so far is linearizable, ``None`` when the
+        configuration bound overflowed (unknown — fall back to the
+        post-hoc tester)."""
+        if not self._valid:
+            return False
+        if self.violation is not None:
+            return False
+        if self.overflowed:
+            return None
+        return True
+
+    def is_consistent(self) -> bool:
+        """Tester-compatible surface: the verdict so far (an
+        overflowed checker reports ``True`` here only if no violation
+        was flagged BEFORE the overflow; use :meth:`verdict` to
+        distinguish unknown)."""
+        return self.verdict() is not False
+
+    # --- the event stream ----------------------------------------------
+    def on_invoke(self, thread_id, op):
+        self._check_valid()
+        if thread_id in self._live:
+            self._valid = False
+            raise ValueError(
+                f"Thread already has an operation in flight. "
+                f"thread_id={thread_id!r}, op={self._live[thread_id]!r}")
+        self._live[thread_id] = op
+        self._events += 1
+        return self
+
+    def abandon(self, thread_id):
+        """The op will never return: fold its thread out of every
+        configuration onto the abandoned-multiset canonical form."""
+        self._check_valid()
+        if thread_id not in self._live:
+            self._valid = False
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r} (abandon)")
+        op = self._live.pop(thread_id)
+        self._events += 1
+        self._ab[op] = self._ab.get(op, 0) + 1
+        if self.violation is not None or self.overflowed:
+            return self
+        merged: Dict[tuple, tuple] = {}
+        for spec, live_done, ab_applied in self._configs.values():
+            if thread_id in live_done:
+                live_done = {t: r for t, r in live_done.items()
+                             if t != thread_id}
+                ab_applied = dict(ab_applied)
+                ab_applied[op] = ab_applied.get(op, 0) + 1
+            merged[self._ckey(spec, live_done, ab_applied)] = (
+                spec, live_done, ab_applied)
+        self._configs = merged
+        return self
+
+    def on_return(self, thread_id, ret):
+        self._check_valid()
+        if thread_id not in self._live:
+            self._valid = False
+            raise ValueError(
+                f"There is no in-flight invocation for this thread ID. "
+                f"thread_id={thread_id!r}, unexpected_return={ret!r}")
+        self._events += 1
+        if self.violation is not None or self.overflowed:
+            del self._live[thread_id]
+            self._returns += 1
+            return self
+        survivors = self._close_and_select(thread_id, ret)
+        del self._live[thread_id]
+        if survivors is None:  # overflow inside the closure
+            self.overflowed = True
+        elif not survivors:
+            self.violation = {
+                "op_index": self._returns,
+                "event_index": self._events - 1,
+                "thread_id": thread_id,
+                "ret": ret,
+            }
+        else:
+            self._configs = survivors
+        self._returns += 1
+        return self
+
+    # --- the closure ----------------------------------------------------
+    def _close_and_select(self, thread_id, ret) -> Optional[dict]:
+        """BFS over all orders of linearizing pending ops, from every
+        current configuration; collect the configurations where
+        ``thread_id``'s op took effect producing ``ret`` (dropping the
+        thread from the done map — the op is complete). Returns None on
+        configuration-bound overflow. States where the thread is done
+        are never expanded further: any op applied AFTER it is
+        deferrable to a later event's closure (nothing observable
+        happens between events), so the minimal survivors are
+        complete."""
+        survivors: Dict[tuple, tuple] = {}
+        frontier = list(self._configs.values())
+        seen = set(self._configs.keys())
+        while frontier:
+            spec, live_done, ab_applied = frontier.pop()
+            done_ret = live_done.get(thread_id, _MISS)
+            if done_ret is not _MISS:
+                if done_ret == ret:
+                    nd = {t: r for t, r in live_done.items()
+                          if t != thread_id}
+                    survivors[self._ckey(spec, nd, ab_applied)] = (
+                        spec, nd, ab_applied)
+                continue
+            # linearize any live pending op not yet applied here
+            for t2, op2 in self._live.items():
+                if t2 in live_done:
+                    continue
+                obj = spec.clone()
+                r2 = obj.invoke(op2)
+                nd = dict(live_done)
+                nd[t2] = r2
+                key = self._ckey(obj, nd, ab_applied)
+                if key not in seen:
+                    seen.add(key)
+                    frontier.append((obj, nd, ab_applied))
+            # linearize an abandoned op with instances left; void
+            # applications (state unchanged, return never checked) are
+            # skipped — they can never matter
+            for op2, count in self._ab.items():
+                if ab_applied.get(op2, 0) >= count:
+                    continue
+                obj = spec.clone()
+                obj.invoke(op2)
+                if obj == spec:
+                    continue
+                nab = dict(ab_applied)
+                nab[op2] = nab.get(op2, 0) + 1
+                key = self._ckey(obj, live_done, nab)
+                if key not in seen:
+                    seen.add(key)
+                    frontier.append((obj, live_done, nab))
+            if len(seen) > self._max:
+                return None
+        return survivors
+
+
+def replay_online(history, spec,
+                  max_configs: int = 1 << 14
+                  ) -> Optional[OnlineLinearizabilityChecker]:
+    """Feed a :class:`~stateright_tpu.semantics.RecordedHistory`'s
+    events through a fresh online checker in recorded order; returns
+    the checker, or ``None`` for a malformed stream (mirroring
+    ``RecordedHistory.replay``)."""
+    checker = OnlineLinearizabilityChecker(spec,
+                                           max_configs=max_configs)
+    return history.replay(checker)

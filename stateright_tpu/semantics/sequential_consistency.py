@@ -113,19 +113,17 @@ class SequentialConsistencyTester(ConsistencyTester):
 _FAILED_MAX = 1 << 20
 
 
-def _serialize(valid_history, ref_obj, remaining, in_flight,
-               failed=None):
-    if all(not h for h in remaining.values()):
-        return valid_history
-    key = None
-    if failed is not None:
-        # each thread's remaining list is a suffix of its original, so
-        # its length pins the position; in-flight entries only leave
-        key = (ref_obj,
-               tuple(sorted((t, len(h)) for t, h in remaining.items())),
-               frozenset(in_flight))
-        if key in failed:
-            return None
+def _config_key(ref_obj, remaining, in_flight):
+    # each thread's remaining list is a suffix of its original, so
+    # its length pins the position; in-flight entries only leave
+    return (ref_obj,
+            tuple(sorted((t, len(h)) for t, h in remaining.items())),
+            frozenset(in_flight))
+
+
+def _branches(ref_obj, remaining, in_flight):
+    """Candidate next steps (see the linearizability tester; here only
+    program order and the spec prune)."""
     for thread_id in list(remaining):
         history = remaining[thread_id]
         if not history:
@@ -136,7 +134,7 @@ def _serialize(valid_history, ref_obj, remaining, in_flight,
             ret = obj.invoke(op)
             branch_in_flight = {t: v for t, v in in_flight.items()
                                 if t != thread_id}
-            branch_remaining = remaining
+            yield op, ret, obj, remaining, branch_in_flight
         else:
             op, ret = history[0]
             obj = ref_obj.clone()
@@ -144,11 +142,47 @@ def _serialize(valid_history, ref_obj, remaining, in_flight,
                 continue
             branch_remaining = dict(remaining)
             branch_remaining[thread_id] = history[1:]
-            branch_in_flight = in_flight
-        result = _serialize(valid_history + [(op, ret)], obj,
-                            branch_remaining, branch_in_flight, failed)
-        if result is not None:
-            return result
-    if key is not None and len(failed) < _FAILED_MAX:
-        failed.add(key)
+            yield op, ret, obj, branch_remaining, in_flight
+
+
+def _serialize(valid_history, ref_obj, remaining, in_flight,
+               failed=None):
+    """Iterative DFS over the interleavings (one explicit frame per
+    serialized op; matches the linearizability tester — long runtime
+    histories must not consume Python recursion depth)."""
+    if all(not h for h in remaining.values()):
+        return list(valid_history)
+    path = list(valid_history)
+
+    def open_node(obj, rem, flight):
+        key = None
+        if failed is not None:
+            key = _config_key(obj, rem, flight)
+            if key in failed:
+                return None
+        return (key, _branches(obj, rem, flight))
+
+    stack = [open_node(ref_obj, remaining, in_flight)]
+    if stack[0] is None:
+        return None
+    while stack:
+        key, branches = stack[-1]
+        pushed = False
+        for op, ret, obj, b_rem, b_flight in branches:
+            path.append((op, ret))
+            if all(not h for h in b_rem.values()):
+                return path
+            child = open_node(obj, b_rem, b_flight)
+            if child is None:
+                path.pop()
+                continue
+            stack.append(child)
+            pushed = True
+            break
+        if not pushed:
+            if key is not None and len(failed) < _FAILED_MAX:
+                failed.add(key)
+            stack.pop()
+            if stack:
+                path.pop()
     return None

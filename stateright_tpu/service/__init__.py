@@ -29,14 +29,17 @@ layout.
 from .batch import (BatchRun, LaneView, bucket_label, normalize_shapes,
                     plan_batch)
 from .driver import DONE, FAILED, PAUSED, RUNNING, StepDriver
-from .jobs import (JOB_STATES, MODEL_REGISTRY, Job, JobSpec, JobStore,
-                   build_model, known_models, register_model)
-from .scheduler import DeviceLease, DevicePool, Scheduler
+from .jobs import (JOB_KINDS, JOB_STATES, MODEL_REGISTRY, Job, JobSpec,
+                   JobStore, build_model, known_models, register_model)
+from .scheduler import (BURNIN_PRIORITY, DeviceLease, DevicePool,
+                        Scheduler)
 from .api import ServiceHandle, serve_jobs
 
 __all__ = [
+    "BURNIN_PRIORITY",
     "BatchRun",
     "DONE",
+    "JOB_KINDS",
     "DeviceLease",
     "DevicePool",
     "FAILED",

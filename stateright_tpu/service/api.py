@@ -5,11 +5,17 @@ Endpoints (JSON unless noted):
     POST /jobs                  submit a job spec -> {"id": ...}
                                 body: {"model", "args", "kwargs",
                                 "options", "priority", "width",
-                                "target", "step_delay", "batch"}
+                                "target", "step_delay", "batch",
+                                "kind"}
                                 ("batch": "auto" opts into the batch
                                 lane engine — README § Batched small
                                 jobs; batched job views carry the
-                                "batch" id and "lane" index)
+                                "batch" id and "lane" index;
+                                "kind": "soak"|"fuzz" runs a named
+                                SOAK_REGISTRY chaos/fuzz config as a
+                                service job — README § Continuous
+                                verification — with "model" the config
+                                name and "kwargs" SoakConfig overrides)
     GET  /jobs                  -> {"jobs": [view...], "profile": {...}}
     GET  /jobs/<id>             -> job view (+ "result" when terminal)
     POST /jobs/<id>/cancel      -> {"ok": bool}
@@ -198,7 +204,8 @@ def _make_handler(scheduler: Scheduler):
                         width=payload.get("width", 1),
                         target=payload.get("target"),
                         step_delay=payload.get("step_delay", 0.0),
-                        batch=payload.get("batch", False))
+                        batch=payload.get("batch", False),
+                        kind=payload.get("kind", "check"))
                     job = scheduler.submit(spec)
                     self._send_json(201, {"id": job.id,
                                           "state": job.state})

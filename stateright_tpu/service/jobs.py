@@ -42,6 +42,17 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..obs import artifact_paths
 
+#: job kinds (spec.json "kind"): CHECK jobs drive a model-checking
+#: engine; SOAK/FUZZ jobs run a seeded chaos soak of the real actor
+#: runtime (stateright_tpu/soak.py) on a worker thread — same store,
+#: same scheduler, same artifact discipline. FUZZ derives its fault
+#: knobs from the seed (soak.fuzz_config), so a seed range IS a
+#: fuzzing campaign scheduled as a job array.
+KIND_CHECK = "check"
+KIND_SOAK = "soak"
+KIND_FUZZ = "fuzz"
+JOB_KINDS = (KIND_CHECK, KIND_SOAK, KIND_FUZZ)
+
 #: job states (status.json "state")
 QUEUED = "queued"
 RUNNING = "running"
@@ -140,12 +151,32 @@ class JobSpec:
     width (the scheduler may grant less when the mesh is busy);
     ``options`` are ``tpu_options`` (artifact/mesh knobs are service-
     owned and stripped); ``step_delay`` throttles the driver loop —
-    a testing knob that makes kill/preempt windows deterministic."""
+    a testing knob that makes kill/preempt windows deterministic.
+
+    ``kind`` selects the job family: ``"check"`` (default) names a
+    MODEL_REGISTRY model; ``"soak"``/``"fuzz"`` name a SOAK_REGISTRY
+    configuration (``stateright_tpu.soak``) whose ``kwargs`` are
+    SoakConfig overrides (ops, seed, fault knobs) — soak jobs stop at
+    settled op-count boundaries for pause/preempt and resume their
+    remaining op budget as a new seeded segment. ``burnin`` marks a
+    scheduler-synthesized background job (the burn-in lane): lowest
+    priority, preempted the moment real work arrives."""
 
     def __init__(self, model: Any, args=(), kwargs=None, options=None,
                  priority: int = 0, width: int = 1,
                  target: Optional[int] = None,
-                 step_delay: float = 0.0, batch=False):
+                 step_delay: float = 0.0, batch=False,
+                 kind: str = KIND_CHECK, burnin: bool = False):
+        if kind not in JOB_KINDS:
+            raise ValueError(
+                f"JobSpec kind must be one of {JOB_KINDS}, got "
+                f"{kind!r}")
+        if kind != KIND_CHECK and batch:
+            raise ValueError(
+                "soak/fuzz jobs cannot ride the batch lane engine "
+                "(they run the actor runtime, not a chunk program)")
+        self.kind = kind
+        self.burnin = bool(burnin)
         if callable(model):
             self.model_name = getattr(model, "__name__", "<callable>")
             self.factory: Optional[Callable] = model
@@ -190,7 +221,8 @@ class JobSpec:
                 "kwargs": self.kwargs, "options": self.options,
                 "priority": self.priority, "width": self.width,
                 "target": self.target, "step_delay": self.step_delay,
-                "batch": self.batch, "durable": self.durable}
+                "batch": self.batch, "durable": self.durable,
+                "kind": self.kind, "burnin": self.burnin}
 
     @classmethod
     def from_json(cls, payload: dict) -> "JobSpec":
@@ -203,7 +235,9 @@ class JobSpec:
                    width=payload.get("width", 1),
                    target=payload.get("target"),
                    step_delay=payload.get("step_delay", 0.0),
-                   batch="auto" if batch == "auto" else False)
+                   batch="auto" if batch == "auto" else False,
+                   kind=payload.get("kind", KIND_CHECK),
+                   burnin=payload.get("burnin", False))
 
 
 class Job:
@@ -258,13 +292,19 @@ class Job:
                "priority": self.spec.priority,
                "width": self.spec.width,
                "durable": self.spec.durable}
+        if self.spec.kind != KIND_CHECK:
+            out["kind"] = self.spec.kind
+        if self.spec.burnin:
+            out["burnin"] = True
         if self.spec.batch:
             out["batch_requested"] = self.spec.batch
         for key in ("seq", "granted_width", "resume", "preempted",
                     "batch", "lane", "batch_fallback", "hosts",
                     "unique", "error", "queued_at", "granted_at",
                     "running_at", "first_chunk_at", "paused_at",
-                    "done_at", "failed_at", "cancelled_at"):
+                    "done_at", "failed_at", "cancelled_at",
+                    "ops_done", "ops_completed", "segments",
+                    "history_ok"):
             if key in self.status:
                 out[key] = self.status[key]
         if self.state in TERMINAL_STATES:

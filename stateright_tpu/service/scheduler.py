@@ -41,6 +41,7 @@ Observability: the scheduler emits ``job_submit`` / ``job_start`` /
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -49,7 +50,13 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 from ..obs import Metrics, MetricsRing, emit_trace_header, make_trace
 from . import jobs as jobstates
 from .driver import DONE, FAILED, RUNNING, StepDriver
-from .jobs import Job, JobSpec, JobStore, TERMINAL_STATES
+from .jobs import (KIND_CHECK, Job, JobSpec, JobStore, TERMINAL_STATES,
+                   _atomic_write_json)
+
+#: priority the scheduler's own burn-in jobs run at: below anything a
+#: tenant can reasonably submit, so ANY real job outranks (and
+#: preempts) the background soak/fuzz load
+BURNIN_PRIORITY = -(1 << 20)
 
 
 class DeviceLease(NamedTuple):
@@ -272,7 +279,8 @@ class _JobRuntime:
     one-slot control channel (pause / preempt / shutdown / cancel)."""
 
     __slots__ = ("lease", "thread", "checker", "driver", "_control",
-                 "_ctl_lock", "granted_at", "first_chunk_seen")
+                 "_ctl_lock", "granted_at", "first_chunk_seen",
+                 "burnin")
 
     def __init__(self, lease: DeviceLease):
         self.lease = lease
@@ -285,6 +293,9 @@ class _JobRuntime:
         # subset, and whether the first-chunk latency has been recorded
         self.granted_at = time.time()
         self.first_chunk_seen = False
+        #: burn-in lane marker (set at launch) — the utilization
+        #: sampler splits pool occupancy into burnin_frac with it
+        self.burnin = False
 
     def set_control(self, ctl: str) -> None:
         with self._ctl_lock:
@@ -330,7 +341,9 @@ class Scheduler:
     def __init__(self, store, devices=None, step_budget: int = 4,
                  trace=None, recover: bool = True,
                  batch_lanes: Optional[int] = None,
-                 batch_wait: Optional[float] = None, hosts=None):
+                 batch_wait: Optional[float] = None, hosts=None,
+                 burnin: Optional[dict] = None,
+                 corpus_dir: Optional[str] = None):
         from .batch import DEFAULT_LANES, DEFAULT_MAX_WAIT
         self._store = store if isinstance(store, JobStore) \
             else JobStore(store)
@@ -377,12 +390,28 @@ class Scheduler:
         self._bucket_keys_seen: set = set()
         self._batch_seq = 0
         self._flush_timer: Optional[threading.Timer] = None
+        # --- continuous verification fleet (PR 15) ---------------------
+        #: burn-in mode: keep the pool saturated with low-priority
+        #: seeded soak/fuzz jobs that preempt cleanly at op boundaries
+        #: when real work arrives. Spec keys: "config" (SOAK_REGISTRY
+        #: name), "kind" ("fuzz" default | "soak"), "overrides"
+        #: (SoakConfig fields), "seed0" (first seed), "max_jobs"
+        #: (total burn-in jobs to synthesize; None = keep refilling)
+        self._burnin = dict(burnin) if burnin else None
+        self._burnin_seq = 0
+        #: where rejected soak/fuzz histories are auto-filed under
+        #: their (protocol, tester, sha256(ops)) dedup key — point it
+        #: at tests/soak_seeds to feed the regression corpus; None
+        #: keeps artifacts inside each job's directory
+        self._corpus_dir = corpus_dir
         if recover:
             self._recover()
             # boot placement pass: recovered RUNNING jobs (and any
-            # still-QUEUED ones) must not wait for the next submit
-            if any(j.state == jobstates.QUEUED
-                   for j in self._store.jobs()):
+            # still-QUEUED ones) must not wait for the next submit —
+            # and a burn-in scheduler saturates the pool at boot
+            if self._burnin is not None \
+                    or any(j.state == jobstates.QUEUED
+                           for j in self._store.jobs()):
                 self._schedule()
 
     # --- introspection -------------------------------------------------
@@ -570,23 +599,28 @@ class Scheduler:
         with self._lock:
             if self._pool is None:
                 return {"busy_frac": 0.0, "per_host": {},
-                        "queue_depth": 0}
+                        "queue_depth": 0, "burnin_frac": 0.0}
             per_free = self._pool.per_host_free()
             hw = self._pool.host_width
             width = self._pool.width
             free = self._pool.free_width()
             depth = int(self._metrics.get("queue_depth", 0) or 0)
+            burn_w = sum(rt.lease.width
+                         for rt in self._running.values() if rt.burnin)
         per_host = {str(h): round(1.0 - f / hw, 4)
                     for h, f in per_free.items()}
         busy = round(1.0 - free / width, 4) if width else 0.0
+        burn = round(burn_w / width, 4) if width else 0.0
         self._metrics.set("pool_busy_frac", busy)
-        fingerprint = (busy, tuple(sorted(per_host.items())))
+        self._metrics.set("burnin_frac", burn)
+        fingerprint = (busy, burn, tuple(sorted(per_host.items())))
         if fingerprint != self._util_prev:
             self._util_prev = fingerprint
             self._trace.emit("pool_util", busy_frac=busy,
-                             per_host=per_host, queue_depth=depth)
+                             per_host=per_host, queue_depth=depth,
+                             burnin_frac=burn)
         return {"busy_frac": busy, "per_host": per_host,
-                "queue_depth": depth}
+                "queue_depth": depth, "burnin_frac": burn}
 
     def utilization(self) -> dict:
         """The live utilization view (`GET /utilization`): current
@@ -832,6 +866,11 @@ class Scheduler:
                     self._maybe_preempt(job)
                     continue
                 self._launch(job, lease)
+            # burn-in AFTER real placement: leftover free width is
+            # soaked with low-priority fuzz work (re-queued burn-in
+            # jobs re-place through the queued loop above first, so
+            # preempted segments resume before new seeds spawn)
+            self._fill_burnin()
             depth = sum(1 for j in self._store.jobs()
                         if j.state == jobstates.QUEUED
                         and j.id not in self._running)
@@ -843,6 +882,38 @@ class Scheduler:
         # queues behind this critical section — holding the lock
         # across file I/O visibly delayed buddy merge-back
         self._util_ring.add(self._util_sample())
+
+    def _fill_burnin(self) -> None:
+        """Saturate remaining free pool width with burn-in soak/fuzz
+        jobs (caller holds the lock). Each synthesized job is a real
+        durable store entry at :data:`BURNIN_PRIORITY`, so it survives
+        restarts, shows in every listing, and is preempted by ANY real
+        submission; ``max_jobs`` caps total synthesis (None = a
+        standing burn-in fleet that refills as jobs finish)."""
+        b = self._burnin
+        if not b or self._closed:
+            return
+        limit = b.get("max_jobs")
+        while True:
+            if limit is not None and self._burnin_seq >= int(limit):
+                return
+            lease = self._pool.acquire(1)
+            if lease is None:
+                return
+            seed = int(b.get("seed0", 0)) + self._burnin_seq
+            self._burnin_seq += 1
+            spec = JobSpec(
+                b.get("config", "write_once"),
+                kwargs=dict(b.get("overrides") or {}, seed=seed),
+                kind=b.get("kind", jobstates.KIND_FUZZ),
+                priority=BURNIN_PRIORITY, burnin=True)
+            job = self._store.create(spec)
+            self._metrics.inc("jobs_submitted")
+            self._trace.emit("job_submit", job=job.id,
+                             model=spec.model_name,
+                             priority=spec.priority, burnin=True,
+                             kind=spec.kind)
+            self._launch(job, lease)
 
     def _maybe_preempt(self, job: Job) -> None:
         """Nothing is free and ``job`` waits: pause the lowest-priority
@@ -862,6 +933,7 @@ class Scheduler:
         # registered under the lock BEFORE the thread starts, so a
         # concurrent _schedule pass can never double-place the job
         rt = _JobRuntime(lease)
+        rt.burnin = bool(job.spec.burnin)
         self._running[job.id] = rt
         # SLO stamp: the queue-wait clock stops the moment the pool
         # GRANTS the subset (compile/seed latency is first_chunk_s's
@@ -903,6 +975,9 @@ class Scheduler:
 
     def _drive_job(self, job: Job, lease: DeviceLease,
                    rt: _JobRuntime) -> None:
+        if job.spec.kind != KIND_CHECK:
+            self._drive_soak(job, lease, rt)
+            return
         import contextlib
 
         import jax
@@ -993,6 +1068,150 @@ class Scheduler:
                 if status != RUNNING:
                     self._finish_job(job, checker, driver)
                     return
+
+    # --- the soak/fuzz worker (continuous verification fleet) ----------
+    def _drive_soak(self, job: Job, lease: DeviceLease,
+                    rt: _JobRuntime) -> None:
+        """Run one soak/fuzz job segment on this worker thread. The
+        driver's ``on_tick`` hook polls the runtime's control channel
+        ~10x/s, so pause/preempt/shutdown stop the soak cleanly at a
+        SETTLED op-count boundary (every claimed op returned or
+        abandoned) and the job re-queues with its remaining op budget
+        — each resumption is a fresh seeded segment (seed offset by
+        the segment index: new ports, fresh chaos stream), each
+        segment independently cross-checked ONLINE. A violation
+        finishes the job immediately (that is the find), auto-filing
+        the rejected history under its corpus dedup key."""
+        from ..soak import build_soak_config, run_soak
+
+        spec = job.spec
+        overrides = dict(spec.kwargs)
+        base_seed = int(overrides.pop("seed", 0))
+        done_ops = int(job.status.get("ops_done", 0))
+        completed = int(job.status.get("ops_completed", 0))
+        segment = int(job.status.get("segments", 0))
+        resumed = segment > 0
+        ctl_box: List[str] = []
+
+        def tick() -> bool:
+            ctl = rt.take_control()
+            if ctl is not None:
+                ctl_box.append(ctl)
+                return True
+            return False
+
+        cfg = build_soak_config(spec.model_name, overrides,
+                                kind=spec.kind, seed=base_seed)
+        total = int(cfg.ops)
+        # fuzz knobs derive from the BASE seed (stable across
+        # segments); the runtime streams re-seed per segment
+        cfg.seed = base_seed + segment * 10007
+        cfg.ops = max(total - done_ops, 0)
+        cfg.on_tick = tick
+        cfg.trace = job.paths["trace"]
+        cfg.artifact_dir = self._corpus_dir or job.dir
+        cfg.history_path = os.path.join(
+            job.dir, "history.jsonl" if segment == 0
+            else f"history.{segment + 1}.jsonl")
+        job.set_state(jobstates.RUNNING, granted_width=lease.width,
+                      resume=resumed,
+                      hosts=[str(h) for h in lease.hosts])
+        self._trace.emit("job_resume" if resumed else "job_start",
+                         job=job.id, width=lease.width,
+                         hosts=[str(h) for h in lease.hosts],
+                         kind=spec.kind)
+        if cfg.ops > 0:
+            res = run_soak(cfg)
+        else:  # resumed with nothing left: trivially complete
+            res = {"protocol": cfg.protocol, "ops": 0, "completed": 0,
+                   "op_timeouts": 0, "history_ok": True, "testers": {},
+                   "artifact": None, "artifacts": {},
+                   "violation_op": None, "stopped": False,
+                   "elapsed": 0.0, "ops_per_s": None,
+                   "crashes": 0, "restarts": 0, "dropped": 0,
+                   "duplicated": 0, "delayed": 0, "reordered": 0,
+                   "partitions": 0}
+        segment += 1
+        done_ops += int(res.get("ops") or 0)
+        completed += int(res.get("completed") or 0)
+        violated = not res.get("history_ok", True)
+        faults = dict(job.status.get("soak_faults") or {})
+        for key in ("crashes", "restarts", "dropped", "duplicated",
+                    "delayed", "reordered", "partitions",
+                    "op_timeouts"):
+            faults[key] = int(faults.get(key, 0)) + int(res.get(key, 0))
+        self._metrics.inc("fuzz_ops", int(res.get("completed") or 0))
+        if violated:
+            self._metrics.inc("violations")
+        progress = dict(ops_done=done_ops, ops_completed=completed,
+                        segments=segment, soak_faults=faults)
+        ctl = ctl_box[0] if ctl_box else None
+        if ctl == "cancel":
+            job.set_state(jobstates.CANCELLED, **progress)
+            self._trace.emit("job_done", job=job.id,
+                             state="cancelled")
+            return
+        if violated or done_ops >= total or ctl is None:
+            # ran to completion — or stopped AT the violating op: the
+            # find IS the result, the artifact is already corpus-filed
+            result = self._soak_result(job, res, base_seed, total,
+                                       done_ops, completed, segment,
+                                       faults, violated)
+            self._metrics.inc("jobs_done")
+            self._metrics.inc("soak_jobs")
+            self._note_done()
+            job.set_state(jobstates.DONE,
+                          history_ok=not violated, **progress)
+            self._trace.emit("job_done", job=job.id, state="done",
+                             kind=spec.kind,
+                             history_ok=not violated,
+                             ops=completed,
+                             violation_op=result["violation_op"])
+            return
+        # op-boundary stop with budget left: hand the subset back
+        if ctl == "preempt":
+            self._metrics.inc("preemptions")
+            if spec.burnin:
+                self._trace.emit("burnin_preempt", job=job.id,
+                                 ops_done=done_ops)
+            job.set_state(jobstates.QUEUED, resume=True,
+                          preempted=True, **progress)
+        elif ctl == "shutdown":
+            job.set_state(jobstates.QUEUED, resume=True, **progress)
+        else:
+            job.set_state(jobstates.PAUSED, resume=True, **progress)
+        self._trace.emit("job_pause", job=job.id,
+                         reason=("preempt" if ctl == "preempt"
+                                 else ctl if ctl else "user"))
+
+    def _soak_result(self, job: Job, res: dict, seed: int, total: int,
+                     done_ops: int, completed: int, segment: int,
+                     faults: dict, violated: bool) -> dict:
+        """The durable result summary for a soak/fuzz job: the verdict,
+        cumulative op/fault counts across segments, the violation pin
+        (op index + corpus artifact) and the SLO lifecycle stamps."""
+        result = {
+            "job": job.id,
+            "kind": job.spec.kind,
+            "config": job.spec.model_name,
+            "protocol": res.get("protocol"),
+            "seed": seed,
+            "burnin": job.spec.burnin,
+            "ops": done_ops,
+            "ops_budget": total,
+            "completed": completed,
+            "segments": segment,
+            "history_ok": not violated,
+            "testers": res.get("testers"),
+            "violation_op": res.get("violation_op"),
+            "artifact": res.get("artifact"),
+            "artifacts": res.get("artifacts"),
+            "ops_per_s": res.get("ops_per_s"),
+            "faults": faults,
+            "lifecycle": job_lifecycle(job),
+        }
+        _atomic_write_json(job.paths["result"], result)
+        return result
 
     def _finish_job(self, job: Job, checker, driver: StepDriver) -> None:
         # metrics BEFORE the state flip (wait(job) unblocks on it)

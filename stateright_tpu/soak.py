@@ -1,0 +1,924 @@
+"""Chaos soak driver: run a checked protocol on the REAL actor runtime
+under live fault injection, with the consistency cross-check running
+ONLINE as the history streams in.
+
+CLI (a thin shim re-exports this module):
+    python tools/soak.py [--protocol write_once|abd] [--ops N]
+                         [--clients N] [--seed N] [--volatile]
+                         [--loss P] [--duplicate P] [--delay P]
+                         [--crashes N] [--partitions N] [--trace PATH]
+                         [--artifact-dir DIR] [--posthoc]
+
+The harness closes ROADMAP item 5's loop between "model checked" and
+"serves real traffic": the SAME ``Actor`` implementations the checker
+verifies are spawned over localhost UDP (`actor/runtime.py`), driven by
+concurrent client threads through thousands of operations while a
+seeded fault schedule fires live — datagram loss, duplication,
+delay/reorder and partitions via
+:class:`~stateright_tpu.actor.chaos.ChaosNetwork`, plus crash–restart
+of individual actors via ``SpawnHandle.crash``/``restart`` (the runtime
+twin of ``ActorModel.crash_restart``). Every client operation is
+recorded invoke/return through a thread-safe
+:class:`~stateright_tpu.semantics.HistoryRecorder` which streams it
+straight into an
+:class:`~stateright_tpu.semantics.OnlineLinearizabilityChecker` — the
+incremental Wing&Gong/Lowe configuration set maintained across ops —
+so a violation ABORTS the soak at the offending operation (with its
+pinned op index) instead of surfacing post-hoc. Sequential consistency
+(no sound online early-abort exists — see ``semantics/online.py``) and
+any overflowed online run still cross-check post-hoc through the batch
+testers.
+
+A rejected history is a real consistency violation: it is dumped as a
+reproducible seed artifact under a CONTENT-DERIVED dedup key —
+``soak_<protocol>_<kind>_<tester>_<sha256(ops)[:16]>.jsonl`` — so a
+re-found violation updates the same file in place instead of piling
+duplicates; the committed ``tests/soak_seeds/`` corpus replays every
+entry as a regression (``tests/test_fuzz_differential.py``).
+
+As SERVICE LOAD (ROADMAP item 5's standing form): ``service/jobs.py``
+job specs with ``kind="soak"`` / ``kind="fuzz"`` name an entry of
+:data:`SOAK_REGISTRY` (mirroring ``MODEL_REGISTRY`` so specs stay
+plain JSON) and the scheduler runs this driver on a worker thread —
+``SoakConfig.on_tick`` lets it stop cleanly at settled op-count
+boundaries for pause/preempt/cancel, which is what makes burn-in
+preemption an op-boundary hand-off rather than a kill. ``kind="fuzz"``
+derives the fault knobs from the seed (:func:`fuzz_config`), so a seed
+range IS a fuzzing campaign.
+
+Obs: the run emits ``RunTrace`` events (``run_start``, ``soak_start``,
+``fault_injection``, periodic ``ops`` summaries, ``crash``/``restart``,
+``partition``, ``violation``, ``soak_done``) and ``Metrics`` keys
+(``ops``, ``op_timeouts``, ``crashes``, ``restarts``, ``dropped``,
+``duplicated``, ``delayed``, ``reordered``, ``partitions``,
+``history_ok``, ``violations``) rendered by ``tools/trace_report.py``
+— a soak postmortem reads like a checker postmortem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import socket as socket_mod
+import threading
+import time
+from dataclasses import dataclass, field, fields as dc_fields
+from random import Random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .actor import Id, spawn
+from .actor.chaos import ChaosNetwork
+from .actor.core import Actor, Out
+from .actor.register import (Get as RGet, GetOk as RGetOk, Put as RPut,
+                             PutOk as RPutOk)
+from .actor.write_once_register import (Get as WGet, GetOk as WGetOk,
+                                        Put as WPut, PutFail as WPutFail,
+                                        PutOk as WPutOk)
+from .examples.linearizable_register import AbdActor, AbdState
+from .obs import Metrics, make_trace
+from .semantics import (HistoryRecorder, LinearizabilityTester,
+                        OnlineLinearizabilityChecker, Read, ReadOk,
+                        Register, SequentialConsistencyTester,
+                        WORegister, Write, WriteFail, WriteOk)
+
+_LOOP = (127, 0, 0, 1)
+
+
+# --- the runnable server twins ----------------------------------------------
+
+class VolatileWOServer(Actor):
+    """Unreplicated write-once register keeping its value in volatile
+    memory only — the deliberately buggy twin (the live analog of
+    ``write_once_packed.py``'s volatile variant): a crash silently
+    loses an acknowledged write, which the history cross-check must
+    catch. ``None`` = unwritten."""
+
+    def on_start(self, id: Id, o: Out):
+        return None
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if isinstance(msg, WPut):
+            if state is None or state == msg.value:
+                o.send(src, WPutOk(msg.request_id))
+                return msg.value if state is None else None
+            o.send(src, WPutFail(msg.request_id))
+            return None
+        if isinstance(msg, WGet):
+            o.send(src, WGetOk(msg.request_id, state))
+            return None
+        return None
+
+
+class DurableWOServer(VolatileWOServer):
+    """The fixed twin: the register value is on stable storage, so the
+    ``durable()`` projection captured at crash time survives the
+    restart."""
+
+    def durable(self, id: Id, state):
+        return state
+
+    def on_restart(self, id: Id, durable, o: Out):
+        return durable
+
+
+class DurableAbdActor(AbdActor):
+    """ABD replica persisting ``(seq, val)`` across crashes; in-flight
+    coordination phase state is volatile (the realistic model: the
+    register is fsync'd, an interrupted quorum round is abandoned and
+    the client times out).
+
+    Two additions over the model-checked actor (whose pinned oracle
+    counts must not change), both required the moment the transport is
+    at-least-once instead of the model's pristine queues:
+
+    * **stale-coordination abort** — a ``Put``/``Get`` carrying a NEW
+      request id aborts a wedged in-flight phase. The checker's bounded
+      networks never wedge a coordinator, but under real loss a quorum
+      round whose acks all vanish leaves ``phase`` busy forever, and
+      ``AbdActor`` ignores every later request. Aborting is safe: the
+      abandoned op stays in-flight, and a partially recorded write may
+      take effect (ABD read-repair keeps it monotone) — linearizability
+      permits both.
+    * **durable request dedup** — a (requester, request id) → reply log
+      short-circuits re-delivered requests (chaos duplication, client
+      resends) with the cached reply instead of re-executing. Without
+      it a duplicated ``Put('A')`` re-executed after a newer write won
+      bumps the sequence number and RESURRECTS the old value — a real
+      at-most-once violation the soak cross-check catches (the
+      reference only model-checks ABD over non-duplicating networks).
+      The log rides stable storage with ``(seq, val)``: it survives
+      restarts (a crash between reply and resend must not re-execute).
+    """
+
+    _DEDUP_CAP = 4096  # recent replies kept per replica (FIFO trim)
+
+    def __init__(self, peers):
+        super().__init__(peers)
+        self._done = {}  # (requester id, request id) -> cached reply
+
+    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
+        if isinstance(msg, (RPut, RGet)):
+            cached = self._done.get((int(src), msg.request_id))
+            if cached is not None:
+                o.send(src, cached)
+                return None
+            if isinstance(state, AbdState) and state.phase is not None \
+                    and msg.request_id != state.phase.request_id:
+                state = AbdState(seq=state.seq, val=state.val,
+                                 phase=None)
+        before = len(o)
+        # a Put/Get with an (aborted or idle) phase always yields a new
+        # Phase1 state from the base actor, so the local abort above is
+        # never lost through a None ("unchanged") return
+        next_state = super().on_msg(id, state, src, msg, o)
+        for cmd in o[before:]:
+            reply = getattr(cmd, "msg", None)
+            if isinstance(reply, (RPutOk, RGetOk)):
+                self._done[(int(cmd.dst), reply.request_id)] = reply
+                while len(self._done) > self._DEDUP_CAP:
+                    self._done.pop(next(iter(self._done)))
+        return next_state
+
+    def durable(self, id: Id, state):
+        if isinstance(state, AbdState):
+            return (state.seq, state.val)
+        return None
+
+    def on_restart(self, id: Id, durable, o: Out):
+        if durable is None:
+            return self.on_start(id, o)
+        seq, val = durable
+        return AbdState(seq=tuple(seq), val=val, phase=None)
+
+
+# --- configuration ----------------------------------------------------------
+
+@dataclass
+class SoakConfig:
+    protocol: str = "write_once"     # write_once | abd
+    ops: int = 2000                  # invoked client-op budget
+    clients: int = 4
+    seed: int = 0
+    durable: bool = True             # False = the buggy volatile twin
+    loss: float = 0.02
+    duplicate: float = 0.02
+    delay: float = 0.1
+    delay_range: Tuple[float, float] = (0.0005, 0.005)
+    crashes: int = 2                 # crash–restart episodes
+    crash_down: float = 0.05         # seconds the actor stays down
+    partitions: int = 1              # partition episodes
+    partition_span: float = 0.15     # seconds a partition holds
+    op_timeout: float = 0.25         # client wait before abandoning
+    put_ratio: float = 0.3           # P(put) per op (first op: put)
+    testers: Tuple[str, ...] = ("linearizability",)
+    artifact_dir: str = "soak_seeds"
+    trace: Any = None                # tpu_options(trace=...)-style sink
+    deadline: float = 120.0          # hard wall for the whole run
+    # --- online checking + service-job integration ---------------------
+    #: stream the history into the incremental linearizability checker
+    #: (a violation stops the run AT the offending op); False = the
+    #: pre-PR-15 post-hoc-only behavior
+    online: bool = True
+    #: configuration-set bound for the online checker (overflow falls
+    #: back to the post-hoc tester — verdicts never change, only when
+    #: they land)
+    max_online_configs: int = 1 << 14
+    #: polled ~10x/s by the run loop; returning truthy stops the soak
+    #: cleanly at a settled op-count boundary (the scheduler's
+    #: pause/preempt hook) — the partial history is still cross-checked
+    on_tick: Any = None
+    #: when set, the FULL recorded history is always dumped here
+    #: (accepted or rejected) — the service's per-job history.jsonl
+    history_path: Any = None
+
+    def meta(self) -> dict:
+        d = {k: getattr(self, k) for k in (
+            "protocol", "ops", "clients", "seed", "durable", "loss",
+            "duplicate", "delay", "crashes", "crash_down", "partitions",
+            "partition_span", "op_timeout", "put_ratio")}
+        d["delay_range"] = list(self.delay_range)
+        d["testers"] = list(self.testers)
+        return d
+
+
+def volatile_demo_config(seed: int = 11, ops: int = 120,
+                         artifact_dir: str = "soak_seeds",
+                         trace: Any = None) -> SoakConfig:
+    """The "volatile caught" twin run, live: a write-once server whose
+    value is NOT durable, one crash–restart mid-run, and ``put_ratio=0``
+    so every op after each client's opening put is a read — the crash
+    deterministically loses an acknowledged write and every post-restart
+    read observes the unwritten register, which the linearizability
+    cross-check must reject (same values mid-soak could otherwise
+    re-win the second epoch and mask the bug)."""
+    return SoakConfig(
+        protocol="write_once", ops=ops, clients=3, seed=seed,
+        durable=False, loss=0.0, duplicate=0.0, delay=0.0, crashes=1,
+        partitions=0, op_timeout=0.3, put_ratio=0.0,
+        artifact_dir=artifact_dir, trace=trace, deadline=30.0)
+
+
+# --- the soak/fuzz config registry (service job specs) ----------------------
+
+#: THE soak-config registry: named protocol/fault configurations the
+#: service's ``kind: soak|fuzz`` job specs reference by name — the
+#: exact shape MODEL_REGISTRY gives checking jobs, so specs stay plain
+#: JSON and survive service restarts. Values are ``SoakConfig`` field
+#: overrides; lazily populated with the built-ins on first use.
+SOAK_REGISTRY: Dict[str, dict] = {}
+
+_SOAK_BUILTINS_LOADED = False
+
+
+def _ensure_soak_builtins() -> None:
+    global _SOAK_BUILTINS_LOADED
+    if _SOAK_BUILTINS_LOADED:
+        return
+    builtin = {
+        "write_once": dict(protocol="write_once", ops=400, clients=3,
+                           loss=0.02, duplicate=0.02, delay=0.08,
+                           crashes=1, partitions=1, op_timeout=0.2,
+                           deadline=60.0),
+        "abd": dict(protocol="abd", ops=400, clients=3, loss=0.02,
+                    duplicate=0.02, delay=0.08, crashes=1,
+                    partitions=1, op_timeout=0.2, deadline=90.0),
+        # the deliberately violating config: the service e2e pin and
+        # the corpus auto-filing demo (README § Continuous
+        # verification)
+        "write_once_volatile": dict(
+            protocol="write_once", ops=120, clients=3, durable=False,
+            loss=0.0, duplicate=0.0, delay=0.0, crashes=1,
+            partitions=0, op_timeout=0.3, put_ratio=0.0,
+            deadline=30.0),
+    }
+    for name, cfg in builtin.items():
+        SOAK_REGISTRY.setdefault(name, cfg)
+    _SOAK_BUILTINS_LOADED = True
+
+
+def register_soak_config(name: str, **defaults) -> None:
+    """Register a named soak configuration for ``kind: soak|fuzz`` job
+    specs (the one registration path — built-ins land here too)."""
+    SOAK_REGISTRY[name] = dict(defaults)
+
+
+def known_soak_configs() -> list:
+    _ensure_soak_builtins()
+    return sorted(SOAK_REGISTRY)
+
+
+#: ``SoakConfig`` fields a seeded fuzz run perturbs (unless the spec
+#: pinned them explicitly) — the knobs that define the fault mix
+_FUZZ_KNOBS = ("loss", "duplicate", "delay", "crashes", "partitions",
+               "put_ratio", "clients")
+
+
+def fuzz_config(seed: int) -> dict:
+    """Deterministic fault-knob perturbation for ``kind: fuzz`` jobs:
+    the seed IS the campaign coordinate — a job array over a seed
+    range sweeps the fault mix."""
+    rng = Random((seed * 0x9E3779B1) ^ 0xF0552)
+    return {
+        "loss": round(rng.uniform(0.0, 0.05), 4),
+        "duplicate": round(rng.uniform(0.0, 0.05), 4),
+        "delay": round(rng.uniform(0.0, 0.15), 4),
+        "crashes": rng.randrange(0, 3),
+        "partitions": rng.randrange(0, 2),
+        "put_ratio": round(rng.uniform(0.15, 0.5), 4),
+        "clients": rng.randrange(2, 5),
+    }
+
+
+def build_soak_config(name: str, overrides: Optional[dict] = None,
+                      kind: str = "soak", **extra) -> SoakConfig:
+    """Resolve a registry name + JSON overrides into a ``SoakConfig``.
+    ``kind="fuzz"`` additionally perturbs the fault knobs from the
+    seed (:func:`fuzz_config`) — explicit overrides win over the
+    perturbation, the perturbation wins over the registry defaults."""
+    _ensure_soak_builtins()
+    base = SOAK_REGISTRY.get(name)
+    if base is None:
+        raise ValueError(
+            f"unknown soak config {name!r}; known configs: "
+            f"{known_soak_configs()} (register_soak_config(name, ...) "
+            "adds more)")
+    overrides = dict(overrides or {})
+    merged = dict(base)
+    if kind == "fuzz":
+        seed = int(overrides.get("seed", extra.get("seed",
+                                                   base.get("seed", 0))))
+        for knob, value in fuzz_config(seed).items():
+            if knob not in overrides:
+                merged[knob] = value
+    merged.update(overrides)
+    merged.update(extra)
+    valid = {f.name for f in dc_fields(SoakConfig)}
+    unknown = sorted(set(merged) - valid)
+    if unknown:
+        raise ValueError(
+            f"unknown SoakConfig fields {unknown} in soak spec "
+            f"{name!r}; valid fields: {sorted(valid)}")
+    if "delay_range" in merged:
+        merged["delay_range"] = tuple(merged["delay_range"])
+    if "testers" in merged:
+        merged["testers"] = tuple(merged["testers"])
+    return SoakConfig(**merged)
+
+
+# --- protocol plumbing ------------------------------------------------------
+
+class _WriteOnceProto:
+    name = "write_once"
+    spec_name = "woregister"
+
+    def __init__(self, cfg: SoakConfig, ports: List[int]):
+        self.cfg = cfg
+        self.server_ids = [Id.from_socket_addr(_LOOP, ports[0])]
+        self.crash_target = self.server_ids[0]
+
+    def actors(self):
+        server = DurableWOServer() if self.cfg.durable \
+            else VolatileWOServer()
+        return [(self.server_ids[0], server)]
+
+    def spec(self):
+        return WORegister()
+
+    def pick_server(self, cix: int, rng: Random) -> Id:
+        return self.server_ids[0]
+
+    def put(self, rid: int, value):
+        return WPut(rid, value)
+
+    def get(self, rid: int):
+        return WGet(rid)
+
+    def map_ret(self, msg) -> Optional[Any]:
+        if isinstance(msg, WPutOk):
+            return WriteOk()
+        if isinstance(msg, WPutFail):
+            return WriteFail()
+        if isinstance(msg, WGetOk):
+            return ReadOk(msg.value)
+        return None
+
+    def partition_groups(self, client_ids: Sequence[int]):
+        """Cut half the clients off from the server for the span (their
+        ops time out; the rest keep serving)."""
+        clients = sorted(client_ids)
+        keep = clients[0::2]
+        cut = clients[1::2]
+        if not cut:
+            return None
+        return [[int(self.server_ids[0])] + keep, cut]
+
+
+class _AbdProto:
+    name = "abd"
+    spec_name = "register"
+
+    def __init__(self, cfg: SoakConfig, ports: List[int]):
+        self.cfg = cfg
+        self.server_ids = [Id.from_socket_addr(_LOOP, p)
+                           for p in ports[:3]]
+        # crash only ONE designated replica (possibly repeatedly): with
+        # durable (seq, val) any quorum stays correct; ABD tolerates a
+        # minority down
+        self.crash_target = self.server_ids[-1]
+
+    def actors(self):
+        cls = DurableAbdActor if self.cfg.durable else AbdActor
+        return [(sid, cls([p for p in self.server_ids if p != sid]))
+                for sid in self.server_ids]
+
+    def spec(self):
+        return Register('\0')
+
+    def pick_server(self, cix: int, rng: Random) -> Id:
+        # sticky routing: each client keeps one coordinator (the ABD
+        # coordinator serializes one request at a time, so spreading
+        # clients over replicas avoids busy-drops)
+        return self.server_ids[cix % len(self.server_ids)]
+
+    def put(self, rid: int, value):
+        return RPut(rid, value)
+
+    def get(self, rid: int):
+        return RGet(rid)
+
+    def map_ret(self, msg) -> Optional[Any]:
+        if isinstance(msg, RPutOk):
+            return WriteOk()
+        if isinstance(msg, RGetOk):
+            return ReadOk(msg.value)
+        return None
+
+    def partition_groups(self, client_ids: Sequence[int]):
+        """Isolate the middle replica from its peers (clients still
+        reach it, so its coordinations stall into client timeouts; the
+        other two keep quorum)."""
+        ids = [int(s) for s in self.server_ids]
+        return [[ids[0]] + ids[2:], [ids[1]]]
+
+
+_PROTOCOLS = {"write_once": _WriteOnceProto, "abd": _AbdProto}
+
+
+def spec_for(meta: dict):
+    """Rebuild the sequential spec named by an artifact's meta header."""
+    name = meta.get("spec", "woregister")
+    if name == "woregister":
+        return WORegister()
+    if name == "register":
+        return Register('\0')
+    raise ValueError(f"unknown spec {name!r} in artifact meta")
+
+
+def tester_for(name: str, spec):
+    if name == "linearizability":
+        return LinearizabilityTester(spec)
+    if name == "sequential":
+        return SequentialConsistencyTester(spec)
+    raise ValueError(f"unknown tester {name!r}")
+
+
+def check_artifact(path) -> dict:
+    """Replay a dumped seed artifact through the testers named in its
+    meta header; returns {tester: ok} (the regression harness asserts
+    every value stays False)."""
+    from .semantics import RecordedHistory
+
+    meta, history = RecordedHistory.load(path)
+    meta = meta or {}
+    out = {}
+    for name in meta.get("testers", ["linearizability"]):
+        out[name] = history.check(tester_for(name, spec_for(meta)))
+    return out
+
+
+# --- seed-corpus filing (content-derived dedup key) -------------------------
+
+def artifact_filename(protocol: str, kind: str, tester: str,
+                      digest: str) -> str:
+    """The keyed corpus layout: ``(protocol, tester, sha256(ops))`` is
+    the identity — a re-found violation (same op stream) maps to the
+    SAME file and updates in place instead of piling duplicates; the
+    ``kind`` token (durable/volatile) keeps filenames self-describing
+    for humans."""
+    return f"soak_{protocol}_{kind}_{tester}_{digest[:16]}.jsonl"
+
+
+def file_violation(directory, protocol: str, kind: str, tester: str,
+                   history, meta: dict) -> str:
+    """Write (or update in place) one rejected history under its dedup
+    key; returns the path."""
+    os.makedirs(directory, exist_ok=True)
+    digest = history.ops_digest()
+    meta = dict(meta)
+    meta["testers"] = [tester]
+    meta["ops_sha256"] = digest
+    path = os.path.join(
+        directory, artifact_filename(protocol, kind, tester, digest))
+    history.dump(path, meta)
+    return path
+
+
+# --- the driver -------------------------------------------------------------
+
+def _free_udp_ports(n: int) -> List[int]:
+    """``n`` free UDP ports (bound-then-released probe; the tiny race
+    is acceptable for a localhost soak)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket_mod.socket(socket_mod.AF_INET,
+                                  socket_mod.SOCK_DGRAM)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+@dataclass
+class _Shared:
+    """State shared between client threads and the fault scheduler.
+
+    ``gate`` paces the op stream against the fault schedule: clients
+    may only claim ops below it, so each fault fires at a *settled*
+    op-count boundary (every pre-gate op returned or abandoned) instead
+    of racing a fast loopback stream that can exhaust the whole budget
+    before the scheduler's first poll — fault placement is deterministic
+    relative to the op sequence, which is what makes the soak verdicts
+    pinnable as tests."""
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    issued: int = 0
+    gate: int = 0
+    stop: threading.Event = field(default_factory=threading.Event)
+    client_ids: List[int] = field(default_factory=list)
+
+
+def _claim_op(shared: _Shared, budget: int) -> str:
+    """Claim the next op slot: ``"go"`` (claimed), ``"wait"`` (paused
+    at a fault gate), or ``"done"`` (budget exhausted)."""
+    with shared.lock:
+        if shared.issued >= budget:
+            return "done"
+        if shared.issued >= shared.gate:
+            return "wait"
+        shared.issued += 1
+        return "go"
+
+
+def _client_loop(cix: int, cfg: SoakConfig, proto, chaos: ChaosNetwork,
+                 recorder: HistoryRecorder, shared: _Shared) -> None:
+    rng = Random(((cfg.seed * 0x9E3779B1) ^ (0xC11E47 + cix))
+                 & 0xFFFFFFFFFFFF)
+    raw = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    try:
+        raw.bind(("127.0.0.1", 0))
+        cid = Id.from_socket_addr(_LOOP, raw.getsockname()[1])
+        with shared.lock:
+            shared.client_ids.append(int(cid))
+        sock = chaos.wrap(cid, raw)
+        value = chr(ord('A') + cix)  # per-client value: attributable
+        epoch = 0
+        opnum = 0
+        first = True
+        while not shared.stop.is_set():
+            verdict = _claim_op(shared, cfg.ops)
+            if verdict == "done":
+                break
+            if verdict == "wait":
+                time.sleep(0.002)
+                continue
+            opnum += 1
+            rid = cix * 1_000_000 + opnum
+            do_put = first or rng.random() < cfg.put_ratio
+            first = False
+            sid = proto.pick_server(cix, rng)
+            dst_ip, dst_port = sid.socket_addr()
+            addr = (".".join(map(str, dst_ip)), dst_port)
+            if do_put:
+                op, wire = Write(value), proto.put(rid, value)
+            else:
+                op, wire = Read(), proto.get(rid)
+            thread = f"c{cix}.{epoch}"
+            payload = pickle.dumps(wire)
+            recorder.invoke(thread, op)
+            deadline = time.monotonic() + cfg.op_timeout
+            resend_at = time.monotonic() + cfg.op_timeout / 2
+            try:
+                sock.sendto(payload, addr)
+            except OSError:
+                pass
+            got = None
+            while got is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                if time.monotonic() >= resend_at:
+                    # one mid-timeout resend rides out a lost request
+                    # (same rid: still the one in-flight operation)
+                    resend_at = deadline + 1.0
+                    try:
+                        sock.sendto(payload, addr)
+                    except OSError:
+                        pass
+                raw.settimeout(min(remaining, cfg.op_timeout / 2))
+                try:
+                    data, _src = raw.recvfrom(65535)
+                except (socket_mod.timeout, OSError):
+                    continue
+                try:
+                    msg = pickle.loads(data)
+                except Exception:
+                    continue
+                if getattr(msg, "request_id", None) != rid:
+                    continue  # stale reply for an abandoned/old op
+                got = proto.map_ret(msg)
+            if got is None:
+                # abandon: the op stays in-flight in the history; the
+                # recorder RETIRES this logical thread id (a later
+                # resend must run under the next epoch's id)
+                recorder.abandon(thread)
+                epoch += 1
+            else:
+                recorder.ret(thread, got)
+    finally:
+        raw.close()
+
+
+def _fault_schedule(cfg: SoakConfig) -> List[Tuple[int, str]]:
+    """(invoked-op threshold, kind) pairs, evenly interleaved: crashes
+    at k/(crashes+1) of the budget, partitions offset between them."""
+    events: List[Tuple[int, str]] = []
+    for k in range(cfg.crashes):
+        events.append((cfg.ops * (k + 1) // (cfg.crashes + 1), "crash"))
+    for k in range(cfg.partitions):
+        events.append(
+            (cfg.ops * (2 * k + 1) // (2 * cfg.partitions + 1),
+             "partition"))
+    return sorted(events)
+
+
+def _scheduler_loop(cfg: SoakConfig, proto, handle,
+                    chaos: ChaosNetwork, recorder: HistoryRecorder,
+                    metrics: Metrics, trace, shared: _Shared) -> None:
+    schedule = _fault_schedule(cfg)
+    for i, (threshold, kind) in enumerate(schedule):
+        next_gate = schedule[i + 1][0] if i + 1 < len(schedule) \
+            else cfg.ops
+        # wait for the stream to reach the gate and settle (every
+        # claimed op returned or abandoned); bounded so a wedged
+        # client can't hang the schedule
+        settle_by = time.monotonic() + 2 * cfg.op_timeout + 5.0
+        while not shared.stop.is_set() \
+                and time.monotonic() < settle_by:
+            with shared.lock:
+                issued = shared.issued
+            if issued >= threshold \
+                    and recorder.returned + recorder.abandoned \
+                    >= issued:
+                break
+            time.sleep(0.005)
+        if shared.stop.is_set():
+            return
+        if kind == "crash":
+            sid = proto.crash_target
+            if trace:
+                trace.emit("crash", actor=int(sid))
+            handle.crash(sid)
+            metrics.inc("crashes")
+            # release the gate while the actor is down so ops are
+            # attempted against the hole (timeout path), then reboot
+            with shared.lock:
+                shared.gate = next_gate
+            time.sleep(cfg.crash_down)
+            handle.restart(sid)
+            metrics.inc("restarts")
+            if trace:
+                trace.emit("restart", actor=int(sid))
+        else:
+            with shared.lock:
+                client_ids = list(shared.client_ids)
+                shared.gate = next_gate
+            groups = proto.partition_groups(client_ids)
+            if groups is None:
+                continue
+            chaos.set_partition(groups)
+            time.sleep(cfg.partition_span)
+            chaos.heal()
+    with shared.lock:
+        shared.gate = cfg.ops
+
+
+def run_soak(cfg: SoakConfig) -> dict:
+    """Run one seeded soak; returns the result/metrics dict (see the
+    module docstring). A rejected history additionally lands a seed
+    artifact under its content-derived dedup key and its path under
+    ``"artifact"``. With ``cfg.online`` (default) the linearizability
+    cross-check runs INCREMENTALLY — a violation stops the run at the
+    offending operation and ``"violation_op"`` pins its index; with
+    ``cfg.on_tick`` the run stops cleanly at a settled op boundary
+    whenever the callback returns truthy (``"stopped": true``)."""
+    proto_cls = _PROTOCOLS.get(cfg.protocol)
+    if proto_cls is None:
+        raise ValueError(f"unknown protocol {cfg.protocol!r} "
+                         f"(have: {sorted(_PROTOCOLS)})")
+    metrics = Metrics()
+    trace = make_trace(cfg.trace, engine="soak")
+    chaos = ChaosNetwork(seed=cfg.seed, loss=cfg.loss,
+                         duplicate=cfg.duplicate, delay=cfg.delay,
+                         delay_range=cfg.delay_range, metrics=metrics,
+                         trace=trace)
+    n_servers = 3 if cfg.protocol == "abd" else 1
+    proto = proto_cls(cfg, _free_udp_ports(n_servers))
+    online = None
+    if cfg.online and "linearizability" in cfg.testers:
+        online = OnlineLinearizabilityChecker(
+            proto.spec(), max_configs=cfg.max_online_configs)
+    recorder = HistoryRecorder(observer=online)
+    shared = _Shared()
+    schedule = _fault_schedule(cfg)
+    shared.gate = schedule[0][0] if schedule else cfg.ops
+    if trace:
+        from .obs import identity_fields, new_run_id
+        trace.emit("run_start", model=f"soak:{proto.name}",
+                   wall=time.time(),
+                   **identity_fields(trace, new_run_id("soak")))
+        trace.emit("soak_start", protocol=proto.name, ops=cfg.ops,
+                   seed=cfg.seed, clients=cfg.clients,
+                   online=bool(online))
+        trace.emit("fault_injection", max_crashes=cfg.crashes,
+                   actors=[int(proto.crash_target)])
+    t0 = time.monotonic()
+    handle = spawn(pickle.dumps, pickle.loads, proto.actors(),
+                   background=True, seed=cfg.seed, chaos=chaos)
+    clients = [threading.Thread(
+        target=_client_loop,
+        args=(cix, cfg, proto, chaos, recorder, shared),
+        daemon=True, name=f"soak-client-{cix}")
+        for cix in range(cfg.clients)]
+    scheduler = threading.Thread(
+        target=_scheduler_loop,
+        args=(cfg, proto, handle, chaos, recorder, metrics, trace,
+              shared),
+        daemon=True, name="soak-scheduler")
+    stopped = False
+    try:
+        for t in clients:
+            t.start()
+        scheduler.start()
+        hard_deadline = t0 + cfg.deadline
+        last_emit = (0, 0, 0)
+        for t in clients:
+            while t.is_alive():
+                t.join(0.1)
+                counts = (recorder.invoked, recorder.returned,
+                          recorder.abandoned)
+                if trace and counts != last_emit:
+                    trace.emit("ops", op_invoke=counts[0],
+                               op_return=counts[1],
+                               op_timeouts=counts[2])
+                    last_emit = counts
+                if online is not None and online.violation is not None:
+                    # the incremental checker flagged the offending op:
+                    # abort the soak NOW — the artifact captures the
+                    # violating prefix, not another thousand ops
+                    shared.stop.set()
+                if cfg.on_tick is not None and not stopped \
+                        and cfg.on_tick():
+                    # external stop (pause/preempt/cancel): wind down
+                    # at the settled op boundary
+                    stopped = True
+                    shared.stop.set()
+                if time.monotonic() > hard_deadline:
+                    shared.stop.set()
+    finally:
+        shared.stop.set()
+        scheduler.join(5.0)
+        handle.stop()
+        chaos.close()
+    elapsed = time.monotonic() - t0
+
+    history = recorder.history()
+    results = {}
+    violation_op = None
+    ok = True
+    for name in cfg.testers:
+        if name == "linearizability" and online is not None \
+                and online.verdict() is not None:
+            results[name] = online.verdict()
+            if online.violation is not None:
+                violation_op = online.violation["op_index"]
+        else:
+            # post-hoc fallback: online off, or the configuration
+            # bound overflowed (verdict unknown) — and every
+            # non-linearizability tester
+            results[name] = history.check(
+                tester_for(name, proto.spec()))
+        ok = ok and results[name]
+    metrics.set("ops", recorder.returned)
+    metrics.set("op_timeouts", recorder.abandoned)
+    metrics.set("history_ok", int(ok))
+
+    kind = "durable" if cfg.durable else "volatile"
+    meta = cfg.meta()
+    meta["spec"] = proto.spec_name
+    meta["completed"] = recorder.returned
+    if cfg.history_path:
+        history.dump(cfg.history_path, meta)
+
+    artifacts = {}
+    for name, verdict in results.items():
+        if verdict:
+            continue
+        artifacts[name] = file_violation(
+            cfg.artifact_dir, proto.name, kind, name, history, meta)
+    if artifacts:
+        metrics.set("violations", len(artifacts))
+    artifact = next(iter(artifacts.values()), None)
+
+    if trace:
+        for name, path in artifacts.items():
+            trace.emit(
+                "violation", tester=name, artifact=path,
+                op_index=(violation_op
+                          if name == "linearizability" else None))
+        trace.emit("soak_done", ops=recorder.returned,
+                   history_ok=bool(ok))
+        trace.close()
+
+    snap = metrics.snapshot()
+    result = {
+        "protocol": proto.name,
+        "seed": cfg.seed,
+        "durable": cfg.durable,
+        "ops": recorder.invoked,
+        "completed": recorder.returned,
+        "op_timeouts": recorder.abandoned,
+        "elapsed": round(elapsed, 3),
+        "ops_per_s": round(recorder.returned / elapsed, 1)
+        if elapsed > 0 else None,
+        "history_ok": bool(ok),
+        "testers": results,
+        "artifact": artifact,
+        "artifacts": artifacts,
+        "violation_op": violation_op,
+        "stopped": stopped,
+    }
+    for key in ("crashes", "restarts", "dropped", "duplicated",
+                "delayed", "reordered", "partitions"):
+        result[key] = int(snap.get(key, 0))
+    return result
+
+
+# --- CLI --------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="chaos soak: live faults + online consistency "
+                    "cross-check")
+    p.add_argument("--protocol", default="write_once",
+                   choices=sorted(_PROTOCOLS))
+    p.add_argument("--ops", type=int, default=2000)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--volatile", action="store_true",
+                   help="run the buggy volatile twin (the cross-check "
+                        "must reject it under crash-restart)")
+    p.add_argument("--loss", type=float, default=0.02)
+    p.add_argument("--duplicate", type=float, default=0.02)
+    p.add_argument("--delay", type=float, default=0.1)
+    p.add_argument("--crashes", type=int, default=2)
+    p.add_argument("--partitions", type=int, default=1)
+    p.add_argument("--sequential", action="store_true",
+                   help="also cross-check sequential consistency")
+    p.add_argument("--posthoc", action="store_true",
+                   help="disable the online checker (post-hoc only)")
+    p.add_argument("--trace", default=None, metavar="PATH")
+    p.add_argument("--artifact-dir", default="soak_seeds")
+    args = p.parse_args(argv)
+
+    testers = ("linearizability", "sequential") if args.sequential \
+        else ("linearizability",)
+    cfg = SoakConfig(
+        protocol=args.protocol, ops=args.ops, clients=args.clients,
+        seed=args.seed, durable=not args.volatile, loss=args.loss,
+        duplicate=args.duplicate, delay=args.delay,
+        crashes=args.crashes, partitions=args.partitions,
+        testers=testers, trace=args.trace, online=not args.posthoc,
+        artifact_dir=args.artifact_dir)
+    result = run_soak(cfg)
+    print(json.dumps(result))
+    return 0 if result["history_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

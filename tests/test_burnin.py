@@ -1,0 +1,310 @@
+"""Continuous verification fleet: soak/fuzz job kinds + burn-in mode
+(README § Continuous verification).
+
+ACCEPTANCE pins, all on the CPU-forced virtual mesh:
+
+* a ``kind: soak`` job runs a seeded chaos soak on a scheduler worker
+  thread and lands the standard per-job artifact set (history.jsonl,
+  schema-valid trace.jsonl, result.json with verdict + op/fault
+  counts);
+* a scheduler with burn-in enabled SATURATES a 2-device pool with fuzz
+  jobs; a submitted real checking job is granted within one
+  op-boundary preemption and finishes bit-identical (sha256 digest) to
+  a solo run; the preempted fuzz job resumes its remaining op budget
+  and completes;
+* a seeded violating config run as a service job auto-files its
+  rejected history into the corpus directory under the
+  ``(protocol, tester, sha256(ops))`` dedup key, and the corpus replay
+  check keeps rejecting it;
+* pause → resume of a soak job crosses segments (op-boundary stop,
+  remaining budget resumed).
+
+The ``bench.py --burnin-smoke`` contract subprocess pin rides ``-m
+slow`` (tier-1 budget discipline — the in-process pins above cover the
+same machinery).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
+from stateright_tpu.obs import validate_event  # noqa: E402
+from stateright_tpu.service import (BURNIN_PRIORITY, JobSpec,  # noqa: E402
+                                    JobStore, Scheduler)
+from stateright_tpu.soak import check_artifact  # noqa: E402
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: pinned engine shapes (shared with tests/test_service.py so the
+#: persistent compile cache is reused)
+OPTS = {"capacity": 1 << 12, "fmax": 64, "chunk_steps": 2}
+
+
+def _digest(checker) -> str:
+    fps = sorted(int(f) for f in checker.generated_fingerprints())
+    return hashlib.sha256("\n".join(map(str, fps)).encode()).hexdigest()
+
+
+@pytest.fixture(scope="module")
+def solo_2pc3_digest():
+    ck = (TwoPhaseSys(3).checker()
+          .tpu_options(race=False, **OPTS).spawn_tpu().join())
+    return _digest(ck)
+
+
+def _wait_running(sched, n, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        running = [j for j in sched.jobs() if j.state == "running"]
+        if len(running) >= n:
+            return running
+        time.sleep(0.05)
+    raise AssertionError(
+        f"pool never reached {n} running jobs: "
+        f"{[(j.id, j.state) for j in sched.jobs()]}")
+
+
+class TestSoakJobKinds:
+    def test_soak_job_lands_standard_artifacts(self, tmp_path):
+        sched = Scheduler(JobStore(str(tmp_path / "svc")),
+                          devices=jax.devices()[:1])
+        try:
+            job = sched.submit(JobSpec(
+                "write_once", kind="soak",
+                kwargs={"ops": 80, "seed": 3, "crashes": 1,
+                        "partitions": 0, "deadline": 20.0}))
+            assert sched.wait(job.id, timeout=60.0) == "done"
+            view = job.view()
+            assert view["kind"] == "soak"
+            assert view["history_ok"] is True
+            result = job.read_result()
+            assert result["kind"] == "soak"
+            assert result["protocol"] == "write_once"
+            assert result["history_ok"] is True
+            assert result["ops"] == 80 and result["completed"] > 0
+            assert result["faults"]["crashes"] == 1
+            assert result["segments"] == 1
+            assert result["lifecycle"]["queue_wait_s"] >= 0
+            # the standard artifact set: history + schema-valid trace
+            assert os.path.exists(os.path.join(job.dir,
+                                               "history.jsonl"))
+            with open(os.path.join(job.dir, "trace.jsonl")) as f:
+                events = [json.loads(line) for line in f]
+            for ev in events:
+                validate_event(ev)
+            kinds = {e["ev"] for e in events}
+            assert {"run_start", "soak_start", "soak_done"} <= kinds
+            prof = sched.profile()
+            assert prof["soak_jobs"] == 1
+            assert prof["fuzz_ops"] == result["completed"]
+        finally:
+            sched.shutdown()
+
+    def test_fuzz_kind_derives_knobs_from_seed(self, tmp_path):
+        # the registry config + seed fully determine the fault mix;
+        # unknown configs fail loudly with the known list
+        from stateright_tpu.soak import build_soak_config
+        a = build_soak_config("write_once", {"seed": 9}, kind="fuzz")
+        b = build_soak_config("write_once", {"seed": 9}, kind="fuzz")
+        c = build_soak_config("write_once", {"seed": 10}, kind="fuzz")
+        knobs = ("loss", "duplicate", "delay", "crashes", "partitions",
+                 "put_ratio", "clients")
+        assert [getattr(a, k) for k in knobs] \
+            == [getattr(b, k) for k in knobs]
+        assert [getattr(a, k) for k in knobs] \
+            != [getattr(c, k) for k in knobs]
+        # explicit overrides always win over the perturbation
+        pinned = build_soak_config("write_once",
+                                   {"seed": 9, "crashes": 0},
+                                   kind="fuzz")
+        assert pinned.crashes == 0
+        with pytest.raises(ValueError, match="known configs"):
+            build_soak_config("nope", {})
+        with pytest.raises(ValueError, match="unknown SoakConfig"):
+            build_soak_config("write_once", {"bogus_knob": 1})
+        # spec validation: soak jobs cannot ride the batch lanes
+        with pytest.raises(ValueError, match="batch"):
+            JobSpec("write_once", kind="soak", batch="auto")
+        with pytest.raises(ValueError, match="kind"):
+            JobSpec("write_once", kind="chaos")
+
+    def test_pause_resumes_remaining_budget_as_new_segment(
+            self, tmp_path):
+        sched = Scheduler(JobStore(str(tmp_path / "svc")),
+                          devices=jax.devices()[:1])
+        try:
+            job = sched.submit(JobSpec(
+                "write_once", kind="soak",
+                kwargs={"ops": 1200, "seed": 5, "crashes": 0,
+                        "partitions": 0, "delay": 0.0,
+                        "op_timeout": 0.15, "deadline": 60.0}))
+            _wait_running(sched, 1)
+            time.sleep(0.4)  # let some ops land
+            assert sched.pause(job.id)
+            assert sched.wait(job.id, timeout=30.0,
+                              states=("paused",)) == "paused"
+            ops_done = job.status["ops_done"]
+            assert 0 < ops_done < 1200, ops_done
+            assert job.status["segments"] == 1
+            assert sched.resume(job.id)
+            assert sched.wait(job.id, timeout=90.0) == "done"
+            result = job.read_result()
+            assert result["segments"] == 2
+            assert result["ops"] == 1200
+            assert result["history_ok"] is True
+        finally:
+            sched.shutdown()
+
+    def test_violating_config_auto_files_into_corpus(self, tmp_path):
+        corpus = str(tmp_path / "corpus")
+        sched = Scheduler(JobStore(str(tmp_path / "svc")),
+                          devices=jax.devices()[:1],
+                          corpus_dir=corpus)
+        try:
+            job = sched.submit(JobSpec("write_once_volatile",
+                                       kind="soak",
+                                       kwargs={"seed": 4}))
+            assert sched.wait(job.id, timeout=60.0) == "done"
+            result = job.read_result()
+            assert result["history_ok"] is False
+            # the ONLINE checker pinned the offending op strictly
+            # inside the history
+            assert result["violation_op"] is not None
+            assert result["violation_op"] < result["completed"]
+            assert sched.profile()["violations"] == 1
+            # the artifact landed under its dedup key, in the corpus
+            # dir, and the corpus replay check keeps rejecting it —
+            # exactly what tests/test_fuzz_differential.py runs over
+            # the committed tests/soak_seeds/ layout
+            files = [f for f in os.listdir(corpus)
+                     if f.endswith(".jsonl")]
+            assert len(files) == 1
+            assert files[0].startswith(
+                "soak_write_once_volatile_linearizability_")
+            verdicts = check_artifact(os.path.join(corpus, files[0]))
+            assert verdicts and not any(verdicts.values())
+            assert result["artifact"] == os.path.join(corpus, files[0])
+            # the violation event rode the job's trace
+            with open(os.path.join(job.dir, "trace.jsonl")) as f:
+                events = [json.loads(line) for line in f]
+            viol = [e for e in events if e["ev"] == "violation"]
+            assert viol and viol[0]["tester"] == "linearizability"
+            assert viol[0]["op_index"] == result["violation_op"]
+        finally:
+            sched.shutdown()
+
+
+class TestBurninMode:
+    def test_burnin_e2e_preemption_parity_and_resume(
+            self, tmp_path, solo_2pc3_digest):
+        """THE acceptance pin: saturation → op-boundary preemption →
+        bit-identical real job → preempted fuzz lane resumes and
+        completes."""
+        sched = Scheduler(
+            JobStore(str(tmp_path / "svc")),
+            devices=jax.devices()[:2],
+            burnin={"kind": "fuzz", "config": "write_once",
+                    "overrides": {"ops": 700, "deadline": 40.0,
+                                  "crashes": 0, "partitions": 0,
+                                  "delay": 0.0, "op_timeout": 0.15},
+                    "max_jobs": 2})
+        try:
+            running = _wait_running(sched, 2)
+            assert all(j.spec.burnin for j in running)
+            assert all(j.spec.kind == "fuzz" for j in running)
+            assert all(j.priority == BURNIN_PRIORITY for j in running)
+            util = sched.utilization()
+            assert util["busy_frac"] == 1.0
+            assert util["burnin_frac"] == 1.0
+            # a real checking job preempts a fuzz lane and lands the
+            # solo-identical digest
+            real = sched.submit(JobSpec("twopc", args=[3],
+                                        options=OPTS))
+            assert sched.wait(real.id, timeout=120.0) == "done"
+            result = real.read_result()
+            assert result["fingerprints_sha256"] == solo_2pc3_digest
+            prof = sched.profile()
+            assert prof["preemptions"] >= 1
+            preempted = [j for j in sched.jobs()
+                         if j.status.get("preempted")]
+            assert preempted, "no burn-in lane was preempted"
+            victim = preempted[0]
+            # the preempted fuzz job resumes and completes its budget
+            assert sched.wait(victim.id, timeout=120.0) == "done"
+            vres = victim.read_result()
+            assert vres["segments"] >= 2
+            assert vres["ops"] == 700
+            assert vres["history_ok"] is True
+            # burn-in visibility: the preemption event + submit marks
+            with open(os.path.join(str(tmp_path / "svc"),
+                                   "service.jsonl")) as f:
+                events = [json.loads(line) for line in f]
+            for ev in events:
+                validate_event(ev)
+            kinds = {}
+            for ev in events:
+                kinds[ev["ev"]] = kinds.get(ev["ev"], 0) + 1
+            assert kinds.get("burnin_preempt", 0) >= 1
+            assert any(e["ev"] == "job_submit" and e.get("burnin")
+                       for e in events)
+        finally:
+            sched.shutdown()
+
+    def test_burnin_caps_and_drains(self, tmp_path):
+        # max_jobs bounds synthesis: the fleet runs its seeds to
+        # completion and the pool drains back to idle
+        sched = Scheduler(
+            JobStore(str(tmp_path / "svc")),
+            devices=jax.devices()[:2],
+            burnin={"kind": "soak", "config": "write_once",
+                    "overrides": {"ops": 60, "deadline": 20.0,
+                                  "crashes": 0, "partitions": 0,
+                                  "delay": 0.0, "op_timeout": 0.15},
+                    "max_jobs": 3})
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                jobs = sched.jobs()
+                if len(jobs) == 3 and all(j.state == "done"
+                                          for j in jobs):
+                    break
+                time.sleep(0.1)
+            jobs = sched.jobs()
+            assert len(jobs) == 3
+            assert all(j.state == "done" for j in jobs)
+            # distinct seeds per synthesized job (seed0 + sequence)
+            seeds = sorted(j.spec.kwargs["seed"] for j in jobs)
+            assert seeds == [0, 1, 2]
+            assert sched.profile()["soak_jobs"] == 3
+            util = sched.utilization()
+            assert util["burnin_frac"] == 0.0
+            assert util["busy_frac"] == 0.0
+        finally:
+            sched.shutdown()
+
+
+@pytest.mark.slow
+class TestBurninBenchContract:
+    def test_bench_burnin_smoke_contract(self):
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--burnin-smoke"],
+            capture_output=True, text=True, timeout=300, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        contract = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert contract["burnin"] is True
+        assert contract["unit"] == "jobs/min"
+        assert contract["jobs_per_min"]["burnin"] > 0
+        assert contract["jobs_per_min"]["real"] > 0
+        assert contract["preemptions"] >= 1
+        assert "partial" not in contract

@@ -101,31 +101,80 @@ _SOAK_SEEDS = sorted(
     if name.endswith(".jsonl"))
 
 
+def _soak():
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        import soak
+    finally:
+        sys.path.pop(0)
+    return soak
+
+
 @pytest.mark.faults
 class TestSoakSeedCorpus:
     """Rejected-history seed artifacts dumped by the chaos soak harness
-    (tools/soak.py) replay as regressions: each committed corpus entry
-    captured a REAL runtime consistency violation (e.g. the volatile
-    write-once server losing an acknowledged write across a live
-    crash–restart), and the cross-check must keep rejecting it — a
-    tester change that starts accepting one of these histories has
-    broken the semantics, not fixed the bug."""
+    (stateright_tpu/soak.py) replay as regressions: each committed
+    corpus entry captured a REAL runtime consistency violation (e.g.
+    the volatile write-once server losing an acknowledged write across
+    a live crash–restart), and the cross-check must keep rejecting it
+    — a tester change that starts accepting one of these histories has
+    broken the semantics, not fixed the bug. The corpus mixes the
+    legacy seed-named layout with the PR-15 keyed layout
+    (``soak_<protocol>_<kind>_<tester>_<sha256(ops)[:16]>.jsonl`` —
+    auto-filed finds dedup in place); the parametrized replay covers
+    both."""
 
     @pytest.mark.parametrize(
         "path", _SOAK_SEEDS, ids=[os.path.basename(p)
                                   for p in _SOAK_SEEDS])
     def test_seed_artifact_still_rejected(self, path):
-        tools = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "tools")
-        sys.path.insert(0, tools)
-        try:
-            import soak
-        finally:
-            sys.path.pop(0)
-        verdicts = soak.check_artifact(path)
+        verdicts = _soak().check_artifact(path)
         assert verdicts, f"empty artifact {path}"
         assert not any(verdicts.values()), \
             f"{path}: history now ACCEPTED by {verdicts}"
+
+    def test_corpus_contains_keyed_layout_entries(self):
+        soak = _soak()
+        from stateright_tpu.semantics import RecordedHistory
+        keyed = [p for p in _SOAK_SEEDS
+                 if "_linearizability_" in os.path.basename(p)]
+        assert keyed, "no keyed-layout corpus entries committed"
+        for path in keyed:
+            meta, history = RecordedHistory.load(path)
+            # the filename embeds the content digest — the dedup key
+            # a re-found violation maps back onto
+            expected = soak.artifact_filename(
+                meta["protocol"],
+                "durable" if meta.get("durable", True) else "volatile",
+                meta["testers"][0], history.ops_digest())
+            assert os.path.basename(path) == expected
+
+    def test_refound_violation_updates_in_place(self, tmp_path):
+        # filing the SAME history twice lands ONE file (updated), a
+        # different history lands a second — the dedup key is the op
+        # stream, not the run
+        soak = _soak()
+        from stateright_tpu.semantics import (RecordedHistory, Write,
+                                              WriteOk)
+        events = [("inv", "a", Write("x")), ("ret", "a", WriteOk())]
+        h1 = RecordedHistory(events)
+        meta = {"spec": "woregister"}
+        p1 = soak.file_violation(str(tmp_path), "write_once",
+                                 "volatile", "linearizability", h1,
+                                 meta)
+        p2 = soak.file_violation(str(tmp_path), "write_once",
+                                 "volatile", "linearizability", h1,
+                                 meta)
+        assert p1 == p2
+        h2 = RecordedHistory(events + [("inv", "b", Write("y"))])
+        p3 = soak.file_violation(str(tmp_path), "write_once",
+                                 "volatile", "linearizability", h2,
+                                 meta)
+        assert p3 != p1
+        assert len([f for f in os.listdir(str(tmp_path))
+                    if f.endswith(".jsonl")]) == 2
 
 
 @pytest.mark.slow

@@ -1,0 +1,347 @@
+"""Online (incremental) linearizability + the strict history recorder
++ the iterative serialization search (PR 15's semantics tentpole).
+
+Pins, all host-only (no JAX):
+
+* **strict recorder** — a return (or re-invoke) on a retired
+  (abandoned) logical thread id is rejected with a clear error instead
+  of silently corrupting the per-thread bookkeeping; the resend-after-
+  abandon client pattern the soak driver uses (abandon → fresh epoch
+  id) records cleanly and round-trips through the JSONL artifact
+  (including the new ``abd`` retirement events; pre-retirement
+  artifacts still load).
+* **iterative search** — both testers serialize multi-thousand-op
+  histories WITHOUT touching ``sys.setrecursionlimit`` (the old
+  recursive search burned one Python frame per op and needed the
+  limit raised past ~10k ops; burn-in histories get there).
+* **online checker** — verdict parity with the post-hoc
+  ``LinearizabilityTester`` on the committed ``tests/soak_seeds/``
+  corpus plus randomized recorded histories (accepts AND rejects),
+  violation flagged AT the offending op (index pinned strictly before
+  the end of the history), abandoned-op canonicalization keeping the
+  configuration set bounded, and the overflow → ``None`` (unknown)
+  degradation.
+"""
+
+import os
+import sys
+from random import Random
+
+import pytest
+
+from stateright_tpu.semantics import (HistoryRecorder,
+                                      LinearizabilityTester,
+                                      OnlineLinearizabilityChecker,
+                                      Read, ReadOk, RecordedHistory,
+                                      Register,
+                                      SequentialConsistencyTester,
+                                      WORegister, Write, WriteOk,
+                                      replay_online)
+
+_SEEDS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "soak_seeds")
+
+
+def _soak():
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        import soak
+    finally:
+        sys.path.pop(0)
+    return soak
+
+
+# --- the strict recorder ---------------------------------------------------
+
+class TestStrictRecorder:
+    def test_return_on_retired_thread_rejected(self):
+        rec = HistoryRecorder()
+        rec.invoke("c0.0", Write("A"))
+        rec.abandon("c0.0")
+        with pytest.raises(ValueError, match="retired"):
+            rec.ret("c0.0", WriteOk())
+        # ...and re-invoking the retired id is just as dead
+        with pytest.raises(ValueError, match="retired"):
+            rec.invoke("c0.0", Read())
+
+    def test_double_invoke_and_orphan_return_rejected(self):
+        rec = HistoryRecorder()
+        rec.invoke("t", Write("A"))
+        with pytest.raises(ValueError, match="in flight"):
+            rec.invoke("t", Read())
+        with pytest.raises(ValueError, match="without an in-flight"):
+            rec.ret("other", WriteOk())
+        with pytest.raises(ValueError, match="no in-flight"):
+            rec.abandon("other")
+
+    def test_resend_after_abandon_pattern_roundtrips(self):
+        # the soak client pattern: abandon the timed-out op, bump the
+        # epoch, resend under the fresh id — the history keeps the
+        # abandoned op in flight forever and stays well-formed
+        rec = HistoryRecorder()
+        rec.invoke("c0.0", Write("A"))
+        rec.abandon("c0.0")
+        rec.invoke("c0.1", Write("A"))
+        rec.ret("c0.1", WriteOk())
+        rec.invoke("c0.1", Read())
+        rec.ret("c0.1", ReadOk("A"))
+        assert (rec.invoked, rec.returned, rec.abandoned) == (3, 2, 1)
+        history = rec.history()
+        assert [k for k, _t, _p in history.events()] \
+            == ["inv", "abd", "inv", "ret", "inv", "ret"]
+        # JSONL round-trip preserves the abd retirement event and the
+        # content digest
+        meta, loaded = RecordedHistory.from_jsonl(
+            history.to_jsonl({"spec": "woregister"}))
+        assert loaded.events() == history.events()
+        assert loaded.ops_digest() == history.ops_digest()
+        assert loaded.op_count() == 3
+        # the batch tester skips retirements (op stays in flight)
+        assert loaded.check(LinearizabilityTester(WORegister()))
+
+    def test_pre_retirement_artifact_still_loads(self):
+        # an old-format artifact (no "abd" lines, no "v"-less lines)
+        text = ('{"k":"inv","th":"a","v":["W","x"]}\n'
+                '{"k":"ret","th":"a","v":["WOk"]}\n')
+        meta, history = RecordedHistory.from_jsonl(text)
+        assert meta is None and len(history) == 2
+        assert history.check(LinearizabilityTester(Register(None)))
+
+    def test_observer_streams_in_recorded_order(self):
+        checker = OnlineLinearizabilityChecker(WORegister())
+        rec = HistoryRecorder(observer=checker)
+        rec.invoke("w", Write("A"))
+        rec.ret("w", WriteOk())
+        assert checker.verdict() is True
+        rec.invoke("r", Read())
+        rec.ret("r", ReadOk(None))  # reads the unwritten register
+        assert checker.verdict() is False
+        assert checker.violation["op_index"] == 1
+
+
+# --- the iterative search --------------------------------------------------
+
+class TestIterativeSearch:
+    @pytest.fixture(autouse=True)
+    def _no_recursionlimit_games(self, monkeypatch):
+        def bomb(_n):
+            raise AssertionError(
+                "the serialization search must not touch "
+                "sys.setrecursionlimit")
+        monkeypatch.setattr(sys, "setrecursionlimit", bomb)
+
+    def _long_history(self, n_ops: int) -> RecordedHistory:
+        events = []
+        for i in range(n_ops // 2):
+            events.append(("inv", "a", Write(i)))
+            events.append(("ret", "a", WriteOk()))
+            events.append(("inv", "a", Read()))
+            events.append(("ret", "a", ReadOk(i)))
+        return RecordedHistory(events)
+
+    def test_linearizability_12k_ops_no_recursion(self):
+        history = self._long_history(12_000)
+        assert history.check(LinearizabilityTester(Register(0)))
+
+    def test_sequential_consistency_12k_ops_no_recursion(self):
+        history = self._long_history(12_000)
+        assert history.check(SequentialConsistencyTester(Register(0)))
+
+    def test_rejection_verdicts_unchanged(self):
+        # stale read after a completed write: both testers' canonical
+        # reject case survives the iterative rewrite
+        events = [("inv", "w", Write(1)), ("ret", "w", WriteOk()),
+                  ("inv", "r", Read()), ("ret", "r", ReadOk(0))]
+        history = RecordedHistory(events)
+        assert not history.check(LinearizabilityTester(Register(0)))
+        # sequential consistency has no real-time constraint, but a
+        # read of 0 is still serializable (read before the write)
+        assert history.check(SequentialConsistencyTester(Register(0)))
+
+    def test_concurrent_interleavings_still_found(self):
+        # two concurrent writers + a read observing the second value:
+        # the search must find the interleaving (exercises the
+        # iterative backtracking, not just the linear fast path)
+        events = [("inv", "w1", Write(1)), ("inv", "w2", Write(2)),
+                  ("ret", "w2", WriteOk()), ("ret", "w1", WriteOk()),
+                  ("inv", "r", Read()), ("ret", "r", ReadOk(1))]
+        history = RecordedHistory(events)
+        assert history.check(LinearizabilityTester(Register(0)))
+
+
+# --- the online checker ----------------------------------------------------
+
+class TestOnlineChecker:
+    def test_accepts_concurrent_overlap_both_orders(self):
+        for seen in (0, 1):
+            ck = OnlineLinearizabilityChecker(Register(0))
+            ck.on_invoke("w", Write(1))
+            ck.on_invoke("r", Read())
+            ck.on_return("r", ReadOk(seen))
+            ck.on_return("w", WriteOk())
+            assert ck.verdict() is True, seen
+
+    def test_violation_pinned_at_offending_op(self):
+        ck = OnlineLinearizabilityChecker(Register(0))
+        ck.on_invoke("w", Write(1))
+        ck.on_return("w", WriteOk())
+        ck.on_invoke("r", Read())
+        ck.on_return("r", ReadOk(0))  # stale: flagged HERE
+        assert ck.verdict() is False
+        assert ck.violation["op_index"] == 1
+        assert ck.violation["thread_id"] == "r"
+        # later (even valid) events never un-flag it
+        ck.on_invoke("r2", Read())
+        ck.on_return("r2", ReadOk(1))
+        assert ck.verdict() is False
+        assert ck.violation["op_index"] == 1
+
+    def test_abandoned_op_may_or_may_not_take_effect(self):
+        ck = OnlineLinearizabilityChecker(Register(0))
+        ck.on_invoke("w", Write(7))
+        ck.abandon("w")
+        ck.on_invoke("r", Read())
+        ck.on_return("r", ReadOk(7))  # the abandoned write took effect
+        assert ck.verdict() is True
+        ck.on_invoke("r2", Read())
+        ck.on_return("r2", ReadOk(0))  # ...and cannot un-take it
+        assert ck.verdict() is False
+
+    def test_abandon_canonicalization_bounds_configs(self):
+        # hundreds of interchangeable abandoned writes collapse onto
+        # the applied-multiset canonical form — without it this would
+        # be 2^300 configurations
+        ck = OnlineLinearizabilityChecker(Register(0))
+        for i in range(300):
+            ck.on_invoke(f"t{i}", Write("X"))
+            ck.abandon(f"t{i}")
+        ck.on_invoke("r", Read())
+        ck.on_return("r", ReadOk(0))
+        assert ck.verdict() is True
+        assert ck.config_count < 10
+
+    def test_overflow_degrades_to_unknown(self):
+        ck = OnlineLinearizabilityChecker(Register(0), max_configs=2)
+        for i in range(6):  # distinct concurrent writes: real blowup
+            ck.on_invoke(f"w{i}", Write(i))
+        ck.on_invoke("r", Read())
+        ck.on_return("r", ReadOk(3))
+        assert ck.overflowed
+        assert ck.verdict() is None  # unknown -> post-hoc fallback
+
+    def test_malformed_stream_matches_tester_contract(self):
+        ck = OnlineLinearizabilityChecker(Register(0))
+        ck.on_invoke("t", Write(1))
+        with pytest.raises(ValueError, match="in flight"):
+            ck.on_invoke("t", Read())
+        with pytest.raises(ValueError, match="invalid"):
+            ck.on_return("t", WriteOk())
+
+
+def random_history(seed: int, steps: int = 60,
+                   corrupt: bool = False) -> RecordedHistory:
+    """A randomized concurrent register history: ops linearized at
+    return against a ground-truth register (always linearizable),
+    abandons that may or may not take effect, and — with ``corrupt`` —
+    occasional reads returning a wrong value (usually, not always,
+    non-linearizable). The generator emits well-formed streams only."""
+    rng = Random(seed * 0x9E3779B1 + 17)
+    value = 0
+    past = [0]  # every value the register ever held
+    pending = {}  # thread -> op
+    events = []
+    epoch = {}
+    threads = [f"c{i}" for i in range(4)]
+    for _step in range(steps):
+        tid = rng.choice(threads)
+        thread = f"{tid}.{epoch.get(tid, 0)}"
+        if thread not in pending:
+            op = Write(rng.randrange(1, 5)) if rng.random() < 0.45 \
+                else Read()
+            pending[thread] = op
+            events.append(("inv", thread, op))
+            continue
+        op = pending.pop(thread)
+        if rng.random() < 0.15:  # abandon: effect is a coin flip
+            events.append(("abd", thread, None))
+            epoch[tid] = epoch.get(tid, 0) + 1
+            if isinstance(op, Write) and rng.random() < 0.5:
+                value = op.value
+            continue
+        if isinstance(op, Write):
+            value = op.value
+            past.append(value)
+            events.append(("ret", thread, WriteOk()))
+        else:
+            seen = value
+            if corrupt and rng.random() < 0.3:
+                # a STALE (previously held) value: often a real-time
+                # violation, but sometimes saved by a concurrent or
+                # abandoned write — both verdicts occur across seeds
+                seen = rng.choice(past)
+            events.append(("ret", thread, ReadOk(seen)))
+    return RecordedHistory(events)
+
+
+class TestOnlineParity:
+    """ACCEPTANCE: the incremental checker's accept/reject verdicts are
+    identical to the post-hoc tester on the committed soak corpus plus
+    randomized recorded histories — and on the volatile write-once
+    seed it flags the violation BEFORE the full history is consumed."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_parity_on_clean_random_histories(self, seed):
+        history = random_history(seed, corrupt=False)
+        posthoc = history.check(LinearizabilityTester(Register(0)))
+        online = replay_online(history, Register(0))
+        assert online is not None and online.verdict() is not None
+        assert online.verdict() == posthoc
+        assert posthoc  # linearized-at-return is always accepted
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_parity_on_corrupted_random_histories(self, seed):
+        history = random_history(seed + 500, corrupt=True)
+        posthoc = history.check(LinearizabilityTester(Register(0)))
+        online = replay_online(history, Register(0))
+        assert online is not None and online.verdict() is not None
+        assert online.verdict() == posthoc
+
+    def test_corrupted_seeds_cover_both_verdicts(self):
+        verdicts = {random_history(s + 500, corrupt=True).check(
+            LinearizabilityTester(Register(0))) for s in range(12)}
+        assert verdicts == {True, False}, \
+            "the corrupted generator must exercise accepts AND rejects"
+
+    def test_parity_on_committed_corpus(self):
+        soak = _soak()
+        paths = sorted(p for p in os.listdir(_SEEDS_DIR)
+                       if p.endswith(".jsonl"))
+        assert paths, "committed soak corpus is empty"
+        for name in paths:
+            meta, history = RecordedHistory.load(
+                os.path.join(_SEEDS_DIR, name))
+            spec = soak.spec_for(meta or {})
+            posthoc = history.check(soak.tester_for(
+                "linearizability", spec))
+            online = replay_online(history, spec)
+            assert online is not None
+            assert online.verdict() is not None, name
+            assert online.verdict() == posthoc, name
+            assert posthoc is False  # the corpus is rejections only
+
+    def test_corpus_violations_flagged_before_history_end(self):
+        # the ONLINE property the post-hoc tester cannot give you: the
+        # violation lands at the offending op, strictly before the
+        # last operation of the history
+        for name in sorted(os.listdir(_SEEDS_DIR)):
+            if not name.endswith(".jsonl"):
+                continue
+            soak = _soak()
+            meta, history = RecordedHistory.load(
+                os.path.join(_SEEDS_DIR, name))
+            online = replay_online(history, soak.spec_for(meta or {}))
+            assert online.violation is not None, name
+            assert online.violation["op_index"] \
+                < history.op_count(), name

@@ -372,12 +372,26 @@ class TestSoakSmoke:
 
     def test_volatile_twin_is_caught_and_dumps_artifact(self, tmp_path):
         soak = _soak()
+        trace = []
         res = soak.run_soak(soak.volatile_demo_config(
-            artifact_dir=str(tmp_path)))
+            artifact_dir=str(tmp_path), trace=trace))
         assert res["history_ok"] is False
         assert res["crashes"] == 1
+        # the ONLINE checker flagged the violation mid-stream: the
+        # offending op index is pinned strictly before the end of the
+        # history (acceptance: online, not post-hoc)
+        assert res["violation_op"] is not None
+        assert res["violation_op"] < res["ops"]
+        viol = [e for e in trace if e["ev"] == "violation"]
+        assert viol and viol[0]["tester"] == "linearizability"
+        assert viol[0]["op_index"] == res["violation_op"]
         path = res["artifact"]
         assert path is not None and os.path.exists(path)
+        # keyed corpus layout: (protocol, tester, sha256(ops)) in the
+        # filename, so a re-found identical history updates in place
+        base = os.path.basename(path)
+        assert base.startswith(
+            "soak_write_once_volatile_linearizability_")
         # the artifact replays to the same rejection (the regression
         # contract test_fuzz_differential.py runs over the corpus)
         assert soak.check_artifact(path) == {"linearizability": False}

@@ -169,6 +169,10 @@ def parse_round(path: str) -> Dict[str, Any]:
                 # multi-process fleet mesh spanning DCN — not
                 # comparable to single-host device rates
                 ("multihost", bool(contract.get("hosts"))),
+                # a --burnin-smoke round: the value is burn-in lane
+                # jobs/min with a real checking job preempting through
+                # — a fleet-behavior number, not an engine rate
+                ("burnin", bool(contract.get("burnin"))),
             ) if on)
         rnd["workloads"][CONTRACT] = {
             "name": contract.get("metric", "contract"),

@@ -115,6 +115,25 @@ class Board:
                 row += f" host={','.join(map(str, hosts))}"
             if j.get("batch"):
                 row += f" batch={j['batch']}/l{j.get('lane')}"
+            if j.get("kind") in ("soak", "fuzz"):
+                # soak/fuzz lane rows: kind + burn-in tag, ops instead
+                # of unique states, and the cross-check verdict
+                row += f" {j['kind']}"
+                if j.get("burnin"):
+                    row += "(burnin)"
+                ops = (j.get("result") or {}).get(
+                    "completed", j.get("ops_completed"))
+                if ops is not None:
+                    row += f"  ops={int(ops):,}"
+                    prev = self._prev_uniq.get(jid)
+                    if prev is not None and dt and dt > 0:
+                        row += (f"  +{watch.Console._rate((int(ops) - prev) / dt)}"
+                                "/s")
+                    self._prev_uniq[jid] = int(ops)
+                if j.get("history_ok") is False:
+                    row += "  VIOLATION"
+                lines.append(row)
+                continue
             uniq = (j.get("result") or {}).get("unique_state_count",
                                                j.get("unique"))
             if uniq is not None:
@@ -125,6 +144,17 @@ class Board:
                     row += f"  +{watch.Console._rate(rate)}/s"
                 self._prev_uniq[jid] = int(uniq)
             lines.append(row)
+        # burn-in lane summary: the background soak/fuzz load must be
+        # visible, not invisible (README § Continuous verification)
+        burn = util.get("burnin_frac", prof.get("burnin_frac"))
+        if burn or prof.get("soak_jobs") or prof.get("violations"):
+            parts = []
+            if burn is not None:
+                parts.append(f"{float(burn):.0%} of pool")
+            for key in ("soak_jobs", "fuzz_ops", "violations"):
+                if prof.get(key):
+                    parts.append(f"{key}={int(prof[key])}")
+            lines.append("burnin: " + "  ".join(parts))
         # SLO aggregates (cumulative seconds / completions)
         done = by_state.get("done", 0) or int(prof.get("jobs_done",
                                                        0) or 0)
@@ -185,7 +215,8 @@ def load_offline(root: str) -> Dict[str, Any]:
             if kind == "pool_util":
                 util = {"busy_frac": ev.get("busy_frac"),
                         "per_host": ev.get("per_host") or {},
-                        "queue_depth": ev.get("queue_depth", 0)}
+                        "queue_depth": ev.get("queue_depth", 0),
+                        "burnin_frac": ev.get("burnin_frac")}
                 samples.append({"busy_frac": ev.get("busy_frac", 0.0)})
             elif kind == "job_pause" \
                     and ev.get("reason") == "preempt":
@@ -194,6 +225,11 @@ def load_offline(root: str) -> Dict[str, Any]:
         profile["jobs_submitted"] = counts.get("job_submit", 0)
         profile["jobs_done"] = sum(
             1 for j in jobs if j.get("state") == "done")
+        profile["soak_jobs"] = sum(
+            1 for j in jobs if j.get("state") == "done"
+            and j.get("kind") in ("soak", "fuzz"))
+        profile["violations"] = sum(
+            1 for j in jobs if j.get("history_ok") is False)
         wait = [((j.get("result") or {}).get("lifecycle") or {})
                 .get("queue_wait_s") for j in jobs]
         wait = [w for w in wait if w is not None]

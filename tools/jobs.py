@@ -12,11 +12,16 @@ Server:
 Client (all take --url http://host:port):
     python tools/jobs.py submit --url U --model NAME [--args 3,2]
         [--width W] [--priority P] [--target N] [--options '{"k":v}']
-        [--step-delay S] [--batch]            -> prints the job id
+        [--step-delay S] [--batch] [--kind soak|fuzz]
+        [--kwargs '{"k":v}']                  -> prints the job id
         ``--batch`` opts the job into the batch lane engine
         (JobSpec batch='auto'): same-bucket small jobs coalesce into
         one vmapped chunk program; ``list`` shows the batch/lane a
-        batched job ran on
+        batched job ran on. ``--kind soak|fuzz`` runs a chaos
+        soak/fuzz job instead of a checking job: --model names a
+        SOAK_REGISTRY config (write_once, abd, write_once_volatile)
+        and ``--kwargs`` carries SoakConfig overrides
+        (README § Continuous verification)
     python tools/jobs.py list --url U
     python tools/jobs.py watch --url U JOB [--timeout S]
         polls until the job is terminal or paused; prints transitions
@@ -134,6 +139,15 @@ def cmd_submit(argv) -> int:
         payload["target"] = int(target)
     if "--batch" in argv:
         payload["batch"] = "auto"
+    kind = _arg(argv, "--kind")
+    if kind:
+        # soak|fuzz: --model names a SOAK_REGISTRY config and --kwargs
+        # carries SoakConfig overrides (README § Continuous
+        # verification)
+        payload["kind"] = kind
+    kwargs = _arg(argv, "--kwargs")
+    if kwargs:
+        payload["kwargs"] = json.loads(kwargs)
     out = _post(url.rstrip("/") + "/jobs", payload)
     print(out["id"])
     return 0
@@ -145,10 +159,13 @@ def cmd_list(argv) -> int:
     for job in out["jobs"]:
         lane = (f" batch={job['batch']}/lane{job['lane']}"
                 if "batch" in job and "lane" in job else "")
+        kind = f" kind={job['kind']}" if job.get("kind") else ""
+        if job.get("burnin"):
+            kind += " burnin"
         print(f"{job['id']:28} {job['state']:10} "
               f"prio={job.get('priority', 0)} "
               f"width={job.get('granted_width', job.get('width'))} "
-              f"model={job.get('model')}{lane}")
+              f"model={job.get('model')}{kind}{lane}")
     prof = out.get("profile") or {}
     if prof:
         print("# " + " ".join(f"{k}={v}" for k, v in sorted(

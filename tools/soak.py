@@ -1,722 +1,39 @@
-"""Chaos soak driver: run a checked protocol on the REAL actor runtime
-under live fault injection, then cross-check the recorded history with
-the checker's own consistency semantics.
+"""Chaos soak CLI — thin shim over :mod:`stateright_tpu.soak`.
+
+The driver moved INTO the package in PR 15 so the job service can run
+soak/fuzz configurations as first-class scheduled jobs
+(``service/scheduler.py`` ``kind: soak|fuzz`` specs over
+``SOAK_REGISTRY``); this file keeps the historical CLI entry point and
+re-exports the full driver surface for existing consumers
+(``tests/test_soak.py``, ``tests/test_fuzz_differential.py``,
+``bench.py --soak-smoke``).
 
 Usage:
     python tools/soak.py [--protocol write_once|abd] [--ops N]
                          [--clients N] [--seed N] [--volatile]
                          [--loss P] [--duplicate P] [--delay P]
                          [--crashes N] [--partitions N] [--trace PATH]
-                         [--artifact-dir DIR]
+                         [--artifact-dir DIR] [--posthoc]
 
-The harness closes ROADMAP item 5's loop between "model checked" and
-"serves real traffic": the SAME ``Actor`` implementations the checker
-verifies are spawned over localhost UDP (`actor/runtime.py`), driven by
-concurrent client threads through thousands of operations while a
-seeded fault schedule fires live — datagram loss, duplication,
-delay/reorder and partitions via
-:class:`~stateright_tpu.actor.chaos.ChaosNetwork`, plus crash–restart
-of individual actors via ``SpawnHandle.crash``/``restart`` (the runtime
-twin of ``ActorModel.crash_restart``). Every client operation is
-recorded invoke/return through a thread-safe
-:class:`~stateright_tpu.semantics.HistoryRecorder` and replayed through
-``LinearizabilityTester`` / ``SequentialConsistencyTester`` — exactly
-the testers the checker evaluates inside ``Property`` conditions.
-
-A rejected history is a real consistency violation: it is dumped as a
-reproducible seed artifact (config + seed + the invoke/return JSONL)
-under ``--artifact-dir`` and replayed as a parametrized regression by
-``tests/test_fuzz_differential.py`` (the ``soak_seeds/`` corpus). The
-deliberately buggy twin — ``--volatile``, the write-once server whose
-register value lives in volatile memory — is CAUGHT by the cross-check
-under crash–restart, the live analog of ``write_once_packed.py``'s
-"volatile caught" model-checking demonstration.
-
-Protocols:
-
-* ``write_once`` — one unreplicated write-once register server
-  (durable by default: the value survives a crash) + put/get clients;
-  spec ``WORegister()``.
-* ``abd`` — 3 ABD replicas (`examples/linearizable_register.py`), each
-  persisting ``(seq, val)`` across crashes (phase state is volatile —
-  an in-flight coordination is abandoned, the client times out and the
-  op stays in-flight in the history); spec ``Register('\\0')``.
-
-Obs: the run emits ``RunTrace`` events (``run_start``,
-``fault_injection``, periodic ``ops`` summaries, ``crash``/``restart``,
-``partition``, ``soak_done``) and ``Metrics`` keys (``ops``,
-``op_timeouts``, ``crashes``, ``restarts``, ``dropped``,
-``duplicated``, ``delayed``, ``reordered``, ``partitions``,
-``history_ok``) rendered by ``tools/trace_report.py`` — a soak
-postmortem reads like a checker postmortem.
+See ``stateright_tpu/soak.py`` for the full documentation (online
+linearizability checking, the seed-corpus dedup key, the soak-config
+registry, obs integration).
 """
 
 from __future__ import annotations
 
-import json
 import os
-import pickle
-import socket as socket_mod
 import sys
-import threading
-import time
-from dataclasses import dataclass, field
-from random import Random
-from typing import Any, List, Optional, Sequence, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from stateright_tpu.actor import Id, spawn  # noqa: E402
-from stateright_tpu.actor.chaos import ChaosNetwork  # noqa: E402
-from stateright_tpu.actor.core import Actor, Out  # noqa: E402
-from stateright_tpu.actor.register import (  # noqa: E402
-    Get as RGet, GetOk as RGetOk, Put as RPut, PutOk as RPutOk)
-from stateright_tpu.actor.write_once_register import (  # noqa: E402
-    Get as WGet, GetOk as WGetOk, Put as WPut, PutFail as WPutFail,
-    PutOk as WPutOk)
-from stateright_tpu.examples.linearizable_register import (  # noqa: E402
-    AbdActor, AbdState)
-from stateright_tpu.obs import Metrics, make_trace  # noqa: E402
-from stateright_tpu.semantics import (  # noqa: E402
-    HistoryRecorder, LinearizabilityTester, Read, ReadOk, Register,
-    SequentialConsistencyTester, WORegister, Write, WriteFail, WriteOk)
-
-_LOOP = (127, 0, 0, 1)
-
-
-# --- the runnable server twins ----------------------------------------------
-
-class VolatileWOServer(Actor):
-    """Unreplicated write-once register keeping its value in volatile
-    memory only — the deliberately buggy twin (the live analog of
-    ``write_once_packed.py``'s volatile variant): a crash silently
-    loses an acknowledged write, which the history cross-check must
-    catch. ``None`` = unwritten."""
-
-    def on_start(self, id: Id, o: Out):
-        return None
-
-    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
-        if isinstance(msg, WPut):
-            if state is None or state == msg.value:
-                o.send(src, WPutOk(msg.request_id))
-                return msg.value if state is None else None
-            o.send(src, WPutFail(msg.request_id))
-            return None
-        if isinstance(msg, WGet):
-            o.send(src, WGetOk(msg.request_id, state))
-            return None
-        return None
-
-
-class DurableWOServer(VolatileWOServer):
-    """The fixed twin: the register value is on stable storage, so the
-    ``durable()`` projection captured at crash time survives the
-    restart."""
-
-    def durable(self, id: Id, state):
-        return state
-
-    def on_restart(self, id: Id, durable, o: Out):
-        return durable
-
-
-class DurableAbdActor(AbdActor):
-    """ABD replica persisting ``(seq, val)`` across crashes; in-flight
-    coordination phase state is volatile (the realistic model: the
-    register is fsync'd, an interrupted quorum round is abandoned and
-    the client times out).
-
-    Two additions over the model-checked actor (whose pinned oracle
-    counts must not change), both required the moment the transport is
-    at-least-once instead of the model's pristine queues:
-
-    * **stale-coordination abort** — a ``Put``/``Get`` carrying a NEW
-      request id aborts a wedged in-flight phase. The checker's bounded
-      networks never wedge a coordinator, but under real loss a quorum
-      round whose acks all vanish leaves ``phase`` busy forever, and
-      ``AbdActor`` ignores every later request. Aborting is safe: the
-      abandoned op stays in-flight, and a partially recorded write may
-      take effect (ABD read-repair keeps it monotone) — linearizability
-      permits both.
-    * **durable request dedup** — a (requester, request id) → reply log
-      short-circuits re-delivered requests (chaos duplication, client
-      resends) with the cached reply instead of re-executing. Without
-      it a duplicated ``Put('A')`` re-executed after ``'B'`` won bumps
-      the sequence number and RESURRECTS the old value — a real
-      at-most-once violation the soak cross-check catches (the
-      reference only model-checks ABD over non-duplicating networks).
-      The log rides stable storage with ``(seq, val)``: it survives
-      restarts (a crash between reply and resend must not re-execute).
-    """
-
-    _DEDUP_CAP = 4096  # recent replies kept per replica (FIFO trim)
-
-    def __init__(self, peers):
-        super().__init__(peers)
-        self._done = {}  # (requester id, request id) -> cached reply
-
-    def on_msg(self, id: Id, state, src: Id, msg, o: Out):
-        if isinstance(msg, (RPut, RGet)):
-            cached = self._done.get((int(src), msg.request_id))
-            if cached is not None:
-                o.send(src, cached)
-                return None
-            if isinstance(state, AbdState) and state.phase is not None \
-                    and msg.request_id != state.phase.request_id:
-                state = AbdState(seq=state.seq, val=state.val,
-                                 phase=None)
-        before = len(o)
-        # a Put/Get with an (aborted or idle) phase always yields a new
-        # Phase1 state from the base actor, so the local abort above is
-        # never lost through a None ("unchanged") return
-        next_state = super().on_msg(id, state, src, msg, o)
-        for cmd in o[before:]:
-            reply = getattr(cmd, "msg", None)
-            if isinstance(reply, (RPutOk, RGetOk)):
-                self._done[(int(cmd.dst), reply.request_id)] = reply
-                while len(self._done) > self._DEDUP_CAP:
-                    self._done.pop(next(iter(self._done)))
-        return next_state
-
-    def durable(self, id: Id, state):
-        if isinstance(state, AbdState):
-            return (state.seq, state.val)
-        return None
-
-    def on_restart(self, id: Id, durable, o: Out):
-        if durable is None:
-            return self.on_start(id, o)
-        seq, val = durable
-        return AbdState(seq=tuple(seq), val=val, phase=None)
-
-
-# --- configuration ----------------------------------------------------------
-
-@dataclass
-class SoakConfig:
-    protocol: str = "write_once"     # write_once | abd
-    ops: int = 2000                  # invoked client-op budget
-    clients: int = 4
-    seed: int = 0
-    durable: bool = True             # False = the buggy volatile twin
-    loss: float = 0.02
-    duplicate: float = 0.02
-    delay: float = 0.1
-    delay_range: Tuple[float, float] = (0.0005, 0.005)
-    crashes: int = 2                 # crash–restart episodes
-    crash_down: float = 0.05         # seconds the actor stays down
-    partitions: int = 1              # partition episodes
-    partition_span: float = 0.15     # seconds a partition holds
-    op_timeout: float = 0.25         # client wait before abandoning
-    put_ratio: float = 0.3           # P(put) per op (first op: put)
-    testers: Tuple[str, ...] = ("linearizability",)
-    artifact_dir: str = "soak_seeds"
-    trace: Any = None                # tpu_options(trace=...)-style sink
-    deadline: float = 120.0          # hard wall for the whole run
-
-    def meta(self) -> dict:
-        d = {k: getattr(self, k) for k in (
-            "protocol", "ops", "clients", "seed", "durable", "loss",
-            "duplicate", "delay", "crashes", "crash_down", "partitions",
-            "partition_span", "op_timeout", "put_ratio")}
-        d["delay_range"] = list(self.delay_range)
-        d["testers"] = list(self.testers)
-        return d
-
-
-def volatile_demo_config(seed: int = 11, ops: int = 120,
-                         artifact_dir: str = "soak_seeds",
-                         trace: Any = None) -> SoakConfig:
-    """The "volatile caught" twin run, live: a write-once server whose
-    value is NOT durable, one crash–restart mid-run, and ``put_ratio=0``
-    so every op after each client's opening put is a read — the crash
-    deterministically loses an acknowledged write and every post-restart
-    read observes the unwritten register, which the linearizability
-    cross-check must reject (same values mid-soak could otherwise
-    re-win the second epoch and mask the bug)."""
-    return SoakConfig(
-        protocol="write_once", ops=ops, clients=3, seed=seed,
-        durable=False, loss=0.0, duplicate=0.0, delay=0.0, crashes=1,
-        partitions=0, op_timeout=0.3, put_ratio=0.0,
-        artifact_dir=artifact_dir, trace=trace, deadline=30.0)
-
-
-# --- protocol plumbing ------------------------------------------------------
-
-class _WriteOnceProto:
-    name = "write_once"
-    spec_name = "woregister"
-
-    def __init__(self, cfg: SoakConfig, ports: List[int]):
-        self.cfg = cfg
-        self.server_ids = [Id.from_socket_addr(_LOOP, ports[0])]
-        self.crash_target = self.server_ids[0]
-
-    def actors(self):
-        server = DurableWOServer() if self.cfg.durable \
-            else VolatileWOServer()
-        return [(self.server_ids[0], server)]
-
-    def spec(self):
-        return WORegister()
-
-    def pick_server(self, cix: int, rng: Random) -> Id:
-        return self.server_ids[0]
-
-    def put(self, rid: int, value):
-        return WPut(rid, value)
-
-    def get(self, rid: int):
-        return WGet(rid)
-
-    def map_ret(self, msg) -> Optional[Any]:
-        if isinstance(msg, WPutOk):
-            return WriteOk()
-        if isinstance(msg, WPutFail):
-            return WriteFail()
-        if isinstance(msg, WGetOk):
-            return ReadOk(msg.value)
-        return None
-
-    def partition_groups(self, client_ids: Sequence[int]):
-        """Cut half the clients off from the server for the span (their
-        ops time out; the rest keep serving)."""
-        clients = sorted(client_ids)
-        keep = clients[0::2]
-        cut = clients[1::2]
-        if not cut:
-            return None
-        return [[int(self.server_ids[0])] + keep, cut]
-
-
-class _AbdProto:
-    name = "abd"
-    spec_name = "register"
-
-    def __init__(self, cfg: SoakConfig, ports: List[int]):
-        self.cfg = cfg
-        self.server_ids = [Id.from_socket_addr(_LOOP, p)
-                           for p in ports[:3]]
-        # crash only ONE designated replica (possibly repeatedly): with
-        # durable (seq, val) any quorum stays correct; ABD tolerates a
-        # minority down
-        self.crash_target = self.server_ids[-1]
-
-    def actors(self):
-        cls = DurableAbdActor if self.cfg.durable else AbdActor
-        return [(sid, cls([p for p in self.server_ids if p != sid]))
-                for sid in self.server_ids]
-
-    def spec(self):
-        return Register('\0')
-
-    def pick_server(self, cix: int, rng: Random) -> Id:
-        # sticky routing: each client keeps one coordinator (the ABD
-        # coordinator serializes one request at a time, so spreading
-        # clients over replicas avoids busy-drops)
-        return self.server_ids[cix % len(self.server_ids)]
-
-    def put(self, rid: int, value):
-        return RPut(rid, value)
-
-    def get(self, rid: int):
-        return RGet(rid)
-
-    def map_ret(self, msg) -> Optional[Any]:
-        if isinstance(msg, RPutOk):
-            return WriteOk()
-        if isinstance(msg, RGetOk):
-            return ReadOk(msg.value)
-        return None
-
-    def partition_groups(self, client_ids: Sequence[int]):
-        """Isolate the middle replica from its peers (clients still
-        reach it, so its coordinations stall into client timeouts; the
-        other two keep quorum)."""
-        ids = [int(s) for s in self.server_ids]
-        return [[ids[0]] + ids[2:], [ids[1]]]
-
-
-_PROTOCOLS = {"write_once": _WriteOnceProto, "abd": _AbdProto}
-
-
-def spec_for(meta: dict):
-    """Rebuild the sequential spec named by an artifact's meta header."""
-    name = meta.get("spec", "woregister")
-    if name == "woregister":
-        return WORegister()
-    if name == "register":
-        return Register('\0')
-    raise ValueError(f"unknown spec {name!r} in artifact meta")
-
-
-def tester_for(name: str, spec):
-    if name == "linearizability":
-        return LinearizabilityTester(spec)
-    if name == "sequential":
-        return SequentialConsistencyTester(spec)
-    raise ValueError(f"unknown tester {name!r}")
-
-
-def check_artifact(path) -> dict:
-    """Replay a dumped seed artifact through the testers named in its
-    meta header; returns {tester: ok} (the regression harness asserts
-    every value stays False)."""
-    from stateright_tpu.semantics import RecordedHistory
-
-    meta, history = RecordedHistory.load(path)
-    meta = meta or {}
-    out = {}
-    for name in meta.get("testers", ["linearizability"]):
-        out[name] = history.check(tester_for(name, spec_for(meta)))
-    return out
-
-
-# --- the driver -------------------------------------------------------------
-
-def _free_udp_ports(n: int) -> List[int]:
-    """``n`` free UDP ports (bound-then-released probe; the tiny race
-    is acceptable for a localhost soak)."""
-    socks, ports = [], []
-    try:
-        for _ in range(n):
-            s = socket_mod.socket(socket_mod.AF_INET,
-                                  socket_mod.SOCK_DGRAM)
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-    finally:
-        for s in socks:
-            s.close()
-    return ports
-
-
-@dataclass
-class _Shared:
-    """State shared between client threads and the fault scheduler.
-
-    ``gate`` paces the op stream against the fault schedule: clients
-    may only claim ops below it, so each fault fires at a *settled*
-    op-count boundary (every pre-gate op returned or abandoned) instead
-    of racing a fast loopback stream that can exhaust the whole budget
-    before the scheduler's first poll — fault placement is deterministic
-    relative to the op sequence, which is what makes the soak verdicts
-    pinnable as tests."""
-    lock: threading.Lock = field(default_factory=threading.Lock)
-    issued: int = 0
-    gate: int = 0
-    stop: threading.Event = field(default_factory=threading.Event)
-    client_ids: List[int] = field(default_factory=list)
-
-
-def _claim_op(shared: _Shared, budget: int) -> str:
-    """Claim the next op slot: ``"go"`` (claimed), ``"wait"`` (paused
-    at a fault gate), or ``"done"`` (budget exhausted)."""
-    with shared.lock:
-        if shared.issued >= budget:
-            return "done"
-        if shared.issued >= shared.gate:
-            return "wait"
-        shared.issued += 1
-        return "go"
-
-
-def _client_loop(cix: int, cfg: SoakConfig, proto, chaos: ChaosNetwork,
-                 recorder: HistoryRecorder, shared: _Shared) -> None:
-    rng = Random(((cfg.seed * 0x9E3779B1) ^ (0xC11E47 + cix))
-                 & 0xFFFFFFFFFFFF)
-    raw = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
-    try:
-        raw.bind(("127.0.0.1", 0))
-        cid = Id.from_socket_addr(_LOOP, raw.getsockname()[1])
-        with shared.lock:
-            shared.client_ids.append(int(cid))
-        sock = chaos.wrap(cid, raw)
-        value = chr(ord('A') + cix)  # per-client value: attributable
-        epoch = 0
-        opnum = 0
-        first = True
-        while not shared.stop.is_set():
-            verdict = _claim_op(shared, cfg.ops)
-            if verdict == "done":
-                break
-            if verdict == "wait":
-                time.sleep(0.002)
-                continue
-            opnum += 1
-            rid = cix * 1_000_000 + opnum
-            do_put = first or rng.random() < cfg.put_ratio
-            first = False
-            sid = proto.pick_server(cix, rng)
-            dst_ip, dst_port = sid.socket_addr()
-            addr = (".".join(map(str, dst_ip)), dst_port)
-            if do_put:
-                op, wire = Write(value), proto.put(rid, value)
-            else:
-                op, wire = Read(), proto.get(rid)
-            thread = f"c{cix}.{epoch}"
-            payload = pickle.dumps(wire)
-            recorder.invoke(thread, op)
-            deadline = time.monotonic() + cfg.op_timeout
-            resend_at = time.monotonic() + cfg.op_timeout / 2
-            try:
-                sock.sendto(payload, addr)
-            except OSError:
-                pass
-            got = None
-            while got is None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                if time.monotonic() >= resend_at:
-                    # one mid-timeout resend rides out a lost request
-                    # (same rid: still the one in-flight operation)
-                    resend_at = deadline + 1.0
-                    try:
-                        sock.sendto(payload, addr)
-                    except OSError:
-                        pass
-                raw.settimeout(min(remaining, cfg.op_timeout / 2))
-                try:
-                    data, _src = raw.recvfrom(65535)
-                except (socket_mod.timeout, OSError):
-                    continue
-                try:
-                    msg = pickle.loads(data)
-                except Exception:
-                    continue
-                if getattr(msg, "request_id", None) != rid:
-                    continue  # stale reply for an abandoned/old op
-                got = proto.map_ret(msg)
-            if got is None:
-                # abandon: the op stays in-flight in the history;
-                # retire this logical thread id
-                recorder.abandon(thread)
-                epoch += 1
-            else:
-                recorder.ret(thread, got)
-    finally:
-        raw.close()
-
-
-def _fault_schedule(cfg: SoakConfig) -> List[Tuple[int, str]]:
-    """(invoked-op threshold, kind) pairs, evenly interleaved: crashes
-    at k/(crashes+1) of the budget, partitions offset between them."""
-    events: List[Tuple[int, str]] = []
-    for k in range(cfg.crashes):
-        events.append((cfg.ops * (k + 1) // (cfg.crashes + 1), "crash"))
-    for k in range(cfg.partitions):
-        events.append(
-            (cfg.ops * (2 * k + 1) // (2 * cfg.partitions + 1),
-             "partition"))
-    return sorted(events)
-
-
-def _scheduler_loop(cfg: SoakConfig, proto, handle,
-                    chaos: ChaosNetwork, recorder: HistoryRecorder,
-                    metrics: Metrics, trace, shared: _Shared) -> None:
-    schedule = _fault_schedule(cfg)
-    for i, (threshold, kind) in enumerate(schedule):
-        next_gate = schedule[i + 1][0] if i + 1 < len(schedule) \
-            else cfg.ops
-        # wait for the stream to reach the gate and settle (every
-        # claimed op returned or abandoned); bounded so a wedged
-        # client can't hang the schedule
-        settle_by = time.monotonic() + 2 * cfg.op_timeout + 5.0
-        while not shared.stop.is_set() \
-                and time.monotonic() < settle_by:
-            with shared.lock:
-                issued = shared.issued
-            if issued >= threshold \
-                    and recorder.returned + recorder.abandoned \
-                    >= issued:
-                break
-            time.sleep(0.005)
-        if shared.stop.is_set():
-            return
-        if kind == "crash":
-            sid = proto.crash_target
-            if trace:
-                trace.emit("crash", actor=int(sid))
-            handle.crash(sid)
-            metrics.inc("crashes")
-            # release the gate while the actor is down so ops are
-            # attempted against the hole (timeout path), then reboot
-            with shared.lock:
-                shared.gate = next_gate
-            time.sleep(cfg.crash_down)
-            handle.restart(sid)
-            metrics.inc("restarts")
-            if trace:
-                trace.emit("restart", actor=int(sid))
-        else:
-            with shared.lock:
-                client_ids = list(shared.client_ids)
-                shared.gate = next_gate
-            groups = proto.partition_groups(client_ids)
-            if groups is None:
-                continue
-            chaos.set_partition(groups)
-            time.sleep(cfg.partition_span)
-            chaos.heal()
-    with shared.lock:
-        shared.gate = cfg.ops
-
-
-def run_soak(cfg: SoakConfig) -> dict:
-    """Run one seeded soak; returns the result/metrics dict (see the
-    module docstring). A rejected history additionally lands a seed
-    artifact and its path under ``"artifact"``."""
-    proto_cls = _PROTOCOLS.get(cfg.protocol)
-    if proto_cls is None:
-        raise ValueError(f"unknown protocol {cfg.protocol!r} "
-                         f"(have: {sorted(_PROTOCOLS)})")
-    metrics = Metrics()
-    trace = make_trace(cfg.trace, engine="soak")
-    chaos = ChaosNetwork(seed=cfg.seed, loss=cfg.loss,
-                         duplicate=cfg.duplicate, delay=cfg.delay,
-                         delay_range=cfg.delay_range, metrics=metrics,
-                         trace=trace)
-    n_servers = 3 if cfg.protocol == "abd" else 1
-    proto = proto_cls(cfg, _free_udp_ports(n_servers))
-    recorder = HistoryRecorder()
-    shared = _Shared()
-    schedule = _fault_schedule(cfg)
-    shared.gate = schedule[0][0] if schedule else cfg.ops
-    if trace:
-        from stateright_tpu.obs import identity_fields, new_run_id
-        trace.emit("run_start", model=f"soak:{proto.name}",
-                   wall=time.time(),
-                   **identity_fields(trace, new_run_id("soak")))
-        trace.emit("fault_injection", max_crashes=cfg.crashes,
-                   actors=[int(proto.crash_target)])
-    t0 = time.monotonic()
-    handle = spawn(pickle.dumps, pickle.loads, proto.actors(),
-                   background=True, seed=cfg.seed, chaos=chaos)
-    clients = [threading.Thread(
-        target=_client_loop,
-        args=(cix, cfg, proto, chaos, recorder, shared),
-        daemon=True, name=f"soak-client-{cix}")
-        for cix in range(cfg.clients)]
-    scheduler = threading.Thread(
-        target=_scheduler_loop,
-        args=(cfg, proto, handle, chaos, recorder, metrics, trace,
-              shared),
-        daemon=True, name="soak-scheduler")
-    try:
-        for t in clients:
-            t.start()
-        scheduler.start()
-        hard_deadline = t0 + cfg.deadline
-        last_emit = (0, 0, 0)
-        for t in clients:
-            while t.is_alive():
-                t.join(0.1)
-                counts = (recorder.invoked, recorder.returned,
-                          recorder.abandoned)
-                if trace and counts != last_emit:
-                    trace.emit("ops", op_invoke=counts[0],
-                               op_return=counts[1],
-                               op_timeouts=counts[2])
-                    last_emit = counts
-                if time.monotonic() > hard_deadline:
-                    shared.stop.set()
-    finally:
-        shared.stop.set()
-        scheduler.join(5.0)
-        handle.stop()
-        chaos.close()
-    elapsed = time.monotonic() - t0
-
-    history = recorder.history()
-    results = {}
-    ok = True
-    for name in cfg.testers:
-        results[name] = history.check(tester_for(name, proto.spec()))
-        ok = ok and results[name]
-    metrics.set("ops", recorder.returned)
-    metrics.set("op_timeouts", recorder.abandoned)
-    metrics.set("history_ok", int(ok))
-
-    artifact = None
-    if not ok:
-        meta = cfg.meta()
-        meta["spec"] = proto.spec_name
-        meta["completed"] = recorder.returned
-        os.makedirs(cfg.artifact_dir, exist_ok=True)
-        kind = "durable" if cfg.durable else "volatile"
-        artifact = os.path.join(
-            cfg.artifact_dir,
-            f"soak_{proto.name}_{kind}_seed{cfg.seed}.jsonl")
-        history.dump(artifact, meta)
-
-    if trace:
-        trace.emit("soak_done", ops=recorder.returned,
-                   history_ok=bool(ok))
-        trace.close()
-
-    snap = metrics.snapshot()
-    result = {
-        "protocol": proto.name,
-        "seed": cfg.seed,
-        "durable": cfg.durable,
-        "ops": recorder.invoked,
-        "completed": recorder.returned,
-        "op_timeouts": recorder.abandoned,
-        "elapsed": round(elapsed, 3),
-        "ops_per_s": round(recorder.returned / elapsed, 1)
-        if elapsed > 0 else None,
-        "history_ok": bool(ok),
-        "testers": results,
-        "artifact": artifact,
-    }
-    for key in ("crashes", "restarts", "dropped", "duplicated",
-                "delayed", "reordered", "partitions"):
-        result[key] = int(snap.get(key, 0))
-    return result
-
-
-# --- CLI --------------------------------------------------------------------
-
-def main(argv=None) -> int:
-    import argparse
-
-    p = argparse.ArgumentParser(
-        description="chaos soak: live faults + consistency cross-check")
-    p.add_argument("--protocol", default="write_once",
-                   choices=sorted(_PROTOCOLS))
-    p.add_argument("--ops", type=int, default=2000)
-    p.add_argument("--clients", type=int, default=4)
-    p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--volatile", action="store_true",
-                   help="run the buggy volatile twin (the cross-check "
-                        "must reject it under crash-restart)")
-    p.add_argument("--loss", type=float, default=0.02)
-    p.add_argument("--duplicate", type=float, default=0.02)
-    p.add_argument("--delay", type=float, default=0.1)
-    p.add_argument("--crashes", type=int, default=2)
-    p.add_argument("--partitions", type=int, default=1)
-    p.add_argument("--sequential", action="store_true",
-                   help="also cross-check sequential consistency")
-    p.add_argument("--trace", default=None, metavar="PATH")
-    p.add_argument("--artifact-dir", default="soak_seeds")
-    args = p.parse_args(argv)
-
-    testers = ("linearizability", "sequential") if args.sequential \
-        else ("linearizability",)
-    cfg = SoakConfig(
-        protocol=args.protocol, ops=args.ops, clients=args.clients,
-        seed=args.seed, durable=not args.volatile, loss=args.loss,
-        duplicate=args.duplicate, delay=args.delay,
-        crashes=args.crashes, partitions=args.partitions,
-        testers=testers, trace=args.trace,
-        artifact_dir=args.artifact_dir)
-    result = run_soak(cfg)
-    print(json.dumps(result))
-    return 0 if result["history_ok"] else 1
-
+from stateright_tpu.soak import (  # noqa: E402,F401
+    _PROTOCOLS, SOAK_REGISTRY, DurableAbdActor, DurableWOServer,
+    SoakConfig, VolatileWOServer, artifact_filename, build_soak_config,
+    check_artifact, file_violation, fuzz_config, known_soak_configs,
+    main, register_soak_config, run_soak, spec_for, tester_for,
+    volatile_demo_config)
 
 if __name__ == "__main__":
     raise SystemExit(main())

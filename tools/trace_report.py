@@ -177,6 +177,7 @@ def report(events, out=None):
                    "recorder_dump",
                    "spill", "evict", "pause",
                    "crash", "restart", "partition",
+                   "soak_start", "violation", "burnin_preempt",
                    "job_submit", "job_start", "job_pause",
                    "job_resume", "job_done",
                    "bucket_flush", "batch_form", "lane_retire",
@@ -274,6 +275,16 @@ def report(events, out=None):
             parts += [f"{plural[k]}={v}"
                       for k, v in sorted(counts.items())]
             parts.append(f"history_ok={last.get('history_ok')}")
+            # the online cross-check's finds: which tester rejected,
+            # and — when the incremental checker flagged it mid-run —
+            # at which operation the history went bad
+            viols = [e for e in evs if e["ev"] == "violation"]
+            if viols:
+                parts.append(f"violations={len(viols)}")
+                pinned = [e["op_index"] for e in viols
+                          if e.get("op_index") is not None]
+                if pinned:
+                    parts.append(f"violation_op={pinned[0]}")
             out.write("\nsoak: " + " ".join(parts) + "\n")
 
         # job-service summary (engine="service"): per-job lifecycle —
@@ -291,9 +302,20 @@ def report(events, out=None):
             preempts = sum(1 for e in job_evs
                            if e["ev"] == "job_pause"
                            and e.get("reason") == "preempt")
-            out.write(f"\njobs: submitted={sum(1 for e in job_evs if e['ev'] == 'job_submit')} "
-                      f"done={done} failed={failed} "
-                      f"preemptions={preempts}\n")
+            line = (f"\njobs: submitted="
+                    f"{sum(1 for e in job_evs if e['ev'] == 'job_submit')} "
+                    f"done={done} failed={failed} "
+                    f"preemptions={preempts}")
+            # burn-in lane visibility: background soak/fuzz jobs
+            # synthesized by the scheduler, and their op-boundary
+            # hand-offs to real work
+            burn = sum(1 for e in job_evs if e["ev"] == "job_submit"
+                       and e.get("burnin"))
+            bp = [e for e in evs if e["ev"] == "burnin_preempt"]
+            if burn or bp:
+                line += (f"  burnin: jobs={burn} "
+                         f"preempts={len(bp)}")
+            out.write(line + "\n")
             for jid in sorted(per_job):
                 parts = []
                 for ev in per_job[jid]:
@@ -306,6 +328,9 @@ def report(events, out=None):
                         extra = f"({ev.get('reason')})"
                     elif ev["ev"] == "job_done":
                         extra = f"({ev.get('state')})"
+                        # soak/fuzz jobs carry the cross-check verdict
+                        if ev.get("history_ok") is False:
+                            extra += "(VIOLATION)"
                     parts.append(f"{kind}{extra}@{ev['t']:.2f}")
                 out.write(f"  {jid}: " + " -> ".join(parts) + "\n")
 
