@@ -174,6 +174,17 @@ def _compact_metrics(ck):
                          ("xfer_s", "xfer_frac")):
             if k in prof:
                 m[label] = round(prof[k] / search, 3)
+    # span attribution (obs/spans.py, attached by profile()): the
+    # top-3 exclusively-attributed stall buckets + the bubble
+    # fraction, so a BENCH round self-diagnoses its dominant stall
+    # without re-running under a trace sink
+    attribution = prof.get("attribution")
+    if isinstance(attribution, dict) and attribution:
+        m["stalls"] = [[k, round(float(v), 4)] for k, v in
+                       sorted(attribution.items(),
+                              key=lambda kv: -kv[1])[:3]]
+    if prof.get("bubble_frac") is not None:
+        m["bubble_frac"] = round(float(prof["bubble_frac"]), 3)
     uniq, gen = ck.unique_state_count(), ck.state_count()
     if gen:
         m["dedup_hit"] = round(1.0 - uniq / gen, 4)

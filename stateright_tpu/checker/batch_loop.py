@@ -107,7 +107,7 @@ class BatchLoop:
 
     def __init__(self, model, lanes: int, capacity: int, fmax: int,
                  chunk_steps: int = 32, grow_at: float = 0.55,
-                 metrics=None, trace=None):
+                 metrics=None, trace=None, spans=None):
         reason = batch_supports(model)
         if reason is not None:
             raise ValueError(f"model unsupported by the batch loop: "
@@ -126,6 +126,9 @@ class BatchLoop:
         self._steps = int(chunk_steps)
         self._metrics = metrics
         self._trace = trace
+        # span profiler hook (obs/spans.py SpanRecorder): the batch's
+        # dispatch/device/xfer/host intervals for stall attribution
+        self._spans = spans
         self._properties = model.properties()
         self._prop_count = len(self._properties)
         fa = self.fmax * model.max_actions
@@ -279,21 +282,34 @@ class BatchLoop:
                                      np.int32(self.grow_limit),
                                      np.int32(0))
         self._carry = carry
+        t_disp = time.perf_counter()
         if self._metrics is not None:
             self._metrics.inc("chunks")
-            self._metrics.add_time("dispatch",
-                                   time.perf_counter() - t0)
+            self._metrics.add_time("dispatch", t_disp - t0)
         t1 = time.perf_counter()
+        # readiness split (the solo engines' _materialize_stats idiom):
+        # dispatch->ready is the batched kernel executing, ready->
+        # materialized the stats transfer
+        try:
+            stats_d.block_until_ready()
+        except AttributeError:
+            pass  # already host-side (host fallbacks, tests)
+        t_ready = time.perf_counter()
         stats = np.asarray(jax.device_get(stats_d))
+        t_mat = time.perf_counter()
         if self._metrics is not None:
-            self._metrics.add_time("sync_stall",
-                                   time.perf_counter() - t1)
+            self._metrics.add_time("sync_stall", t_mat - t1)
+        if self._spans is not None:
+            self._spans.record("dispatch", t0, t_disp)
+            self._spans.record("device", t_disp, t_ready)
+            self._spans.record("xfer", t_ready, t_mat)
         self._last_stats = stats
         # ONE pull covers every lane's fresh log rows (the batch is
         # sized for small jobs, so the whole log matrix is cheap)
         log = None
         exits: List[Tuple[int, str]] = []
         P = self._prop_count
+        t_host0 = time.perf_counter()
         for lane in self.active_lanes():
             st = self._lanes[lane]
             row = stats[lane]
@@ -348,6 +364,9 @@ class BatchLoop:
             if reason is not None:
                 st.active = False
                 exits.append((lane, reason))
+        if self._spans is not None:
+            # the per-lane consume loop is this engine's host phase
+            self._spans.record("host", t_host0, time.perf_counter())
         return exits
 
     # --- per-lane reads ------------------------------------------------

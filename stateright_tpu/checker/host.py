@@ -18,7 +18,8 @@ from typing import Dict, Optional
 
 from ..core import Expectation, Model
 from ..fingerprint import fp64_node
-from ..obs import (FlightRecorder, Metrics, apply_artifact_dir,
+from ..obs import (FlightRecorder, Metrics, SpanRecorder,
+                   apply_artifact_dir, attach_attribution,
                    default_flight_path, fault_info, identity_fields,
                    make_trace, new_run_id)
 from .builder import Checker, CheckerBuilder
@@ -88,6 +89,11 @@ class HostChecker(Checker):
         # from file paths. Stamped onto run_start by _step_wrapper.
         self._run_id = obs_opts.get("run_id") or new_run_id()
         self._job_id = obs_opts.get("job_id")
+        # span profiler (obs/spans.py): the device engines record each
+        # pipeline phase as an INTERVAL here; always on (bounded ring)
+        # so profile()'s attribution works traceless, and mirrored as
+        # `span` trace events when a sink is configured
+        self._spans = SpanRecorder(self._trace)
 
     def _timed(self, name: str):
         """Accumulate wall time under a glossary phase key."""
@@ -98,8 +104,13 @@ class HostChecker(Checker):
         (wall-seconds), counters, and observed maxima. Key meanings are
         pinned in ONE place — ``stateright_tpu.obs.GLOSSARY`` (also
         rendered in README.md § Observability) — rather than restated
-        per engine; engines report only the phases they run."""
-        return self._metrics.snapshot()
+        per engine; engines report only the phases they run. Engines
+        that recorded spans additionally report ``attribution`` /
+        ``idle_s`` / ``bubble_frac`` — the overlap-aware wall-time
+        split (attached post-snapshot: fractions must never ride a
+        summing ``Metrics.merge``)."""
+        return attach_attribution(self._metrics.snapshot(),
+                                  self._spans)
 
     def run_id(self) -> str:
         """This run's correlation id (stamped on its ``run_start``

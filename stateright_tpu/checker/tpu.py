@@ -655,6 +655,7 @@ class TpuChecker(HostChecker):
         hook = self._fault_hook
         shards = int(self._fault_shards)
         self._pull_timing = None
+        self._pull_stamps = None
 
         def pull():
             if hook is not None:
@@ -672,6 +673,9 @@ class TpuChecker(HostChecker):
             t2 = time.perf_counter()
             base = t_disp if t_disp is not None else t0
             self._pull_timing = (max(t1 - base, 0.0), max(t2 - t1, 0.0))
+            # absolute stamps for the span profiler: the device span
+            # runs dispatch->ready, the xfer span ready->materialized
+            self._pull_stamps = (t1, t2)
             return out
 
         deadline = self._chunk_deadline
@@ -1289,15 +1293,18 @@ class TpuChecker(HostChecker):
                                    steps=jnp.int32(k_steps),
                                    vmax=jnp.int32(0),
                                    pdh=jnp.int32(0), prb=jnp.int32(0))
+            t_d0 = time.perf_counter()
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit,
                                           np.int32(self._h_pulled))
+            t_disp = time.perf_counter()
             self._metrics.inc("chunks")
             if fused_on:
                 self._metrics.inc("fused_chunks")
-            inflight.append((int(self._metrics.get("chunks")), stats_d,
-                             self._h_pulled, int(grow_limit), hcap,
-                             time.perf_counter()))
+            ordinal = int(self._metrics.get("chunks"))
+            self._spans.record("dispatch", t_d0, t_disp, chunk=ordinal)
+            inflight.append((ordinal, stats_d, self._h_pulled,
+                             int(grow_limit), hcap, t_disp))
 
         def process(ordinal: int, stats_d, h_base: int, grow_limit: int,
                     hcap_d: int, t_disp: float) -> set:
@@ -1316,6 +1323,16 @@ class TpuChecker(HostChecker):
             if timing is not None:
                 self._metrics.add_time("device_s", timing[0])
                 self._metrics.add_time("xfer_s", timing[1])
+            # span twins: device (dispatch->ready) and xfer (ready->
+            # materialized) as INTERVALS — under pipelining the device
+            # span overlaps the PREVIOUS chunk's host span, which is
+            # exactly what the attribution sweep needs to see
+            stamps = getattr(self, "_pull_stamps", None)
+            if stamps is not None:
+                self._spans.record("device", t_disp, stamps[0],
+                                   chunk=ordinal)
+                self._spans.record("xfer", stamps[0], stamps[1],
+                                   chunk=ordinal)
             # a successful sync proves the backend is alive: the retry
             # budget bounds CONSECUTIVE faults, not lifetime hiccups
             # (and the spill budget CONSECUTIVE unproductive spills)
@@ -1346,7 +1363,8 @@ class TpuChecker(HostChecker):
                 # queue/log suffixes are append-only, so gathering them
                 # from the LIVE carry — possibly a later in-flight
                 # chunk's future — reads exactly the committed rows)
-                with self._timed("shadow"):
+                with self._spans.span("host_probe", chunk=ordinal), \
+                        self._timed("shadow"):
                     prev = shadow.log_n[0]
                     q_new = gather_rows(carry.q, np.arange(
                         n_init + prev, n_init + log_n, dtype=np.int32))
@@ -1446,7 +1464,8 @@ class TpuChecker(HostChecker):
                 # same order as the synchronous path.
                 fresh = h_n - self._h_pulled
                 if fresh > 0:
-                    with self._timed("posthoc"):
+                    with self._spans.span("props", chunk=ordinal), \
+                            self._timed("posthoc"):
                         win = stats[tail0 + width3:].reshape(
                             (HIST_WINDOW, -1))
                         offset = self._h_pulled - h_base
@@ -1471,8 +1490,12 @@ class TpuChecker(HostChecker):
                         h_n=max(hgrow_pend["h_n"], h_n))
                 else:
                     self._hscan_tail = q_tail
-            self._metrics.add_time("host_overlap",
-                                   time.perf_counter() - t0)
+            t_host_end = time.perf_counter()
+            self._metrics.add_time("host_overlap", t_host_end - t0)
+            # the umbrella host span (stats decode + shadow fold +
+            # inline props): overlapped when chunk N+1 is in flight,
+            # the pipeline bubble when nothing is
+            self._spans.record("host", t0, t_host_end, chunk=ordinal)
             if kovf:
                 # resize data for the drained handler; skip the exit
                 # checks exactly like the synchronous retry `continue`
@@ -2382,7 +2405,7 @@ class TpuChecker(HostChecker):
         log_d, log_n_d = mirror
         import jax
 
-        with self._timed("mirror_pull"):
+        with self._spans.span("mirror"), self._timed("mirror_pull"):
             log_n = int(jax.device_get(log_n_d))
             if self._trace:
                 self._trace.emit("mirror_pull", n=log_n)

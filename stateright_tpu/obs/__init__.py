@@ -31,12 +31,16 @@ from .artifacts import (ARTIFACT_NAMES, apply_artifact_dir,
                         artifact_paths)
 from .metrics import GAUGES, GLOSSARY, MAXIMA, Metrics, MetricsRing
 from .recorder import FlightRecorder, default_flight_path
+from .spans import (DEVICE_SPANS, SpanRecorder, analyze,
+                    attach_attribution, ranked, shard_imbalance,
+                    spans_from_events, top_stalls)
 from .trace import (EVENT_SCHEMA, NULL_TRACE, NullTrace, RunTrace,
                     emit_trace_header, fault_info, identity_fields,
                     make_trace, new_run_id, validate_event)
 
 __all__ = [
     "ARTIFACT_NAMES",
+    "DEVICE_SPANS",
     "EVENT_SCHEMA",
     "FlightRecorder",
     "GAUGES",
@@ -47,13 +51,20 @@ __all__ = [
     "NULL_TRACE",
     "NullTrace",
     "RunTrace",
+    "SpanRecorder",
+    "analyze",
     "apply_artifact_dir",
     "artifact_paths",
+    "attach_attribution",
     "default_flight_path",
     "emit_trace_header",
     "fault_info",
     "identity_fields",
     "make_trace",
     "new_run_id",
+    "ranked",
+    "shard_imbalance",
+    "spans_from_events",
+    "top_stalls",
     "validate_event",
 ]

@@ -294,6 +294,25 @@ GLOSSARY: Dict[str, str] = {
                       "mesh — the DCN latency floor every fingerprint "
                       "all-to-all pays between hosts; 0 on "
                       "single-process meshes, which skip the probe)",
+    # --- span attribution (obs/spans.py; attached by profile(), NOT
+    # stored in the registry — merge() would sum fractions) -------------
+    "attribution": "overlap-aware wall-time split (dict: bucket -> "
+                   "seconds, largest first) from the run's span "
+                   "timeline — device-only buckets (device/xfer/"
+                   "exchange) are device-bound, 'overlap' is host work "
+                   "hidden under an in-flight chunk (free), 'host:*' "
+                   "is host work blocking an idle device (the pipeline "
+                   "bubble), 'idle' is dead air; rows sum to wall "
+                   "(tools/stall_report.py renders the ranked table)",
+    "idle_s": "wall seconds with NO span active (neither device nor "
+              "host side) inside the run's span extent — dead air "
+              "between chunks (from the attribution sweep; not a "
+              "registry counter)",
+    "bubble_frac": "fraction of span-extent wall time where the host "
+                   "blocked the critical path (host:* buckets) or "
+                   "nothing ran (idle) — the addressable pipeline "
+                   "bubble; 0 means every host second hid under device "
+                   "compute (not a registry counter)",
 }
 
 #: keys that are point-in-time GAUGES, not accumulating counters:

@@ -195,6 +195,15 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "mesh_init": frozenset({"shards", "hosts", "procs"}),
     "host_join": frozenset({"host"}),
     "host_drop": frozenset({"host"}),
+    # one phase INTERVAL on the trace clock (obs/spans.py): `name` is
+    # the phase (dispatch / device / xfer / host / host_probe / mirror
+    # / exchange / props / idle), `t0`/`t1` its trace-relative bounds
+    # — unlike the flat phase timers these compose under the pipeline:
+    # the overlap-aware sweep (spans.analyze, tools/stall_report.py)
+    # attributes wall time only where a phase is the unique blocker.
+    # Optional identity fields (`chunk`, `shard`, `lane`, `job`) ride
+    # along when the emitting loop has them
+    "span": frozenset({"name", "t0", "t1"}),
 }
 
 _BASE_FIELDS = frozenset({"t", "ev", "engine"})

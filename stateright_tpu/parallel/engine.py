@@ -358,6 +358,11 @@ class ShardedTpuChecker(TpuChecker):
         if n_procs > 1:
             probe_s = dcn_probe(mesh, axis)
             self._metrics.add_time("dcn_exchange_s", probe_s)
+            # the collective's interval on the span timeline: the DCN
+            # floor every cross-host fingerprint exchange pays
+            t_probe = time.perf_counter()
+            self._spans.record("exchange", t_probe - probe_s, t_probe,
+                               shard=D)
         if self._trace:
             self._trace.emit(
                 "mesh_init", shards=D, hosts=n_hosts, procs=n_procs,
@@ -419,13 +424,16 @@ class ShardedTpuChecker(TpuChecker):
                                    bmax=jnp.int32(0),
                                    pdh=jnp.int32(0),
                                    prb=jnp.int32(0))
+            t_d0 = time.perf_counter()
             with self._timed("dispatch"):
                 carry, stats_d = chunk_fn(carry, remaining, grow_limit)
+            t_disp = time.perf_counter()
             self._metrics.inc("chunks")
             if fused_on:
                 self._metrics.inc("fused_chunks")
-            inflight.append((int(self._metrics.get("chunks")), stats_d,
-                             int(grow_limit), time.perf_counter()))
+            ordinal = int(self._metrics.get("chunks"))
+            self._spans.record("dispatch", t_d0, t_disp, chunk=ordinal)
+            inflight.append((ordinal, stats_d, int(grow_limit), t_disp))
 
         def process(ordinal: int, stats_d, grow_limit: int,
                     t_disp: float) -> set:
@@ -441,6 +449,13 @@ class ShardedTpuChecker(TpuChecker):
             if timing is not None:
                 self._metrics.add_time("device_s", timing[0])
                 self._metrics.add_time("xfer_s", timing[1])
+            # interval twins for the attribution sweep (obs/spans.py)
+            stamps = getattr(self, "_pull_stamps", None)
+            if stamps is not None:
+                self._spans.record("device", t_disp, stamps[0],
+                                   chunk=ordinal)
+                self._spans.record("xfer", stamps[0], stamps[1],
+                                   chunk=ordinal)
             # a successful sync proves the backend is alive; the retry
             # budget (and the per-device blame streak) bounds
             # CONSECUTIVE faults, the spill budget CONSECUTIVE spills
@@ -476,7 +491,8 @@ class ShardedTpuChecker(TpuChecker):
                 # per-shard queue/log slices are append-only and keep
                 # their shard-relative positions across growths, so the
                 # suffix gathers reconstruct the device state exactly
-                with self._timed("shadow"):
+                with self._spans.span("host_probe", chunk=ordinal), \
+                        self._timed("shadow"):
                     qloc = qcap // D
                     closc = self._capacity // D
                     eloc = (ecap // D) if ecap else 0
@@ -595,7 +611,8 @@ class ShardedTpuChecker(TpuChecker):
             if self._host_props and any(
                     p.name not in discoveries
                     for _i, p in self._host_props):
-                with self._timed("posthoc"):
+                with self._spans.span("props", chunk=ordinal), \
+                        self._timed("posthoc"):
                     # the reduction is pinned to THIS chunk's per-shard
                     # queue tails: under pipelining the live carry
                     # already holds the next chunk's appends, and
@@ -604,8 +621,9 @@ class ShardedTpuChecker(TpuChecker):
                     self._posthoc_sharded(carry, qcap, n_init_arr,
                                           discoveries,
                                           q_tail_h=q_tail)
-            self._metrics.add_time("host_overlap",
-                                   time.perf_counter() - t0)
+            t_host_end = time.perf_counter()
+            self._metrics.add_time("host_overlap", t_host_end - t0)
+            self._spans.record("host", t0, t_host_end, chunk=ordinal)
             if kovf:
                 kovf_pend[0] = max(kovf_pend[0], vmax)
                 kovf_pend[1] = max(kovf_pend[1], dmax)

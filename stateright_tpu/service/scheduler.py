@@ -948,6 +948,16 @@ class Scheduler:
             hosts=[str(h) for h in lease.hosts],
             queue_wait_s=(round(rt.granted_at - queued_at, 6)
                           if queued_at is not None else None))
+        if queued_at is not None and self._trace:
+            # the job-dispatch gap as a span: submit->grant on the
+            # service stream's clock (wall = t0_unix + t), so the
+            # stall report sees queueing dead-air beside engine spans
+            t0_unix = getattr(self._trace, "t0_unix", None)
+            if t0_unix is not None:
+                self._trace.emit(
+                    "span", name="idle", job=job.id,
+                    t0=round(max(0.0, queued_at - t0_unix), 6),
+                    t1=round(max(0.0, rt.granted_at - t0_unix), 6))
         thread = threading.Thread(
             target=self._run_job, args=(job, lease, rt),
             name=f"stateright-job-{job.id}", daemon=True)

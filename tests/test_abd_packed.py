@@ -52,6 +52,7 @@ class TestPackedAbdOnDevice:
         assert (plain.unique_state_count()
                 == packed.unique_state_count() == 544)
 
+    @pytest.mark.slow  # ~28s warm: 3-replica host + device enumerations
     def test_three_servers(self):
         # quorum-of-2 behavior with 3 replicas: host/device agreement
         host = (PackedAbd(1, server_count=3).checker()
@@ -86,6 +87,7 @@ class TestOrderedOnDevice:
                 == host.generated_fingerprints())
         dev.assert_properties()
 
+    @pytest.mark.slow  # ~24s warm: 100k-state run to the overflow
     def test_channel_overflow_is_loud(self):
         import pytest
 

@@ -333,6 +333,7 @@ class TestBatchedJobs:
 # --- the throughput pin (bench --job-storm subprocess) ---------------------
 
 class TestJobStorm:
+    @pytest.mark.slow  # ~38s warm: two bench subprocesses (cold cache)
     def test_storm_contract_compiles_and_speedup(self):
         # ACCEPTANCE: >=24 tiny same-bucket-family jobs on one CPU
         # device complete with <=2 distinct compiles (vs >=24

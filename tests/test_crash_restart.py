@@ -261,6 +261,7 @@ class TestEngineParity:
             assert any(isinstance(a, Crash) for a in actions)
             assert path.last_state().history.serialized_history() is None
 
+    @pytest.mark.slow  # ~24s warm: paxos parity across both engines
     def test_paxos_small_config_parity(self):
         from stateright_tpu.examples.paxos_packed import PackedPaxos
 

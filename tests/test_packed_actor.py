@@ -49,6 +49,7 @@ class TestPackedPaxosOnDevice:
         path = ck.discoveries()["value chosen"]
         assert len(path.into_actions()) >= 1
 
+    @pytest.mark.slow  # ~43s warm: level-mode + posthoc paxos runs
     def test_level_mode_agrees_with_posthoc(self):
         """The per-level engine (incremental host-prop eval) and the
         device engine (post-hoc eval over distinct histories) reach the

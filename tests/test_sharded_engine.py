@@ -229,6 +229,7 @@ class TestShardedEventually:
 
 
 class TestShardedKmaxOverflowRecovery:
+    @pytest.mark.slow  # ~56s warm: two sharded compiles + full rebuild
     def test_undersized_kmax_grows_and_completes(self):
         # the sharded kovf protocol: all shards abort the iteration in
         # lockstep (replicated flag), the host rebuilds with a doubled

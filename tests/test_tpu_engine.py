@@ -448,6 +448,7 @@ def test_plan_insert_host_matches_device_probe():
 
 
 class TestKmaxOverflowRecovery:
+    @pytest.mark.slow  # ~46s warm: kovf abort + doubled-kmax recompile
     def test_undersized_kmax_grows_and_completes(self):
         # force the kovf abort-and-rebuild protocol: a candidate buffer
         # far below the real branching must abort the first iteration

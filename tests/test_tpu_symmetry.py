@@ -44,6 +44,8 @@ class TestPackedRepresentative:
 
 
 class TestDeviceSymmetry:
+    @pytest.mark.slow  # ~42s warm (5-RM 2pc under symmetry); the
+    # complete_symmetry + sharded symmetry pins stay tier-1
     def test_2pc_sym_reduces(self):
         # 5 RMs: 8,832 plain states (2pc.rs:133); under symmetry the DFS
         # oracle reaches 665 (2pc.rs:138). 2pc's representative breaks

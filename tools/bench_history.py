@@ -135,6 +135,12 @@ def parse_round(path: str) -> Dict[str, Any]:
             "unit": row.get("unit"),
             "uniq": row.get("uniq"),
             "gen_per_uniq": row.get("gen_per_uniq"),
+            # span attribution (PR 18): the top stall buckets and the
+            # pipeline-bubble fraction bench.py embeds per workload —
+            # optional (pre-span rounds simply lack them), trended as
+            # the dominant-stall column
+            "stalls": metrics.get("stalls"),
+            "bubble_frac": metrics.get("bubble_frac"),
             # duplicate-expansion factor AFTER the cross-chunk dedup
             # ring's in-register kills (PR 13) — the g/u vs g/u_cc gap
             # is the cache's measured bite, tracked as its own trend
@@ -226,6 +232,33 @@ def compute_flags(rounds: List[Dict[str, Any]],
                           "round": rnd["round"],
                           "workload": err["workload"],
                           "detail": err["error"][:200]})
+    # span-attribution coverage (PR 18): rounds BEFORE the first
+    # attribution-carrying round predate the span profiler — flagged
+    # informationally (never fatal, so the committed pre-span
+    # artifacts keep the gate green). A LATER round with workload rows
+    # but no attribution anywhere regressed the instrument: fatal.
+    attr_idx = [i for i, r in enumerate(rounds)
+                if _has_attribution(r)]
+    if attr_idx:
+        first = attr_idx[0]
+        for i, rnd in enumerate(rounds):
+            if _has_attribution(rnd) or not rnd["workloads"]:
+                continue
+            if i < first:
+                flags.append({
+                    "kind": "pre_span", "round": rnd["round"],
+                    "info": True,
+                    "detail": "round predates the span profiler — no "
+                              "attribution fields (informational, "
+                              "not fatal)"})
+            else:
+                flags.append({
+                    "kind": "missing_attribution",
+                    "round": rnd["round"],
+                    "detail": "no workload row carries span "
+                              "attribution (stalls/bubble_frac) in a "
+                              "round AFTER the profiler landed in "
+                              f"{rounds[first]['round']}"})
     # regressions / disappearances: compare each data round against the
     # PREVIOUS round that carried per-workload rows
     data_rounds = [r for r in rounds if r["workloads"]]
@@ -262,6 +295,13 @@ def compute_flags(rounds: List[Dict[str, Any]],
                                          1 - cw["best"] / pw["best"],
                                          prev))
     return flags
+
+
+def _has_attribution(rnd) -> bool:
+    """True when any workload row of the round carries the span
+    profiler's fields (``stalls``/``bubble_frac``)."""
+    return any(w.get("stalls") or w.get("bubble_frac") is not None
+               for w in rnd["workloads"].values())
 
 
 def _round_tags(rnd) -> set:
@@ -320,6 +360,12 @@ def render_markdown(report: Dict[str, Any], out) -> None:
                     cell += f", g/u={e['gen_per_uniq']}"
                 if e.get("gen_per_uniq_cc"):
                     cell += f", g/u_cc={e['gen_per_uniq_cc']}"
+                if e.get("stalls"):
+                    # the dominant-stall trend: the bucket the next
+                    # perf PR should target, round over round
+                    cell += f", stall={e['stalls'][0][0]}"
+                if e.get("bubble_frac") is not None:
+                    cell += f", bubble={e['bubble_frac']}"
                 if e["tags"]:
                     cell += " [" + ",".join(e["tags"]) + "]"
                 cells.append(cell)
@@ -336,7 +382,9 @@ def render_markdown(report: Dict[str, Any], out) -> None:
         out.write(f"* **{f['kind']}** {f['round']}"
                   + (f" `{where}`" if where else "")
                   + f": {f['detail']}"
-                  + (" (allowed)" if f.get("allowed") else "") + "\n")
+                  + (" (allowed)" if f.get("allowed") else "")
+                  + (" (informational)" if f.get("info") else "")
+                  + "\n")
 
 
 def allowed(flag: Dict[str, Any], allow: List[str]) -> bool:
@@ -391,7 +439,9 @@ def main(argv) -> int:
     elif json_to is None or md_to == "-":
         render_markdown(report, sys.stdout)
     if "--check" in argv:
-        hard = [f for f in report["flags"] if not allowed(f, allow)]
+        # informational flags (pre-span rounds) never fail the gate
+        hard = [f for f in report["flags"]
+                if not allowed(f, allow) and not f.get("info")]
         if hard:
             return 1
     return 0

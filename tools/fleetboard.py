@@ -155,6 +155,31 @@ class Board:
                 if prof.get(key):
                     parts.append(f"{key}={int(prof[key])}")
             lines.append("burnin: " + "  ".join(parts))
+        # top-stall line (obs/spans.py attribution): summed non-overlap
+        # buckets across job result profiles (plus any service-level
+        # attribution the offline reader derived from span events) —
+        # the fleet's dominant stall and mean pipeline bubble
+        stalls: Dict[str, float] = {}
+        bubbles: List[float] = []
+        sources = [((j.get("result") or {}).get("profile") or {})
+                   for j in jobs]
+        sources.append(prof)
+        for p in sources:
+            attr = p.get("attribution")
+            if isinstance(attr, dict):
+                for k, v in attr.items():
+                    if k != "overlap":
+                        stalls[k] = stalls.get(k, 0.0) + float(v)
+            if p.get("bubble_frac") is not None:
+                bubbles.append(float(p["bubble_frac"]))
+        if stalls:
+            top = sorted(stalls.items(), key=lambda kv: (-kv[1], kv[0]))
+            line = "stall: " + "  ".join(
+                f"{k}={v:.2f}s" for k, v in top[:3])
+            if bubbles:
+                line += (f"  bubble={sum(bubbles) / len(bubbles):.0%}"
+                         f" mean")
+            lines.append(line)
         # SLO aggregates (cumulative seconds / completions)
         done = by_state.get("done", 0) or int(prof.get("jobs_done",
                                                        0) or 0)
@@ -209,9 +234,12 @@ def load_offline(root: str) -> Dict[str, Any]:
     svc = store.service_trace_path
     if os.path.isfile(svc):
         counts: Dict[str, int] = {}
+        span_events: List[Dict[str, Any]] = []
         for ev in watch.follow_file(svc, follow=False):
             kind = ev.get("ev")
             counts[kind] = counts.get(kind, 0) + 1
+            if kind == "span":
+                span_events.append(ev)
             if kind == "pool_util":
                 util = {"busy_frac": ev.get("busy_frac"),
                         "per_host": ev.get("per_host") or {},
@@ -235,6 +263,17 @@ def load_offline(root: str) -> Dict[str, Any]:
         wait = [w for w in wait if w is not None]
         if wait:
             profile["queue_wait_s"] = sum(wait)
+        if span_events:
+            # service-stream spans (batch lane engine, queue-wait idle
+            # gaps): fold into the board's stall line
+            from stateright_tpu.obs import spans as spans_mod
+            attr = spans_mod.analyze(
+                spans_mod.spans_from_events(span_events))
+            if attr["spans"]:
+                profile["attribution"] = {
+                    k: round(v, 6)
+                    for k, v, _s in spans_mod.ranked(attr)}
+                profile["bubble_frac"] = round(attr["bubble_frac"], 6)
         util["samples"] = samples
     return {"jobs": jobs, "profile": profile, "utilization": util}
 

@@ -12,22 +12,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _trace_line(events, prof):
-    """One summary line from an in-memory run trace + profile()."""
-    chunks = [e for e in events if e["ev"] == "chunk"]
-    inters = [e["ev"] for e in events
-              if e["ev"] in ("grow", "hgrow", "egrow", "kovf")]
-    dh = [c["dedup_hit"] for c in chunks]
-    bits = [f"chunks={len(chunks)}"]
-    if dh:
-        bits.append(f"dedup_hit={sum(dh) / len(dh):.3f}")
-    if chunks:
-        bits.append(f"load={max(c['load'] for c in chunks):.4f}")
-    if inters:
-        bits.append(f"interventions={inters}")
-    search = prof.get("search")
-    if search and "sync_stall" in prof:
-        bits.append(f"stall={prof['sync_stall'] / search:.0%}")
-    return "  trace: " + " ".join(bits)
+    """One summary line per run — a thin shim over the span consumer
+    (tools/stall_report.py): the overlap-aware attribution replaces
+    the old hand-parsed chunk/stall ratios, which double-counted under
+    the pipeline."""
+    import stall_report
+    attr, imb = stall_report.attribution_from_events(events)
+    chunks = sum(1 for e in events if e.get("ev") == "chunk")
+    return (f"  trace: chunks={chunks} "
+            + stall_report.summary_line(attr, imb))
 
 
 def _probe(name, mk, n_runs, warm):

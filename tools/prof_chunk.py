@@ -1,8 +1,10 @@
 """Profile the device chunk loop on paxos: trace one warm capped run and
-summarize (a) the engine's own run-trace (per-chunk timeline via
-tools/trace_report.py) and (b) op time by kernel name from the XLA
-trace proto — the run-trace explains WHAT the loop did (chunks, dedup,
-growth storms), the XLA trace WHERE the device time went."""
+summarize (a) the engine's own run-trace via the span consumer
+(tools/stall_report.py — the overlap-aware stall attribution table)
+and (b) op time by kernel name from the XLA trace proto — the stall
+table explains WHICH side blocked the wall clock, the XLA trace WHERE
+the device time went. A thin shim: all trace parsing lives in
+stall_report/obs.spans."""
 import glob
 import gzip
 import json
@@ -52,11 +54,14 @@ run()  # warm (observed-size-memo shape switch)
 with jax.profiler.trace(outdir):
     run(trace=RUN_TRACE)
 
-# --- the engine's own run-trace: per-chunk timeline ---------------------
-from trace_report import load_events, report  # noqa: E402
+# --- the engine's own run-trace: overlap-aware stall attribution --------
+import stall_report  # noqa: E402
+from trace_report import load_events  # noqa: E402
 
-print("\n=== run-trace summary ===", file=sys.stderr)
-report(load_events(RUN_TRACE), out=sys.stderr)
+print("\n=== stall attribution ===", file=sys.stderr)
+_attr, _imb = stall_report.attribution_from_events(
+    load_events(RUN_TRACE))
+stall_report.render(_attr, _imb, title=RUN_TRACE, out=sys.stderr)
 
 # --- XLA kernel-time table ---------------------------------------------
 traces = glob.glob(os.path.join(outdir, "**", "*.trace.json.gz"),

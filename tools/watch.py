@@ -64,6 +64,11 @@ class Console:
         self._last_t = 0.0
         self._dev_total = 0.0
         self._xfer_total = 0.0
+        # recent span events (obs/spans.py): bounded, folded into a
+        # live top-stall fragment on progress lines rather than
+        # rendered per-event (spans arrive several per chunk)
+        from collections import deque
+        self._spans: "deque" = deque(maxlen=512)
         self.rendered_progress = 0
         self.rendered_events = 0
 
@@ -107,8 +112,26 @@ class Console:
                 parts.append(f"xfer={self._xfer_total / t:4.0%}")
         if "shard_q" in ev:
             parts.append(f"shards={len(ev['shard_q'])}")
+        stall = self._top_stall()
+        if stall:
+            parts.append(stall)
         self._w(" ".join(parts))
         self.rendered_progress += 1
+
+    def _top_stall(self) -> Optional[str]:
+        """Live top-stall fragment from the recent span window: the
+        largest NON-overlap attribution bucket (overlap is the
+        pipeline working — not a stall) plus the bubble fraction."""
+        if not self._spans:
+            return None
+        from stateright_tpu.obs import spans as spans_mod
+        attr = spans_mod.analyze(self._spans)
+        rows = [r for r in spans_mod.ranked(attr) if r[0] != "overlap"]
+        if not rows:
+            return f"stall=none bubble={attr['bubble_frac']:.0%}"
+        name, _secs, share = rows[0]
+        return (f"stall={name}:{share:.0%} "
+                f"bubble={attr['bubble_frac']:.0%}")
 
     def _event_line(self, ev: Dict[str, Any]) -> None:
         detail = " ".join(
@@ -121,6 +144,11 @@ class Console:
     # --- the consumer entry point --------------------------------------
     def feed(self, ev: Dict[str, Any]) -> None:
         kind = ev.get("ev")
+        if kind == "span":
+            # accumulated for the progress lines' top-stall fragment,
+            # never rendered per-event (several land per chunk)
+            self._spans.append(ev)
+            return
         if kind in _PROGRESS:
             now = time.monotonic()
             if (self.interval and self._last_render_t is not None
