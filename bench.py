@@ -795,6 +795,141 @@ def _job_storm() -> None:
         print(json.dumps(contract))
 
 
+def _flex_smoke() -> None:
+    """``--flex-smoke``: a seconds-scale proof of the elastic fleet
+    (flex controller + rolling host join/leave) under the crash-proof
+    contract — a small job storm on a 1-host (4 CPU devices)
+    scheduler while a second simulated host ARRIVES mid-run (the
+    hungry wide job promotes onto the freed width, in place) and then
+    LEAVES again (its tenants preempt back through the shard-agnostic
+    checkpoint). Every finished job's fingerprint digest must equal a
+    solo run of the same model; the contract line is tagged
+    ``"flex": true`` with bounded promote/demote counts and a
+    ``pool_busy_frac`` snapshot. Emitted from a ``finally`` path with
+    ``"partial"``/``"failed"`` on any error; rc=0 regardless."""
+    import hashlib
+    import os
+    import tempfile
+    import time
+
+    contract = {
+        "metric": "elastic flex smoke (job storm + rolling host "
+                  "join/leave, digests vs solo)",
+        "value": None,
+        "unit": "uniq/s",
+        "flex": True,
+        "promotes": None,
+        "demotes": None,
+        "pool_busy_frac": None,
+        "jobs": None,
+    }
+    try:
+        # force an 8-device CPU pool BEFORE jax initializes (and
+        # re-assert the config: a sitecustomize may override it)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from stateright_tpu.models.twopc import TwoPhaseSys
+        from stateright_tpu.service import JobSpec, JobStore, Scheduler
+
+        devs = jax.devices()
+        opts = {"capacity": 1 << 12, "fmax": 64, "chunk_steps": 2}
+
+        def _solo_digest(n: int) -> str:
+            ck = (TwoPhaseSys(n).checker()
+                  .tpu_options(race=False, **opts).spawn_tpu().join())
+            fps = sorted(int(f) for f in ck.generated_fingerprints())
+            return hashlib.sha256(
+                "\n".join(map(str, fps)).encode()).hexdigest()
+
+        solos = {n: _solo_digest(n) for n in (2, 3, 4)}
+        root = tempfile.mkdtemp(prefix="stateright_flex_smoke_")
+        sched = Scheduler(JobStore(root), devices=devs[:4],
+                          hosts=["h0"] * 4, flex=True,
+                          flex_interval=0.0, step_budget=1)
+        wide = sched.submit(JobSpec("twopc", args=[4], options=opts,
+                                    width=8, step_delay=0.01))
+        storm = [wide]
+        # the arriving host joins once the wide job is live, so the
+        # flex pass has a promotion-eligible tenant to widen onto it
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline \
+                and not sched.job(wide.id).status.get(
+                    "first_chunk_at"):
+            time.sleep(0.05)
+        sched.join_host("h1", devs[4:])
+        # give the in-place promote a moment to land (best effort —
+        # the contract stays green either way, the counts tell)
+        while time.monotonic() < deadline \
+                and not sched.profile().get("promotes"):
+            time.sleep(0.05)
+        # the storm: higher-priority arrivals put the (now over-width)
+        # wide job under queue pressure — the flex demote path
+        storm.append(sched.submit(JobSpec(
+            "twopc", args=[3], options=opts, width=2, priority=5)))
+        storm.append(sched.submit(JobSpec(
+            "twopc", args=[2], options=opts, width=1, priority=5)))
+        time.sleep(1.0)
+        # ... and the host leaves again mid-storm: free width
+        # withdraws, jobs whose lease touches it checkpoint and
+        # re-place on what stays
+        sched.leave_host("h1")
+        rows = []
+        total = 0.0
+        for job in storm:
+            state = sched.wait(job.id, timeout=240.0)
+            row = {"job": job.id, "args": job.spec.args,
+                   "state": state,
+                   "granted_width": job.status.get("granted_width")}
+            result = job.read_result()
+            if state == "done" and result is not None:
+                n = int(job.spec.args[0])
+                secs = max(job.status.get("done_at", 0.0)
+                           - job.status.get("running_at", 0.0), 1e-9)
+                row["uniq"] = result["unique_state_count"]
+                row["rate"] = round(result["unique_state_count"]
+                                    / secs, 1)
+                row["digest_ok"] = (result["fingerprints_sha256"]
+                                    == solos[n])
+                if not row["digest_ok"]:
+                    FAILED.append(f"flex-digest-{job.id}")
+                total += row["rate"]
+            else:
+                FAILED.append(f"flex-job-{job.id}")
+                row["error"] = job.status.get("error")
+            rows.append(row)
+            print(json.dumps({"workload": f"flex {job.id}", **row}),
+                  file=sys.stderr)
+        prof = sched.profile()
+        contract["jobs"] = rows
+        if total:
+            contract["value"] = round(total, 1)
+        contract["promotes"] = int(prof.get("promotes", 0) or 0)
+        contract["demotes"] = int(prof.get("demotes", 0) or 0)
+        contract["preemptions"] = int(prof.get("preemptions", 0) or 0)
+        contract["pool_busy_frac"] = prof.get("pool_busy_frac")
+        # bounded churn: hysteresis must keep the controller from
+        # thrashing even with the interval forced to zero
+        if contract["promotes"] > 8 or contract["demotes"] > 8:
+            FAILED.append("flex-thrash")
+        sched.shutdown()
+    except BaseException as exc:
+        print(json.dumps({"workload": "flex", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("flex")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
 def _arg_after(flag: str, default):
     if flag in sys.argv:
         return sys.argv[sys.argv.index(flag) + 1]
@@ -819,6 +954,9 @@ def main() -> None:
         return
     if "--multihost-smoke" in sys.argv:
         _multihost_smoke()
+        return
+    if "--flex-smoke" in sys.argv:
+        _flex_smoke()
         return
     if SMOKE:
         N = 1

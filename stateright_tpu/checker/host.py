@@ -50,6 +50,14 @@ class HostChecker(Checker):
         self._pause_event = threading.Event()
         self._pause_path = None
         self._paused = False
+        # elastic runs (the scale-UP mirror of the degradation ladder):
+        # request_promote(devices) stashes the grant and sets the
+        # event; the sharded chunk loop drains its pipeline at the
+        # next chunk boundary and widens D -> 2D onto the granted
+        # devices (promote_step, parallel/engine.py). Engines without
+        # a widen-capable loop leave the event unread — a no-op.
+        self._promote_event = threading.Event()
+        self._promote_request = None
         # True once a StepDriver has claimed this run: the background
         # thread must never start on top of an externally driven run
         self._driven = False

@@ -322,6 +322,74 @@ class FaultAttributor:
         self._last, self._streak = None, 0
 
 
+def match_device(devices, ref) -> Optional[int]:
+    """Resolve one device reference onto a mesh position, or ``None``.
+
+    Shared by ``degrade_step`` and ``promote_step`` (parallel/
+    engine.py) so the two directions of the elastic ladder cannot
+    drift: a real PJRT fault names the GLOBAL device id; an injected
+    one may name the mesh position; a promote grant hands whole
+    ``jax.Device`` objects. Matching order — object identity, then the
+    global ``.id``, then the bare position fallback."""
+    if ref is None:
+        return None
+    devs = list(devices)
+    if not isinstance(ref, int):
+        for i, dv in enumerate(devs):
+            if dv is ref or dv == ref:
+                return i
+        ref = getattr(ref, "id", None)
+        if not isinstance(ref, int):
+            return None
+    ids = [getattr(dv, "id", None) for dv in devs]
+    if ref in ids:
+        return ids.index(ref)
+    if 0 <= ref < len(devs):
+        return ref
+    return None
+
+
+def select_survivors(devices, new_d: int, *, blamed_pos=None,
+                     labels=None) -> list:
+    """The width-``new_d`` survivor subset for one ladder rung.
+
+    On a multi-host mesh (``labels`` has more than one distinct value)
+    a blamed position takes its whole HOST out first — DCN partitions
+    and host deaths fault every chip behind that NIC — so the halved
+    mesh stays host-aligned; on one host only the blamed chip is
+    dropped. The kept prefix preserves host-major order, which is what
+    keeps the ``owner_of(fp, D/2)`` re-route identical to a cross-mesh
+    checkpoint resume."""
+    devs = list(devices)
+    if blamed_pos is not None:
+        if labels is not None and len(set(labels)) > 1:
+            bad = labels[blamed_pos]
+            devs = [dv for dv, h in zip(devs, labels) if h != bad]
+        else:
+            devs.pop(blamed_pos)
+    return devs[:new_d]
+
+
+def resolve_grant(universe, refs, exclude=()) -> list:
+    """Map a promote grant (``jax.Device`` objects, global ids, or
+    positions into ``universe``) onto concrete devices, dropping
+    duplicates, unresolvable refs, and anything in ``exclude`` (the
+    devices the mesh already holds)."""
+    universe = list(universe)
+    out: list = []
+    taken = {id(dv) for dv in exclude}
+    for ref in refs:
+        pos = match_device(universe, ref)
+        if pos is None:
+            continue
+        dv = universe[pos]
+        if id(dv) in taken:
+            continue
+        taken.add(id(dv))
+        out.append(dv)
+    return out
+
+
 class DegradePolicy:
     """The mesh degradation ladder (README § Resilience).
 

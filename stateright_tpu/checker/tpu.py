@@ -849,6 +849,36 @@ class TpuChecker(HostChecker):
                 "=...)")
         self._pause_event.set()
 
+    def request_promote(self, devices) -> None:
+        """Widen a sharded run D -> 2D at the next chunk boundary: the
+        chunk loop drains its pipeline, extends the mesh with (up to D
+        of) the granted ``devices``, re-routes the shadow's mirror and
+        pending frontier by ``owner_of(fp, 2D)`` with preload-aware
+        growth limits recomputed at the new width, recompiles, and
+        resumes — the exact mirror of one degradation-ladder rung, so
+        a job that degraded around a transient fault can climb back up
+        once the blamed chip is released healthy. Requires the host
+        shadow (``retries``/``autosave``/``max_capacity``); runs
+        without one — and non-sharded engines — quietly decline, and
+        a grant that cannot double the mesh (too few distinct new
+        devices, or 2D past the shard limit) is dropped at the
+        boundary rather than raising mid-run."""
+        grant = list(devices)
+        if not grant:
+            raise ValueError(
+                "request_promote() needs at least one device to widen "
+                "onto (pass the freed jax.Device objects, their global "
+                "ids, or jax.devices() positions)")
+        self._promote_request = grant
+        self._promote_event.set()
+
+    def promote_pending(self) -> bool:
+        """True while a ``request_promote`` grant awaits its
+        chunk-boundary decision (the flex controller steps the driver
+        until this clears, then reads the ``promotes`` counter to
+        learn whether the engine took or declined the grant)."""
+        return self._promote_event.is_set()
+
     # ------------------------------------------------------------------
     def _run(self) -> None:
         for _ in self._run_steps():

@@ -26,15 +26,105 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
 from .mesh import (ENV_COORDINATOR, ENV_CPU, ENV_LOCAL_DEVICES,
                    ENV_NUM_PROCS, ENV_RANK)
+
+_READY_RE = re.compile(r"rank(\d+)\.ready$")
+
+
+def write_ready_marker(out_dir: str, rank: int, **info) -> str:
+    """The worker half of the ready contract: land
+    ``out_dir/rank<k>.ready`` (JSON: ``rank`` plus whatever the worker
+    knows — ``local_devices``, ``global_devices``, ...) ATOMICALLY, so
+    a watcher never reads a half-written marker. A LATE rank writing
+    one is the rolling-join signal :func:`scan_ready` picks up."""
+    path = os.path.join(out_dir, f"rank{int(rank)}.ready")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict(info, rank=int(rank)), f)
+    os.replace(tmp, path)
+    return path
+
+
+def scan_ready(out_dir: str, seen: set) -> List[tuple]:
+    """One scan for rank ready markers: every ``rank<k>.ready`` not in
+    ``seen`` (marked as a side effect) returns as ``(rank, info)``.
+    Deliberately NOT bounded by the launched rank count — a marker from
+    a rank beyond the original fleet is how a rolling host join
+    announces itself mid-run."""
+    try:
+        names = os.listdir(out_dir)
+    except OSError:
+        return []
+    out = []
+    for name in sorted(names):
+        m = _READY_RE.match(name)
+        if m is None:
+            continue
+        rank = int(m.group(1))
+        if rank in seen:
+            continue
+        seen.add(rank)
+        info: dict = {}
+        try:
+            with open(os.path.join(out_dir, name)) as f:
+                info = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        out.append((rank, info))
+    return out
+
+
+def attach_ready_watcher(out_dir: str, scheduler, device_factory, *,
+                         seen: Optional[set] = None, trace=None,
+                         poll: float = 0.05):
+    """Bridge late ready markers into a live scheduler's device pool.
+
+    A daemon thread polls ``out_dir`` with :func:`scan_ready`; each NEW
+    rank marker becomes ``scheduler.join_host(f"rank{k}",
+    device_factory(rank, info))`` — the rolling-join path that widens
+    the two-level pool mid-run (queued jobs place wider; with the flex
+    controller on, hungry running jobs promote onto the new width).
+    ``seen`` pre-marks the ranks already part of the fleet; ``trace``
+    optionally receives a ``host_join`` per late rank on the LAUNCHER
+    stream (the scheduler emits its own on the service stream). Returns
+    a zero-argument stop callable (idempotent; joins the thread)."""
+    seen = set() if seen is None else seen
+    stop_event = threading.Event()
+
+    def _watch() -> None:
+        while not stop_event.is_set():
+            for rank, info in scan_ready(out_dir, seen):
+                devices = device_factory(rank, info)
+                if trace is not None:
+                    trace.emit("host_join", host=rank,
+                               devices=info.get("local_devices"),
+                               global_devices=info.get(
+                                   "global_devices"))
+                try:
+                    scheduler.join_host(f"rank{rank}", devices)
+                except (RuntimeError, ValueError):
+                    return  # scheduler shut down / duplicate label
+            stop_event.wait(poll)
+
+    thread = threading.Thread(target=_watch, daemon=True,
+                              name="stateright-ready-watcher")
+    thread.start()
+
+    def stop() -> None:
+        stop_event.set()
+        thread.join(timeout=5.0)
+
+    return stop
 
 
 def pick_port() -> int:
@@ -146,22 +236,14 @@ def launch_fleet(cmd: Sequence[str], num_procs: int, *,
         while True:
             codes = [p.poll() for p in procs]
             if trace is not None:
-                for rank in range(num_procs):
-                    if rank in joined:
-                        continue
-                    ready = os.path.join(out_dir, f"rank{rank}.ready")
-                    if os.path.exists(ready):
-                        joined.add(rank)
-                        info = {}
-                        try:
-                            with open(ready) as f:
-                                info = json.load(f)
-                        except (OSError, json.JSONDecodeError):
-                            pass
-                        trace.emit("host_join", host=rank,
-                                   devices=info.get("local_devices"),
-                                   global_devices=info.get(
-                                       "global_devices"))
+                # scan_ready is rank-unbounded on purpose: a marker
+                # from a rank BEYOND the launched fleet (a rolling
+                # host join) lands in fleet.jsonl like any other
+                for rank, info in scan_ready(out_dir, joined):
+                    trace.emit("host_join", host=rank,
+                               devices=info.get("local_devices"),
+                               global_devices=info.get(
+                                   "global_devices"))
             if all(c is not None for c in codes):
                 break
             failed = [r for r, c in enumerate(codes)

@@ -235,6 +235,25 @@ def fleet_mesh(axis: str = "shards", devices=None, host_map=None):
     return Mesh(np.asarray(picked), (axis,))
 
 
+def host_major(devices, host_map=None) -> list:
+    """Reorder a device list host-major (stable: hosts keep their
+    first-appearance order, devices keep their order within a host).
+    ``promote_step`` (parallel/engine.py) runs the widened mesh
+    through this so a mid-run host join lands host-aligned — the
+    degradation ladder's host rung can then drop a later-failing host
+    as a contiguous block, exactly as if the fleet had started wide."""
+    devices = list(devices)
+    order: List = []
+    groups: dict = {}
+    for d in devices:
+        h = device_host(d, host_map)
+        if h not in groups:
+            groups[h] = []
+            order.append(h)
+        groups[h].append(d)
+    return [d for h in order for d in groups[h]]
+
+
 # ----------------------------------------------------------------------
 # process-spanning host pulls
 # ----------------------------------------------------------------------

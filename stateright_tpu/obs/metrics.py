@@ -81,6 +81,15 @@ GLOSSARY: Dict[str, str] = {
                 "the surviving power-of-two device subset, D -> D/2 "
                 "-> ... -> single chip "
                 "(tpu_options(degrade=, min_mesh=))",
+    "promotes": "elastic scale-up rungs taken: a granted device subset "
+                "doubled the mesh D -> 2D at a drained chunk boundary "
+                "(Checker.request_promote / the scheduler's flex "
+                "controller) — the exact mirror of a degradation rung, "
+                "so a run that degraded around a transient fault can "
+                "climb back up the ladder",
+    "promote": "elastic scale-up passes: widening the mesh and "
+               "re-seeding the sharded carry at the new width "
+               "(promote_step, parallel/engine.py)",
     "autosaves": "resilience checkpoints written (periodic "
                  "tpu_options(autosave=...) snapshots plus the "
                  "exhausted-retries and capacity-terminal writes)",
@@ -228,6 +237,14 @@ GLOSSARY: Dict[str, str] = {
                    "device subsets for higher-priority work (the "
                    "victim re-queues and resumes from its pause "
                    "checkpoint, typically on a smaller subset)",
+    "demotes": "flex-controller demotions: over-width running jobs "
+               "preempted under queue pressure to resume on a smaller "
+               "subset (a subset of preemptions — only the ones the "
+               "SLO-driven flex controller initiated)",
+    "flex_width": "extra device-width currently leased to running "
+                  "jobs by in-place flex promotes (gauge — rises when "
+                  "the controller grants a doubling lease, falls back "
+                  "as promoted jobs finish or the engine declines)",
     "queue_depth": "jobs currently waiting for a device subset "
                    "(gauge; sampled after every scheduling pass)",
     # --- continuous verification fleet (soak/fuzz as service load) -----
@@ -323,7 +340,7 @@ GAUGES = frozenset({
     "mesh_shards", "fused", "engine", "fault_device", "history_ok",
     "shard_balance", "host_tier_keys", "queue_depth", "lanes",
     "hosts", "procs", "fused_unsupported", "cc_dedup_capacity",
-    "pool_busy_frac", "jobs_per_min", "burnin_frac",
+    "pool_busy_frac", "jobs_per_min", "burnin_frac", "flex_width",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

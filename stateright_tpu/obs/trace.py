@@ -95,6 +95,14 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # a degradation-ladder rung: the mesh halved onto the surviving
     # device subset (optional fields: the blamed device, the error)
     "degrade": frozenset({"from_shards", "to_shards"}),
+    # the elastic ladder's scale-UP rung (parallel/engine.py
+    # promote_step): the mesh doubled onto a granted device subset at
+    # a drained chunk boundary — the exact mirror of `degrade`
+    # (optional field: the granted device ids); `host_promote` records
+    # each NEW host the widened mesh spans (the reverse of the host
+    # rung's `host_drop`; optional from/to shard widths)
+    "promote": frozenset({"from_shards", "to_shards"}),
+    "host_promote": frozenset({"host"}),
     # memory tiering (checker/resilience.py SpillPolicy): `evict`
     # records the range selection (how many fingerprint-prefix ranges
     # were newly evicted and how many keys they held), `spill` the
@@ -162,6 +170,15 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     "job_pause": frozenset({"job", "reason"}),
     "job_resume": frozenset({"job", "width"}),
     "job_done": frozenset({"job", "state"}),
+    # the scheduler's flex controller (README § Elastic fleet):
+    # `job_promote` — freed pool width granted to a running
+    # width-hungry job (in place via Checker.request_promote, or
+    # through the pause/resume-wider checkpoint path; `width` is the
+    # new width); `job_demote` — an over-width job preempted under
+    # queue pressure to resume on a smaller subset (`width` is the
+    # width it gave up)
+    "job_promote": frozenset({"job", "width"}),
+    "job_demote": frozenset({"job", "width"}),
     # burn-in mode (README § Continuous verification): a low-priority
     # background soak/fuzz job was preempted at an op-count boundary to
     # free its device subset for a real checking job — it re-queues and

@@ -154,7 +154,9 @@ class ShardedTpuChecker(TpuChecker):
         from ..checker.resilience import (FaultAttributor, FaultKind,
                                           blamed_device, classify_error,
                                           find_candidate_overflow,
-                                          gather_rows, pack_qrows,
+                                          gather_rows, match_device,
+                                          pack_qrows, resolve_grant,
+                                          select_survivors,
                                           spill_eligible)
 
         policy = self._retry_policy
@@ -638,6 +640,11 @@ class ShardedTpuChecker(TpuChecker):
                     or self._pause_event.is_set()):
                 acts.add("done")
                 return acts
+            if self._promote_event.is_set() and shadow is not None:
+                # elastic scale-up request (request_promote): surface
+                # it as an act so the intervention path below drains
+                # the double-buffered pipeline before the mesh widens
+                acts.add("promote")
             need_grow = (int(log_n.max()) >= grow_limit
                          or int(q_tail.max()) > qcap // D - headroom)
             if need_grow:
@@ -904,28 +911,19 @@ class ShardedTpuChecker(TpuChecker):
             host_map = opts.get("host_map")
             labels = [device_host(dv, host_map) for dv in devs]
             hosts_before = set(labels)
-            pos = None
-            if blamed is not None:
-                # a real PJRT fault names the GLOBAL device id; an
-                # injected one may name the mesh position — match id
-                # first, fall back to position
-                ids = [getattr(d, "id", None) for d in devs]
-                if blamed in ids:
-                    pos = ids.index(blamed)
-                elif 0 <= blamed < len(devs):
-                    pos = blamed
-            if len(hosts_before) > 1 and pos is not None:
-                # HOST RUNG: on a multi-host mesh a blamed chip takes
-                # its whole HOST down the ladder (DCN partitions and
-                # host deaths fault every chip behind that NIC) — the
-                # survivors are host-major, so the halved mesh stays
-                # host-aligned and the owner_of(fp, D/2) re-route is
-                # exactly the chip rung's math
-                bad = labels[pos]
-                devs = [dv for dv, h in zip(devs, labels) if h != bad]
-            elif pos is not None:
-                devs.pop(pos)
-            keep = devs[:new_d]
+            # survivor selection is shared with promote_step
+            # (checker/resilience.py) so the ladder's two directions
+            # cannot drift: a real PJRT fault names the GLOBAL device
+            # id, an injected one may name the mesh position (id match
+            # first, position fallback); on a multi-host mesh the HOST
+            # RUNG takes the blamed chip's whole host down the ladder
+            # (DCN partitions and host deaths fault every chip behind
+            # that NIC) — the survivors stay host-major, so the halved
+            # mesh stays host-aligned and the owner_of(fp, D/2)
+            # re-route is exactly the chip rung's math
+            pos = match_device(devs, blamed)
+            keep = select_survivors(devs, new_d, blamed_pos=pos,
+                                    labels=labels)
             hosts_after = {device_host(dv, host_map) for dv in keep}
             self._metrics.inc("degrades")
             self._metrics.set("mesh_shards", new_d)
@@ -968,6 +966,87 @@ class ShardedTpuChecker(TpuChecker):
             shadow.reshard(D)
             return False
 
+        def promote_step() -> bool:
+            # the scale-UP mirror of degrade_step (one rung back up the
+            # elastic ladder): at a drained chunk boundary, extend the
+            # mesh with D of the granted devices, re-route the shadow's
+            # mirror + pending frontier by owner_of(fp, 2D) with the
+            # preload-aware growth limits recomputed at the new width,
+            # recompile, and resume D -> 2D. The reseed that follows is
+            # exactly a cross-mesh checkpoint resume, so it composes
+            # with spill tiering for free: evicted prefix ranges
+            # (SPILL_PREFIX_BITS top bits) re-nest inside the wider
+            # shard ownership and stay on the host tier. A grant that
+            # cannot double the mesh is declined quietly — the run
+            # resumes at the old width rather than dying mid-flight.
+            nonlocal mesh, D, insert_fn, headroom, size_key, ecap, \
+                recover_reason
+            grant_refs = self._promote_request
+            self._promote_request = None
+            self._promote_event.clear()
+            if not grant_refs or shadow is None:
+                return False
+            new_d = D * 2
+            if new_d > MAX_MESH_SHARDS:
+                return False
+            devs = list(mesh.devices.flat)
+            grant = resolve_grant(jax.devices(), grant_refs,
+                                  exclude=devs)
+            if len(grant) < D:
+                return False  # doubling needs D fresh distinct chips
+            grant = grant[:D]
+            # budget viability at the new width: doubling the mesh
+            # doubles the kmax share of the per-iteration headroom,
+            # and under a tight HBM budget (max_capacity) the wider
+            # mesh may no longer fit that headroom below each shard's
+            # growth limit — decline rather than trade a viable narrow
+            # run for a capacity-terminal wide one (the same bound
+            # handle_spill treats as terminal)
+            cap = self._capacity
+            new_head = max(new_d * kmax, fmax)
+            while self._grow_at * (cap // new_d) <= new_head + 1 \
+                    and spill_pol.can_grow(cap):
+                cap *= 4
+            if self._grow_at * (cap // new_d) <= new_head + 1:
+                return False
+            from ..cluster.mesh import device_host, host_major
+            hosts_before = {device_host(dv, host_map) for dv in devs}
+            # host-major so a join lands host-aligned: a later HOST
+            # RUNG can drop the joined host as a contiguous block
+            keep = host_major(devs + grant, host_map)
+            hosts_after = {device_host(dv, host_map) for dv in keep}
+            while self._capacity % new_d:
+                self._capacity *= 2
+            while ecap and ecap % new_d:
+                ecap *= 2
+            self._metrics.inc("promotes")
+            self._metrics.set("mesh_shards", new_d)
+            self._metrics.set("hosts", len(hosts_after))
+            if self._trace:
+                self._trace.emit(
+                    "promote", from_shards=D, to_shards=new_d,
+                    devices=[getattr(dv, "id", None) for dv in grant])
+                for h in sorted(hosts_after - hosts_before, key=str):
+                    self._trace.emit("host_promote", host=h,
+                                     from_shards=D, to_shards=new_d)
+            # the blame streak was pinned at the old width; a fresh
+            # mesh must not inherit it (mirrors the taken-rung clear)
+            attributor.clear()
+            from jax.sharding import Mesh
+            mesh = self._mesh = Mesh(np.asarray(keep), (axis,))
+            D = new_d
+            self._fault_shards = D
+            insert_fn = build_sharded_insert(mesh, axis)
+            headroom = max(D * kmax, fmax)
+            mk = model_cache_key(model)
+            size_key = ((mk, fmax, self._sound, self._symmetry, D)
+                        if mk is not None else None)
+            shadow.reshard(D)
+            recover_reason = "promote"
+            with self._timed("promote"):
+                reseed()
+            return True
+
         fault_attempt = 0
         spill_attempt = 0
         recover_delay = None
@@ -1004,6 +1083,13 @@ class ShardedTpuChecker(TpuChecker):
                         handle_kovf()
                     elif "done" in acts:
                         break
+                    elif "promote" in acts:
+                        # widen before considering growth: the promote
+                        # reseed re-runs the preload-aware grow loop at
+                        # the new width, subsuming a pending "grow"; a
+                        # declined grant resumes at the old width and
+                        # the next chunk re-raises any growth pressure
+                        promote_step()
                     elif "grow" in acts:
                         # budget-aware growth: grow while the HBM
                         # budget allows, spill to the host tier once

@@ -21,9 +21,12 @@ Scheduling policy:
 * queued jobs place in (priority desc, submission order) — a job asks
   for ``width`` devices and is granted the largest free power-of-two
   block ≤ its request (down to 1);
-* a running job's mesh width NEVER changes mid-flight — only at a
-  pause/resume boundary, riding the ladder's existing cross-mesh
-  resume machinery (the checkpoint format is shard-agnostic);
+* a running job's mesh width changes only at a chunk boundary: by
+  default via pause/resume (the checkpoint format is shard-agnostic);
+  with ``flex=True`` the elastic controller may also DOUBLE a hungry
+  running job in place (``Checker.request_promote`` — the degradation
+  ladder run upward) when buddies merge free and the queue is empty,
+  and demote over-width jobs first under queue pressure;
 * **preemption**: when nothing is free and a queued job outranks a
   running one, the lowest-priority victim is paused (checkpoint
   written, subset released) and re-queued to resume on whatever subset
@@ -136,10 +139,19 @@ class DevicePool:
             {} for _ in range(nh)]
         # level 2: free blocks of whole hosts, in host units
         self._free_hosts: Dict[int, set] = {nh: {0}}
+        # hosts withdrawn mid-run (rolling leave): their indices stay
+        # valid — offsets are positional — but their free blocks are
+        # gone and release() DISCARDS their returning blocks instead
+        # of merging them back, so the host drains as leases end
+        self._retired: set = set()
 
     @property
     def host_count(self) -> int:
         return len(self.host_labels)
+
+    @property
+    def active_host_count(self) -> int:
+        return len(self.host_labels) - len(self._retired)
 
     def _host_of_offset(self, offset: int) -> int:
         return offset // self.host_width
@@ -213,9 +225,18 @@ class DevicePool:
         hw = self.host_width
         if width > hw:
             h, k = offset // hw, width // hw
+            if self._retired & set(range(h, h + k)):
+                # the fleet lease touched a retired host: hand back
+                # only the still-active hosts, one by one
+                for i in range(h, h + k):
+                    if i not in self._retired:
+                        self._merge_hosts(i, 1)
+                return
             self._merge_hosts(h, k)
             return
         hi = self._host_of_offset(offset)
+        if hi in self._retired:
+            return  # the host left the fleet; its block leaves the pool
         free = self._local_free[hi]
         while width < hw:  # merge with the free buddy (host-local)
             rel = offset - hi * hw
@@ -261,9 +282,13 @@ class DevicePool:
 
     def per_host_free(self) -> Dict:
         """Free device count per host label (the fleet-utilization
-        view bench's multihost smoke and operators read)."""
-        out = {h: 0 for h in self.host_labels}
+        view bench's multihost smoke and operators read). Retired
+        hosts are omitted — they are no longer capacity."""
+        out = {h: 0 for hi, h in enumerate(self.host_labels)
+               if hi not in self._retired}
         for hi, free in enumerate(self._local_free):
+            if hi in self._retired:
+                continue
             out[self.host_labels[hi]] += sum(
                 s * len(offs) for s, offs in free.items())
         for s, offs in self._free_hosts.items():
@@ -271,6 +296,57 @@ class DevicePool:
                 for hi in range(h, h + s):
                     out[self.host_labels[hi]] += self.host_width
         return out
+
+    # --- elastic fleet: rolling host join / leave ----------------------
+    def add_host(self, label, devices) -> int:
+        """Register a freshly-ready host's devices as new free pool
+        width MID-RUN (the rolling-join half of the elastic fleet).
+
+        The host lands as one fully-free level-2 block and buddy-merges
+        with its aligned neighbors, so joining the 4th host of a
+        2-wide fleet restores a fleet-level width-4·hw block. A host
+        count that is momentarily not a power of two degrades
+        gracefully — the odd host serves slice-level and single-host
+        work until its buddy arrives. Brings exactly ``host_width``
+        devices into play (extras are ignored, keeping every host's
+        contribution uniform); returns the new host index."""
+        devices = list(devices)
+        if label in self.host_labels:
+            raise ValueError(f"host {label!r} is already in the pool")
+        if len(devices) < self.host_width:
+            raise ValueError(
+                f"a joining host must bring at least host_width="
+                f"{self.host_width} devices (got {len(devices)})")
+        h = len(self.host_labels)
+        self.host_labels.append(label)
+        self._devices.extend(devices[:self.host_width])
+        self._local_free.append({})
+        self.width += self.host_width
+        self._merge_hosts(h, 1)
+        return h
+
+    def retire_host(self, label) -> List:
+        """Withdraw a host's FREE width so nothing new lands there
+        (the rolling-leave half). Busy slices drain as their leases
+        release — ``release`` discards a retired host's blocks instead
+        of merging them back. Level-2 blocks spanning the host are
+        broken up and their still-active hosts re-freed. Returns the
+        withdrawn device objects."""
+        hi = self.host_labels.index(label)
+        if hi in self._retired:
+            raise ValueError(f"host {label!r} is already retired")
+        self._retired.add(hi)
+        for s, offs in list(self._free_hosts.items()):
+            for h in list(offs):
+                if h <= hi < h + s:
+                    offs.discard(h)
+                    for k in range(h, h + s):
+                        if k != hi and k not in self._retired:
+                            self._merge_hosts(k, 1)
+        self._local_free[hi] = {}
+        self.width -= self.host_width
+        hw = self.host_width
+        return self._devices[hi * hw:(hi + 1) * hw]
 
 
 class _JobRuntime:
@@ -280,7 +356,7 @@ class _JobRuntime:
 
     __slots__ = ("lease", "thread", "checker", "driver", "_control",
                  "_ctl_lock", "granted_at", "first_chunk_seen",
-                 "burnin")
+                 "burnin", "promote_lease", "flexed_at")
 
     def __init__(self, lease: DeviceLease):
         self.lease = lease
@@ -296,11 +372,22 @@ class _JobRuntime:
         #: burn-in lane marker (set at launch) — the utilization
         #: sampler splits pool occupancy into burnin_frac with it
         self.burnin = False
+        #: the SECOND lease a flex promote granted (the in-place
+        #: widen): held until the job exits, or released immediately
+        #: when the engine declines the grant at the chunk boundary
+        self.promote_lease: Optional[DeviceLease] = None
+        #: last flex action stamp (per-job hysteresis window)
+        self.flexed_at = 0.0
 
     def set_control(self, ctl: str) -> None:
         with self._ctl_lock:
-            # cancel beats pause; otherwise first request wins
-            if self._control is None or ctl == "cancel":
+            # cancel beats pause; a pending flex promote yields to
+            # ANY other request (widening is opportunistic — a pause/
+            # preempt/cancel racing it must not be dropped); otherwise
+            # first request wins
+            if self._control is None or ctl == "cancel" \
+                    or (self._control == "promote"
+                        and ctl != "promote"):
                 self._control = ctl
 
     def take_control(self) -> Optional[str]:
@@ -343,7 +430,8 @@ class Scheduler:
                  batch_lanes: Optional[int] = None,
                  batch_wait: Optional[float] = None, hosts=None,
                  burnin: Optional[dict] = None,
-                 corpus_dir: Optional[str] = None):
+                 corpus_dir: Optional[str] = None,
+                 flex: bool = False, flex_interval: float = 5.0):
         from .batch import DEFAULT_LANES, DEFAULT_MAX_WAIT
         self._store = store if isinstance(store, JobStore) \
             else JobStore(store)
@@ -404,6 +492,21 @@ class Scheduler:
         #: at tests/soak_seeds to feed the regression corpus; None
         #: keeps artifacts inside each job's directory
         self._corpus_dir = corpus_dir
+        # --- elastic flex controller (promote-on-freed-width) ----------
+        #: opt-in: the default keeps the historical "a running job's
+        #: width never changes mid-flight" contract for existing
+        #: deployments. With ``flex=True`` every placement pass that
+        #: leaves the queue empty may widen ONE hungry running job
+        #: (granted < requested) onto freed width — in place for
+        #: width>=2 sharded jobs, via checkpoint migration for singles
+        self._flex = bool(flex)
+        #: hysteresis window between flex actions (fleet-wide AND
+        #: per-job), bounding promote/demote churn under bursty load
+        self._flex_interval = float(flex_interval)
+        self._flex_last = 0.0
+        #: extra device-width currently out on promote leases (the
+        #: flex_width gauge; symmetric grant/release accounting)
+        self._flex_extra = 0
         if recover:
             self._recover()
             # boot placement pass: recovered RUNNING jobs (and any
@@ -548,6 +651,53 @@ class Scheduler:
                 if t is not None:
                     t.join(max(0.0, deadline - time.monotonic()))
         self._trace.close()
+
+    # --- elastic fleet: rolling host join / leave ----------------------
+    def join_host(self, label, devices) -> int:
+        """Rolling host join: register a freshly-ready host's devices
+        as new free pool width MID-RUN and immediately re-run
+        placement — queued jobs place wider, and with ``flex=True``
+        hungry running jobs promote onto the widened fleet. Emits
+        ``host_join`` (the same event the fleet launcher stamps when a
+        rank's ready marker lands). Returns the new host index."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is shut down")
+            self._ensure_pool()
+            hi = self._pool.add_host(label, devices)
+            self._metrics.set("hosts", self._pool.active_host_count)
+            self._trace.emit("host_join", host=str(label),
+                             devices=self._pool.host_width)
+        self._schedule()
+        return hi
+
+    def leave_host(self, label) -> List:
+        """Rolling host leave: withdraw the host's free width so
+        nothing new lands there, then preempt every job whose lease
+        touches it — each checkpoints at its next chunk boundary and
+        re-places on the remaining fleet through the shard-agnostic
+        resume path (the demote mirror of :meth:`join_host`). Returns
+        the withdrawn device objects."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is shut down")
+            self._ensure_pool()
+            if label not in self._pool.host_labels:
+                raise ValueError(f"unknown host {label!r}")
+            gone = self._pool.retire_host(label)
+            self._metrics.set("hosts", self._pool.active_host_count)
+            self._trace.emit("host_drop", host=str(label))
+            for rt in self._running.values():
+                if label in rt.lease.hosts or (
+                        rt.promote_lease is not None
+                        and label in rt.promote_lease.hosts):
+                    rt.set_control("preempt")
+            for brt in self._batch_running.values():
+                if label in brt.lease.hosts:
+                    # batched lanes checkpoint and their jobs requeue
+                    brt.set_control("shutdown")
+        self._schedule()
+        return gone
 
     # --- recovery ------------------------------------------------------
     def _recover(self) -> None:
@@ -866,6 +1016,12 @@ class Scheduler:
                     self._maybe_preempt(job)
                     continue
                 self._launch(job, lease)
+            # flex BEFORE burn-in: a finishing job's buddy-merged width
+            # goes to a promotion-eligible RUNNING job first (this pass
+            # runs on every release, fixing the historical gap where
+            # freed width was only ever offered to QUEUED jobs), and
+            # only what flex declines is soaked by burn-in below
+            self._flex_pass()
             # burn-in AFTER real placement: leftover free width is
             # soaked with low-priority fuzz work (re-queued burn-in
             # jobs re-place through the queued loop above first, so
@@ -915,11 +1071,71 @@ class Scheduler:
                              kind=spec.kind)
             self._launch(job, lease)
 
+    # --- elastic flex controller (promote-on-freed-width) --------------
+    def _flex_pass(self) -> None:
+        """Scale-UP policy pass (caller holds the lock; no-op unless
+        ``flex=True``): when placement left the queue EMPTY and buddy
+        merge-back freed width, widen the hungriest RUNNING job
+        instead of letting the width idle. Width>=2 sharded jobs
+        double IN PLACE — the pool grants a second lease of equal
+        width and the worker hands it to the live engine
+        (``Checker.request_promote``, the degradation ladder run
+        upward); width-1 singles have no mesh to widen and migrate
+        through the shard-agnostic checkpoint instead (pause +
+        requeue; the queued loop re-grants wider). One action per pass
+        under a ``flex_interval`` hysteresis window (fleet-wide and
+        per-job), so promote/demote cannot thrash against bursty
+        arrivals."""
+        if not self._flex or self._closed or self._pool is None:
+            return
+        if any(j.state == jobstates.QUEUED and j.id not in self._running
+               for j in self._store.jobs()):
+            return  # queued work outranks widening anyone
+        now = time.monotonic()
+        if now - self._flex_last < self._flex_interval:
+            return
+        cands = []
+        for jid, rt in self._running.items():
+            job = self._store.get(jid)
+            # a still-compiling job (rt.checker None) stays eligible:
+            # the control slot holds the promote until its worker
+            # loop starts, so a host joining mid-compile is not lost
+            if job is None or rt.burnin \
+                    or job.spec.kind != KIND_CHECK \
+                    or rt.promote_lease is not None:
+                continue
+            hunger = min(job.spec.width, self._pool.width) \
+                - rt.lease.width
+            if hunger <= 0 or now - rt.flexed_at < self._flex_interval:
+                continue
+            cands.append((hunger, -job.priority, job.seq, job, rt))
+        # widest-hungry first; priority then age break ties
+        cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+        for _hunger, _pri, _seq, job, rt in cands:
+            if rt.lease.width >= 2:
+                extra = self._pool.acquire(rt.lease.width)
+                if extra is None:
+                    continue  # freed width doesn't fit a double; next
+                rt.promote_lease = extra
+                self._flex_extra += extra.width
+                self._metrics.set("flex_width", self._flex_extra)
+                rt.flexed_at = self._flex_last = now
+                rt.set_control("promote")
+            else:
+                if self._pool.largest_free() < 2:
+                    continue  # a wash: it would resume at width 1
+                rt.flexed_at = self._flex_last = now
+                rt.set_control("promote_migrate")
+            return  # one flex action per pass (hysteresis)
+
     def _maybe_preempt(self, job: Job) -> None:
         """Nothing is free and ``job`` waits: pause the lowest-priority
         RUNNING job it strictly outranks (the victim checkpoints,
         releases its subset, and re-queues to resume on a smaller
-        one)."""
+        one). With flex enabled, over-width victims are DEMOTED first
+        — same pause-and-requeue mechanics, but it frees more width
+        per victim and shows up as the scale-down half of the elastic
+        ladder (``job_demote`` / ``demotes``)."""
         victims = [(self._store.get(jid), rt)
                    for jid, rt in self._running.items()]
         victims = [(vj, rt) for vj, rt in victims
@@ -927,6 +1143,11 @@ class Scheduler:
         if not victims:
             return
         victims.sort(key=lambda pair: (pair[0].priority, -pair[0].seq))
+        if self._flex:
+            for vj, rt in victims:
+                if rt.lease.width > 1 or rt.promote_lease is not None:
+                    rt.set_control("demote")
+                    return
         victims[0][1].set_control("preempt")
 
     def _launch(self, job: Job, lease: DeviceLease) -> None:
@@ -939,6 +1160,17 @@ class Scheduler:
         # GRANTS the subset (compile/seed latency is first_chunk_s's
         # problem, not queueing's)
         job.status["granted_at"] = rt.granted_at
+        # a width-1 job the flex controller migrated through its
+        # checkpoint lands here for the wider grant: the promote is
+        # only real if the pool actually granted MORE than it had
+        prev_w = job.status.get("granted_width")
+        if job.status.pop("flex_migrate", None) \
+                and prev_w and lease.width > int(prev_w):
+            self._metrics.inc("promotes")
+            self._trace.emit("job_promote", job=job.id,
+                             width=lease.width,
+                             hosts=[str(h) for h in lease.hosts],
+                             migrated=True)
         queued_at = job.status.get("queued_at")
         if queued_at is not None:
             self._metrics.add_time(
@@ -981,6 +1213,12 @@ class Scheduler:
             with self._lock:
                 self._running.pop(job.id, None)
                 self._pool.release(lease)
+                extra = rt.promote_lease
+                rt.promote_lease = None
+                if extra is not None:
+                    self._pool.release(extra)
+                    self._flex_extra -= extra.width
+                    self._metrics.set("flex_width", self._flex_extra)
             self._schedule()
 
     def _drive_job(self, job: Job, lease: DeviceLease,
@@ -1031,14 +1269,38 @@ class Scheduler:
             delay = job.spec.step_delay
             while True:
                 ctl = rt.take_control()
-                if ctl in ("pause", "preempt", "shutdown"):
+                if ctl == "promote":
+                    status = self._apply_promote(job, lease, rt,
+                                                 checker, driver)
+                    if status != RUNNING:
+                        self._finish_job(job, checker, driver)
+                        return
+                    continue
+                if ctl in ("pause", "preempt", "demote",
+                           "promote_migrate", "shutdown"):
                     checker.request_pause()
                     driver.drain()
                     if checker.paused():
-                        if ctl == "preempt":
+                        if ctl in ("preempt", "demote"):
                             self._metrics.inc("preemptions")
+                            if ctl == "demote":
+                                w = lease.width + (
+                                    rt.promote_lease.width
+                                    if rt.promote_lease is not None
+                                    else 0)
+                                self._metrics.inc("demotes")
+                                self._trace.emit("job_demote",
+                                                 job=job.id, width=w)
                             job.set_state(jobstates.QUEUED,
                                           resume=True, preempted=True)
+                        elif ctl == "promote_migrate":
+                            # flex scale-up for a width-1 single: ride
+                            # the shard-agnostic checkpoint — requeue,
+                            # let the placement loop re-grant wider,
+                            # and _launch emits the job_promote
+                            job.set_state(jobstates.QUEUED,
+                                          resume=True,
+                                          flex_migrate=True)
                         elif ctl == "shutdown":
                             # graceful stop: re-enqueue so the next
                             # boot resumes it without an operator
@@ -1047,9 +1309,11 @@ class Scheduler:
                             job.set_state(jobstates.PAUSED, resume=True)
                         self._trace.emit(
                             "job_pause", job=job.id,
-                            reason=("preempt" if ctl == "preempt"
-                                    else "shutdown"
-                                    if ctl == "shutdown" else "user"))
+                            reason={"preempt": "preempt",
+                                    "demote": "preempt",
+                                    "promote_migrate": "promote",
+                                    "shutdown": "shutdown"}.get(
+                                        ctl, "user"))
                         return
                     # the run finished before the pause landed
                     self._finish_job(job, checker, driver)
@@ -1078,6 +1342,55 @@ class Scheduler:
                 if status != RUNNING:
                     self._finish_job(job, checker, driver)
                     return
+
+    def _apply_promote(self, job: Job, lease: DeviceLease,
+                       rt: _JobRuntime, checker, driver) -> str:
+        """Hand the flex grant to the LIVE engine (worker thread): ask
+        for the in-place double (``Checker.request_promote``) and step
+        the driver until the next chunk boundary takes the decision.
+        The engine may decline — no host shadow, or the doubled mesh
+        would be budget-unviable — and a declined grant's lease merges
+        straight back, so the width was only reserved, never wasted.
+        Applied grants stay leased until the job exits (released with
+        the base lease in ``_run_job``)."""
+        extra = rt.promote_lease
+        status = RUNNING
+        applied = False
+        if extra is not None and lease.width >= 2:
+            before = int(checker.profile().get("promotes", 0) or 0)
+            checker.request_promote(list(extra.devices))
+            spins = 0
+            while status == RUNNING and checker.promote_pending() \
+                    and spins < 256:
+                status = driver.step(1)
+                spins += 1
+            if checker.promote_pending():
+                # no decision landed (the run ended first, or the
+                # engine has no chunk boundary to decide at): keep
+                # the lease reserved — it releases with the job, and
+                # releasing it NOW could hand devices the engine may
+                # still widen onto to another tenant
+                return status
+            applied = int(checker.profile().get(
+                "promotes", 0) or 0) > before
+        if applied:
+            width = lease.width + extra.width
+            hosts: list = []
+            for h in (*lease.hosts, *extra.hosts):
+                if str(h) not in hosts:
+                    hosts.append(str(h))
+            self._metrics.inc("promotes")
+            job.set_state(jobstates.RUNNING, granted_width=width,
+                          hosts=hosts)
+            self._trace.emit("job_promote", job=job.id, width=width,
+                             hosts=[str(h) for h in extra.hosts])
+        elif extra is not None:
+            with self._lock:
+                rt.promote_lease = None
+                self._pool.release(extra)
+                self._flex_extra -= extra.width
+                self._metrics.set("flex_width", self._flex_extra)
+        return status
 
     # --- the soak/fuzz worker (continuous verification fleet) ----------
     def _drive_soak(self, job: Job, lease: DeviceLease,

@@ -315,6 +315,68 @@ class TestMultiProcess:
         assert result["unique"] == solo.unique_state_count()
 
 
+# --- rolling host join: the ready-marker contract ----------------------
+
+class TestReadyMarkers:
+    """cluster/launch.py's ready contract: workers land atomic
+    ``rank<k>.ready`` JSON markers; ``scan_ready`` is idempotent over a
+    ``seen`` set and deliberately unbounded by the launched rank count
+    — a LATE rank's marker is the rolling-join signal — and
+    ``attach_ready_watcher`` bridges it into a live scheduler as
+    ``join_host``. No devices involved: the bridge is pure files."""
+
+    def test_write_and_scan_are_idempotent(self, tmp_path):
+        from stateright_tpu.cluster.launch import (scan_ready,
+                                                   write_ready_marker)
+        seen: set = set()
+        assert scan_ready(str(tmp_path), seen) == []
+        write_ready_marker(str(tmp_path), 0, local_devices=2)
+        write_ready_marker(str(tmp_path), 1, local_devices=2,
+                           shards=4)
+        got = scan_ready(str(tmp_path), seen)
+        assert [r for r, _ in got] == [0, 1]
+        assert got[0][1]["local_devices"] == 2
+        assert got[1][1]["shards"] == 4
+        assert scan_ready(str(tmp_path), seen) == []  # all seen
+        # no half-written marker is ever visible (atomic replace)
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.endswith(".tmp")]
+        # a LATE rank beyond the original fleet is still picked up
+        write_ready_marker(str(tmp_path), 2, local_devices=2)
+        assert [r for r, _ in scan_ready(str(tmp_path), seen)] == [2]
+
+    def test_watcher_bridges_late_ranks_to_join_host(self, tmp_path):
+        import time as _time
+
+        from stateright_tpu.cluster.launch import (attach_ready_watcher,
+                                                   write_ready_marker)
+
+        class FakeScheduler:
+            def __init__(self):
+                self.joined = []
+
+            def join_host(self, label, devices):
+                self.joined.append((label, list(devices)))
+
+        sched = FakeScheduler()
+        seen = {0, 1}  # the original fleet: never re-joined
+        write_ready_marker(str(tmp_path), 0, local_devices=2)
+        stop = attach_ready_watcher(
+            str(tmp_path), sched,
+            lambda rank, info: [rank * 10 + i
+                                for i in range(info["local_devices"])],
+            seen=seen, poll=0.01)
+        try:
+            write_ready_marker(str(tmp_path), 2, local_devices=2)
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and not sched.joined:
+                _time.sleep(0.01)
+        finally:
+            stop()
+            stop()  # idempotent
+        assert sched.joined == [("rank2", [20, 21])]
+
+
 # --- bench contract + bench_history tag --------------------------------
 
 class TestBenchMultihostSmoke:

@@ -24,7 +24,7 @@ jax = pytest.importorskip("jax")
 
 from stateright_tpu.checker.resilience import (  # noqa: E402
     CAPACITY_MARKERS, ChunkDeadlineError, FaultKind, RetryPolicy,
-    classify_error)
+    classify_error, match_device, resolve_grant, select_survivors)
 from stateright_tpu.examples.paxos_packed import PackedPaxos  # noqa: E402
 from stateright_tpu.models.twopc import TwoPhaseSys  # noqa: E402
 
@@ -793,3 +793,212 @@ class TestBenchContract:
         assert payload["partial"] is True
         assert isinstance(payload["failed"], list) and payload["failed"]
         assert "device-pipelined" in payload["failed"]
+
+
+# --- elastic ladder, upward rung ---------------------------------------
+
+class _Dev:
+    """A stand-in ``jax.Device``: a global ``.id`` at a mesh position
+    (the survivor-selection helpers never touch real hardware)."""
+
+    def __init__(self, id):
+        self.id = id
+
+    def __repr__(self):
+        return f"_Dev({self.id})"
+
+
+class TestSurvivorHelpers:
+    """The shared ladder arithmetic (checker/resilience.py): both
+    ``degrade_step`` and ``promote_step`` resolve device references and
+    pick survivor subsets through these, so the two directions cannot
+    drift."""
+
+    def test_match_device_by_object_then_id_then_position(self):
+        devs = [_Dev(100), _Dev(101), _Dev(102)]
+        assert match_device(devs, devs[1]) == 1      # object identity
+        assert match_device(devs, 102) == 2          # global id
+        assert match_device(devs, _Dev(100)) == 0    # foreign obj, .id
+        assert match_device(devs, 1) == 1            # position fallback
+        assert match_device(devs, None) is None
+        assert match_device(devs, 999) is None
+        assert match_device(devs, object()) is None  # no .id at all
+
+    def test_select_survivors_single_host_drops_only_the_blamed_chip(
+            self):
+        devs = [_Dev(i) for i in range(4)]
+        assert select_survivors(devs, 2, blamed_pos=3) == devs[:2]
+        assert select_survivors(devs, 2, blamed_pos=0) == devs[1:3]
+        assert select_survivors(devs, 2) == devs[:2]  # no blame: prefix
+
+    def test_select_survivors_multi_host_drops_the_whole_host(self):
+        # a blamed chip takes its HOST out (DCN partitions fault every
+        # chip behind that NIC), keeping the halved mesh host-aligned
+        devs = [_Dev(i) for i in range(4)]
+        labels = ["a", "a", "b", "b"]
+        assert select_survivors(devs, 2, blamed_pos=2,
+                                labels=labels) == devs[:2]
+        assert select_survivors(devs, 2, blamed_pos=1,
+                                labels=labels) == devs[2:]
+
+    def test_resolve_grant_dedups_and_excludes_the_current_mesh(self):
+        universe = [_Dev(i + 100) for i in range(4)]
+        got = resolve_grant(
+            universe,
+            [universe[2], 103, 0, 103, object()],  # obj, id, pos, dup
+            exclude=(universe[0],))                # mesh already holds
+        assert got == [universe[2], universe[3]]
+        assert resolve_grant(universe, [999, None]) == []
+
+
+@pytest.fixture(scope="module")
+def clean_2pc3_d4():
+    """One uninterrupted D=4 oracle run (the promote parity target)."""
+    return _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                chunk_steps=2, mesh=_mesh(4))
+
+
+def _promote_mid_run(ck, grant, timeout=180.0):
+    """Drive ``ck`` one quantum, hand it ``grant``, and run to the end:
+    the widening lands at the next chunk boundary, genuinely mid-run."""
+    from stateright_tpu.service import RUNNING, StepDriver
+    drv = StepDriver(ck).start()
+    drv.step(1)
+    ck.request_promote(list(grant))
+    deadline = time.monotonic() + timeout
+    while (drv.status == RUNNING and ck.promote_pending()
+           and time.monotonic() < deadline):
+        drv.step(1)
+    drv.drain()
+    return ck
+
+
+class TestPromote:
+    """Acceptance (elastic fleet): ``request_promote`` doubles a
+    sharded run D=2 -> D=4 at a chunk boundary with discoveries and
+    fingerprint sets bit-identical to an uninterrupted D=4 run,
+    pipelined and synchronous; the widening composes with host-tier
+    spill; and a run that degraded around a blame streak climbs BACK
+    to its original width once the blamed chip is released healthy."""
+
+    def test_promote_doubles_mesh_pipelined(self, clean_2pc3_d4):
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("need 4 devices")
+        trace = []
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, retries=1, backoff=0.0,
+                           mesh=_mesh(2), trace=trace)
+              .spawn_tpu())
+        _promote_mid_run(ck, devices[2:4])
+        _assert_parity(ck, clean_2pc3_d4)
+        prof = ck.profile()
+        assert prof["promotes"] == 1
+        assert prof["mesh_shards"] == 4
+        promotes = [e for e in trace if e["ev"] == "promote"]
+        assert len(promotes) == 1
+        assert promotes[0]["from_shards"] == 2
+        assert promotes[0]["to_shards"] == 4
+        from stateright_tpu.obs import validate_event
+        for ev in trace:
+            validate_event(ev)
+
+    def test_promote_doubles_mesh_sync(self, clean_2pc3_d4):
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("need 4 devices")
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, pipeline=False, retries=1,
+                           backoff=0.0, mesh=_mesh(2))
+              .spawn_tpu())
+        _promote_mid_run(ck, devices[2:4])
+        _assert_parity(ck, clean_2pc3_d4)
+        prof = ck.profile()
+        assert prof["promotes"] == 1
+        assert prof["mesh_shards"] == 4
+
+    @pytest.mark.slow
+    def test_promote_composes_with_spill(self):
+        # a budget-capped D=2 run spills to the host tier, THEN the
+        # grant doubles the mesh and the run finishes wide — parity
+        # (set semantics: shapes differ) vs an uncapped clean D=4 run
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("need 4 devices")
+        from stateright_tpu.service import RUNNING, StepDriver
+        spilled = (TwoPhaseSys(4).checker()
+                   .tpu_options(race=False, capacity=1 << 11,
+                                max_capacity=1 << 11, fmax=8, kmax=64,
+                                chunk_steps=2, retries=1, backoff=0.0,
+                                mesh=_mesh(2))
+                   .spawn_tpu())
+        drv = StepDriver(spilled).start()
+        deadline = time.monotonic() + 180.0
+        while (drv.status == RUNNING
+               and not spilled.profile().get("spills")
+               and time.monotonic() < deadline):
+            drv.step(1)
+        spilled.request_promote(devices[2:4])
+        drv.drain()
+        clean = _run(lambda: TwoPhaseSys(4), capacity=1 << 12, fmax=16,
+                     chunk_steps=2, mesh=_mesh(4))
+        assert spilled.unique_state_count() == clean.unique_state_count()
+        assert (set(spilled.generated_fingerprints())
+                == set(clean.generated_fingerprints()))
+        prof = spilled.profile()
+        assert prof["promotes"] == 1
+        assert prof["mesh_shards"] == 4
+        assert prof["spills"] >= 1
+
+    def test_degrade_then_promote_roundtrip(self, clean_2pc3_d4):
+        # REGRESSION (elastic fleet): D=4 drops to D=2 on a transient
+        # blame streak, then climbs back 2 -> 4 when the blamed chip is
+        # released healthy — bit-identical to an uninterrupted D=4 run
+        devices = jax.devices()
+        if len(devices) < 4:
+            pytest.skip("need 4 devices")
+
+        faults = {"n": 0}
+
+        def flaky(chunk, shards):
+            # exactly two faults naming one chip: a blame streak at
+            # D=4, inert afterwards so the climb back up stays clean
+            if shards == 4 and faults["n"] < 2:
+                faults["n"] += 1
+                raise RuntimeError(
+                    "UNAVAILABLE: device 3 fell off the mesh "
+                    "(injected)")
+
+        from stateright_tpu.service import RUNNING, StepDriver
+        trace = []
+        ck = (TwoPhaseSys(3).checker()
+              .tpu_options(race=False, capacity=1 << 12, fmax=64,
+                           chunk_steps=2, retries=5, backoff=0.0,
+                           blame_after=2, mesh=_mesh(4),
+                           fault_hook=flaky, trace=trace)
+              .spawn_tpu())
+        drv = StepDriver(ck).start()
+        deadline = time.monotonic() + 180.0
+        while (drv.status == RUNNING
+               and not ck.profile().get("degrades")
+               and time.monotonic() < deadline):
+            drv.step(1)
+        assert ck.profile()["degrades"] == 1  # narrowed, still running
+        # the blamed chip comes back: grant the dropped half back
+        held = list(ck._mesh.devices.flat)
+        gone = [d for d in devices[:4] if d not in held]
+        assert len(gone) == 2
+        ck.request_promote(gone)
+        drv.drain()
+        _assert_parity(ck, clean_2pc3_d4)
+        prof = ck.profile()
+        assert prof["degrades"] == 1
+        assert prof["promotes"] == 1
+        assert prof["mesh_shards"] == 4
+        kinds = [e["ev"] for e in trace]
+        assert kinds.index("degrade") < kinds.index("promote")
+        from stateright_tpu.obs import validate_event
+        for ev in trace:
+            validate_event(ev)

@@ -178,6 +178,65 @@ class TestTwoLevelPool:
         assert pool.per_host_free() == {"a": 4, "b": 4}
 
 
+class TestElasticPool:
+    """Rolling host join/retire on the two-level pool: joined width
+    buddy-merges into the fleet level, retired FREE width is withdrawn
+    immediately, and busy slices on a retiring host drain without
+    ever re-entering the free lists."""
+
+    def test_add_host_doubles_fleet_width(self):
+        pool = DevicePool(list(range(4)), hosts=["h0"] * 4)
+        assert pool.acquire(8) is None
+        assert pool.add_host("h1", [4, 5, 6, 7]) == 1
+        assert pool.width == 8 and pool.active_host_count == 2
+        l8 = pool.acquire(8)
+        assert l8 is not None and l8.hosts == ("h0", "h1")
+        assert l8.devices == (0, 1, 2, 3, 4, 5, 6, 7)
+        pool.release(l8)
+        assert pool.largest_free() == 8
+
+    def test_add_host_rejects_duplicates_and_narrow_hosts(self):
+        pool = DevicePool(list(range(4)), hosts=["h0"] * 4)
+        with pytest.raises(ValueError, match="already"):
+            pool.add_host("h0", [9, 10, 11, 12])
+        with pytest.raises(ValueError, match="host_width"):
+            pool.add_host("h1", [9])
+
+    def test_retire_withdraws_free_width_immediately(self):
+        pool = DevicePool(list(range(8)), hosts=["h0"] * 4 + ["h1"] * 4)
+        assert pool.retire_host("h1") == [4, 5, 6, 7]
+        assert pool.width == 4 and pool.active_host_count == 1
+        assert pool.per_host_free() == {"h0": 4}
+        assert pool.acquire(4).hosts == ("h0",)
+        assert pool.acquire(1) is None
+        with pytest.raises(ValueError, match="already retired"):
+            pool.retire_host("h1")
+
+    def test_retire_drains_busy_leases_without_refreeing_them(self):
+        # the 8-device carve: h1's half is BUSY at retire time — its
+        # eventual release is discarded, while h0's merges back whole
+        pool = DevicePool(list(range(8)), hosts=["h0"] * 4 + ["h1"] * 4)
+        on_h0 = pool.acquire(4)
+        on_h1 = pool.acquire(4)
+        assert on_h0.hosts == ("h0",) and on_h1.hosts == ("h1",)
+        pool.retire_host("h1")
+        assert pool.free_width() == 0
+        pool.release(on_h1)  # drained, NOT re-freed
+        assert pool.free_width() == 0
+        pool.release(on_h0)
+        assert pool.free_width() == 4
+        assert pool.acquire(4).hosts == ("h0",)
+
+    def test_retire_breaks_the_spanning_block_keeping_survivors(self):
+        pool = DevicePool(list(range(8)), hosts=["h0"] * 4 + ["h1"] * 4)
+        assert pool.largest_free() == 8  # one merged fleet-level block
+        pool.retire_host("h0")
+        assert pool.width == 4
+        lease = pool.acquire(4)
+        assert lease is not None and lease.hosts == ("h1",)
+        assert pool.per_host_free() == {"h1": 0}
+
+
 class TestTwoHostScheduler:
     def test_grants_jobs_across_two_simulated_hosts(self, tmp_path,
                                                     solo_2pc3):
@@ -214,6 +273,132 @@ class TestTwoHostScheduler:
         assert sched._pool.largest_free() == 4
         assert sched._pool.per_host_free() == {"h0": 2, "h1": 2}
         sched.shutdown()
+
+
+# --- elastic flex: promote-on-freed-width, demote-under-pressure ------
+
+class TestFlexController:
+    """SLO-driven flex (``Scheduler(flex=True)``): width freed by a
+    finishing job promotes the hungriest RUNNING job in place (the
+    release path re-checks running jobs, not only the queue); queue
+    pressure demotes the over-width job first; a rolling host join
+    widens a running job without a restart. Digests stay pinned to the
+    solo oracles through every width change."""
+
+    def test_release_promotes_running_job_in_place(self, tmp_path,
+                                                   solo_2pc3,
+                                                   solo_2pc4):
+        # the 8-device carve: B holds half the pool, so A (wants 8)
+        # lands on 4; when B finishes and its buddies merge free, the
+        # flex pass doubles A mid-run — promotes == 1, digest pinned
+        if len(jax.devices()) < 8:
+            pytest.skip("need 8 devices")
+        sched = Scheduler(JobStore(tmp_path), devices=jax.devices(),
+                          hosts=["h0"] * 8, flex=True,
+                          flex_interval=0.0, step_budget=1)
+        try:
+            b = sched.submit(JobSpec("twopc", args=[3], options=OPTS,
+                                     width=4))
+            a = sched.submit(JobSpec("twopc", args=[4], options=OPTS,
+                                     width=8, step_delay=0.02))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not \
+                    sched.job(a.id).status.get("granted_width"):
+                time.sleep(0.05)
+            assert sched.job(a.id).status["granted_width"] == 4
+            assert sched.wait(b.id, timeout=180.0) == "done"
+            assert sched.wait(a.id, timeout=240.0) == "done"
+            assert sched.job(a.id).status["granted_width"] == 8
+            prof = sched.profile()
+            assert prof.get("promotes") == 1
+            assert prof.get("demotes", 0) == 0
+            # the promote lease releases in the worker's exit path,
+            # just AFTER the state flip wait() unblocks on — settle
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline \
+                    and sched.profile().get("flex_width"):
+                time.sleep(0.05)
+            assert sched.profile().get("flex_width") == 0
+            assert sched.job(b.id).read_result()[
+                "fingerprints_sha256"] == _digest(solo_2pc3)
+            assert sched.job(a.id).read_result()[
+                "fingerprints_sha256"] == _digest(solo_2pc4)
+        finally:
+            sched.shutdown()
+
+    def test_queue_pressure_demotes_the_overwidth_job(self, tmp_path,
+                                                      solo_2pc3,
+                                                      solo_2pc4):
+        # C runs wide and alone; a higher-priority arrival finds the
+        # pool fully carved — flex picks the width>1 job to DEMOTE
+        # (checkpoint, release, requeue narrower) rather than a blind
+        # preempt; both resume/finish with pinned digests
+        if len(jax.devices()) < 4:
+            pytest.skip("need 4 devices")
+        sched = Scheduler(JobStore(tmp_path),
+                          devices=jax.devices()[:4], hosts=["h0"] * 4,
+                          flex=True, flex_interval=0.0, step_budget=1)
+        try:
+            c = sched.submit(JobSpec("twopc", args=[4], options=OPTS,
+                                     width=4, step_delay=0.05))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline \
+                    and not sched.job(c.id).status.get("first_chunk_at"):
+                time.sleep(0.05)
+            d = sched.submit(JobSpec("twopc", args=[3], options=OPTS,
+                                     width=4, priority=5))
+            assert sched.wait(d.id, timeout=180.0) == "done"
+            assert sched.wait(c.id, timeout=240.0) == "done"
+            prof = sched.profile()
+            assert prof.get("demotes") == 1
+            assert prof.get("preemptions") == 1
+            assert sched.job(c.id).status.get("resume") is True
+            assert sched.job(d.id).read_result()[
+                "fingerprints_sha256"] == _digest(solo_2pc3)
+            assert sched.job(c.id).read_result()[
+                "fingerprints_sha256"] == _digest(solo_2pc4)
+            demotes = [json.loads(ln) for ln in open(
+                sched.store.service_trace_path)
+                if '"job_demote"' in ln]
+            assert demotes and demotes[0]["job"] == c.id
+            assert demotes[0]["width"] == 4
+        finally:
+            sched.shutdown()
+
+    def test_host_join_widens_a_running_job(self, tmp_path, solo_2pc4):
+        # rolling join: the fleet starts one host wide; h1 joins
+        # mid-run and the under-granted job is promoted onto it
+        if len(jax.devices()) < 8:
+            pytest.skip("need 8 devices")
+        devs = jax.devices()
+        sched = Scheduler(JobStore(tmp_path), devices=devs[:4],
+                          hosts=["h0"] * 4, flex=True,
+                          flex_interval=0.0, step_budget=1)
+        try:
+            a = sched.submit(JobSpec("twopc", args=[4], options=OPTS,
+                                     width=8, step_delay=0.02))
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and not \
+                    sched.job(a.id).status.get("granted_width"):
+                time.sleep(0.05)
+            assert sched.job(a.id).status["granted_width"] == 4
+            assert sched.join_host("h1", devs[4:8]) == 1
+            assert sched.pool_width() == 8
+            assert sched.wait(a.id, timeout=240.0) == "done"
+            assert sched.job(a.id).status["granted_width"] == 8
+            assert sorted(sched.job(a.id).status["hosts"]) \
+                == ["h0", "h1"]
+            prof = sched.profile()
+            assert prof.get("promotes") == 1
+            assert prof.get("hosts") == 2
+            assert sched.job(a.id).read_result()[
+                "fingerprints_sha256"] == _digest(solo_2pc4)
+            # the job is done: h1 retires with nothing to drain
+            assert len(sched.leave_host("h1")) == 4
+            assert sched.pool_width() == 4
+            assert sched.profile().get("hosts") == 1
+        finally:
+            sched.shutdown()
 
 
 # --- StepDriver: start -> step(budget) -> ... -> finish ---------------
@@ -659,3 +844,45 @@ class TestBenchServiceSmoke:
             report = bh.build_report([art])
         entry = report["trend"][bh.CONTRACT][0]
         assert "service" in entry["tags"]
+
+
+class TestBenchFlexSmoke:
+    @pytest.mark.slow
+    def test_contract_line_lands_rc0(self):
+        # ACCEPTANCE (elastic fleet): --flex-smoke runs the rolling
+        # join -> in-place promote -> pressure -> leave storyline and
+        # ALWAYS lands a JSON contract line, rc=0; a full (non-partial)
+        # round pins digest parity and bounded promote/demote churn
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--flex-smoke"],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        contract = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert contract["flex"] is True
+        assert contract["unit"] == "uniq/s"
+        if "partial" not in contract:
+            assert contract["value"] and contract["value"] > 0
+            assert contract["promotes"] >= 1  # the join was USED
+            assert contract["promotes"] <= 8  # ... without thrashing
+            assert contract["demotes"] <= 8
+            assert all(row["digest_ok"] for row in contract["jobs"])
+        # tools/bench_history.py tags the flex round
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench_history", os.path.join(REPO, "tools",
+                                          "bench_history.py"))
+        bh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bh)
+        import tempfile
+        with tempfile.TemporaryDirectory() as tdir:
+            art = os.path.join(tdir, "BENCH_r98.json")
+            with open(art, "w") as f:
+                json.dump({"rc": 0, "parsed": contract, "tail": ""}, f)
+            report = bh.build_report([art])
+        entry = report["trend"][bh.CONTRACT][0]
+        assert "flex" in entry["tags"]

@@ -179,6 +179,10 @@ def parse_round(path: str) -> Dict[str, Any]:
                 # jobs/min with a real checking job preempting through
                 # — a fleet-behavior number, not an engine rate
                 ("burnin", bool(contract.get("burnin"))),
+                # a --flex-smoke round: a job storm under a rolling
+                # host join/leave with the elastic flex controller on
+                # — promote/demote behavior, not an engine rate
+                ("flex", bool(contract.get("flex"))),
             ) if on)
         rnd["workloads"][CONTRACT] = {
             "name": contract.get("metric", "contract"),

@@ -194,7 +194,8 @@ class Board:
         if slo:
             lines.append("slo: " + "  ".join(slo))
         inter = {k: int(prof[k]) for k in
-                 ("preemptions", "retries", "degrades", "spills",
+                 ("preemptions", "retries", "degrades", "promotes",
+                  "demotes", "spills",
                   "jobs_failed", "sse_dropped", "recorder_dumps")
                  if prof.get(k)}
         lines.append("interventions: " + (" ".join(
@@ -250,6 +251,12 @@ def load_offline(root: str) -> Dict[str, Any]:
                     and ev.get("reason") == "preempt":
                 profile["preemptions"] = \
                     profile.get("preemptions", 0) + 1
+            elif kind == "job_promote":
+                profile["promotes"] = \
+                    profile.get("promotes", 0) + 1
+            elif kind == "job_demote":
+                profile["demotes"] = \
+                    profile.get("demotes", 0) + 1
         profile["jobs_submitted"] = counts.get("job_submit", 0)
         profile["jobs_done"] = sum(
             1 for j in jobs if j.get("state") == "done")

@@ -71,14 +71,12 @@ def worker_main(args, ctx) -> int:
     rank = ctx.rank
     out = args.out
     mesh = fleet_mesh("shards")
-    ready = {
-        "rank": rank,
-        "local_devices": len(jax.local_devices()),
-        "global_devices": len(jax.devices()),
-        "shards": int(mesh.shape["shards"]),
-    }
-    with open(os.path.join(out, f"rank{rank}.ready"), "w") as f:
-        json.dump(ready, f)
+    from stateright_tpu.cluster.launch import write_ready_marker
+    write_ready_marker(
+        out, rank,
+        local_devices=len(jax.local_devices()),
+        global_devices=len(jax.devices()),
+        shards=int(mesh.shape["shards"]))
 
     model = build_model(args.model, list(args.args), {})
     builder = (model.checker()

@@ -173,13 +173,15 @@ def report(events, out=None):
         inters = [e for e in evs if e["ev"] in
                   ("grow", "hgrow", "egrow", "kovf", "compile",
                    "retry", "watchdog", "autosave", "failover",
-                   "degrade", "fused_fallback", "fused_unsupported",
+                   "degrade", "promote", "host_promote",
+                   "fused_fallback", "fused_unsupported",
                    "recorder_dump",
                    "spill", "evict", "pause",
                    "crash", "restart", "partition",
                    "soak_start", "violation", "burnin_preempt",
                    "job_submit", "job_start", "job_pause",
                    "job_resume", "job_done",
+                   "job_promote", "job_demote",
                    "bucket_flush", "batch_form", "lane_retire",
                    "mesh_init", "host_join", "host_drop")]
         if inters:
@@ -194,24 +196,29 @@ def report(events, out=None):
         # degrades, with every chip the faults were blamed on
         resil = [e for e in evs
                  if e["ev"] in ("retry", "failover", "degrade",
-                                "watchdog")]
+                                "promote", "watchdog")]
         if resil:
             counts = {}
             for ev in resil:
                 counts[ev["ev"]] = counts.get(ev["ev"], 0) + 1
             plural = {"retry": "retries", "watchdog": "watchdogs",
-                      "failover": "failovers", "degrade": "degrades"}
+                      "failover": "failovers", "degrade": "degrades",
+                      "promote": "promotes"}
             parts = [f"{plural[kind]}={counts[kind]}"
                      for kind in ("retry", "watchdog", "failover",
-                                  "degrade") if kind in counts]
+                                  "degrade", "promote")
+                     if kind in counts]
             blamed = sorted({ev["device"] for ev in resil
                              if ev.get("device") is not None})
             if blamed:
                 parts.append(f"blamed_devices={blamed}")
-            degrades = [e for e in resil if e["ev"] == "degrade"]
-            if degrades:
+            # the ladder runs BOTH ways now: the final width is the
+            # last rung taken in either direction
+            rungs = [e for e in resil
+                     if e["ev"] in ("degrade", "promote")]
+            if rungs:
                 parts.append(
-                    f"final_mesh={degrades[-1]['to_shards']}")
+                    f"final_mesh={rungs[-1]['to_shards']}")
             out.write("\nresilience: " + " ".join(parts) + "\n")
 
         # fleet summary (stateright_tpu/cluster + multi-host meshes):
@@ -221,7 +228,8 @@ def report(events, out=None):
         mesh_evs = [e for e in evs if e["ev"] == "mesh_init"]
         joins = [e for e in evs if e["ev"] == "host_join"]
         drops = [e for e in evs if e["ev"] == "host_drop"]
-        if mesh_evs or joins or drops:
+        hpromotes = [e for e in evs if e["ev"] == "host_promote"]
+        if mesh_evs or joins or drops or hpromotes:
             parts = []
             if mesh_evs:
                 last = mesh_evs[-1]
@@ -238,6 +246,10 @@ def report(events, out=None):
                 parts.append(
                     "host_drops="
                     f"{sorted((str(e.get('host')) for e in drops))}")
+            if hpromotes:
+                parts.append(
+                    "host_promotes="
+                    f"{sorted((str(e.get('host')) for e in hpromotes))}")
             out.write("\nfleet: " + " ".join(parts) + "\n")
 
         # memory-tiering summary: how the run survived its HBM budget —
