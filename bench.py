@@ -73,7 +73,11 @@ burn-in mode: low-priority seeded fuzz jobs saturating a 2-device CPU
 pool, a real checking job preempting a fuzz lane at an op boundary)
 and lands a ``"burnin": true`` contract line with ``jobs_per_min`` for
 both the burn-in and real-job lanes — bench_history tags it
-``burnin``.
+``burnin``; ``--audit-smoke`` runs the silent-corruption defense (a
+``corrupt_hook``-injected lying chip caught by ``audit=1``, replayed
+to a digest bit-identical to the clean oracle) and lands an
+``"audit": true`` contract line with audit/mismatch/quarantine
+counts — bench_history tags it ``audit``.
 """
 
 from __future__ import annotations
@@ -930,6 +934,90 @@ def _flex_smoke() -> None:
         print(json.dumps(contract))
 
 
+def _audit_smoke() -> None:
+    """``--audit-smoke``: a seconds-scale proof of the silent-
+    corruption defense under the crash-proof contract — one clean
+    audited run (zero mismatches allowed), then a LYING run on the
+    same model with ``corrupt_hook`` flipping one fingerprint bit in
+    a chunk's frontier: the auditor must catch it, quarantine the
+    chip, and the replayed run's digest must be bit-identical to the
+    clean oracle. The contract line is tagged ``"audit": true`` with
+    ``audited``/``audits``/``audit_mismatches``/``quarantined``
+    counts. Emitted from a ``finally`` path with ``"partial"``/
+    ``"failed"`` on any error; rc=0 regardless."""
+    import os
+
+    contract = {
+        "metric": "silent-corruption audit smoke (lying chip caught, "
+                  "digest vs clean oracle)",
+        "value": None,
+        "unit": "uniq/s",
+        "audit": True,
+        "audited": None,
+        "audits": None,
+        "audit_mismatches": None,
+        "quarantined": None,
+    }
+    try:
+        # CPU platform BEFORE jax initializes (and re-assert the
+        # config: a sitecustomize may override it)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from stateright_tpu.models.twopc import TwoPhaseSys
+
+        opts = {"capacity": 1 << 12, "fmax": 64, "chunk_steps": 2,
+                "race": False}
+
+        def _run(**extra):
+            t0 = time.perf_counter()
+            ck = (TwoPhaseSys(3).checker()
+                  .tpu_options(**opts, **extra).spawn_tpu().join())
+            return ck, time.perf_counter() - t0
+
+        clean, _ = _run()
+        oracle = clean.generated_fingerprints()
+
+        audited, _ = _run(audit=1)
+        if audited.generated_fingerprints() != oracle:
+            FAILED.append("audit-clean-digest")
+        if audited.profile().get("audit_mismatches"):
+            FAILED.append("audit-clean-mismatch")
+
+        lying, secs = _run(
+            audit=1, retries=2, backoff=0.0,
+            corrupt_hook=lambda o, d: 0 if o == 2 else None)
+        prof = lying.profile()
+        contract["audited"] = bool(prof.get("audits"))
+        contract["audits"] = int(prof.get("audits", 0) or 0)
+        contract["audit_mismatches"] = int(
+            prof.get("audit_mismatches", 0) or 0)
+        contract["quarantined"] = int(prof.get("quarantined", 0) or 0)
+        contract["value"] = round(
+            lying.unique_state_count() / max(secs, 1e-9), 1)
+        if lying.generated_fingerprints() != oracle:
+            FAILED.append("audit-lying-digest")
+        if contract["audit_mismatches"] < 1:
+            FAILED.append("audit-not-caught")
+        if contract["quarantined"] < 1:
+            FAILED.append("audit-no-quarantine")
+    except BaseException as exc:
+        print(json.dumps({"workload": "audit", "error": repr(exc)}),
+              file=sys.stderr)
+        FAILED.append("audit")
+    finally:
+        if FAILED:
+            contract["partial"] = True
+            contract["failed"] = FAILED
+        print(json.dumps(contract))
+
+
 def _arg_after(flag: str, default):
     if flag in sys.argv:
         return sys.argv[sys.argv.index(flag) + 1]
@@ -957,6 +1045,9 @@ def main() -> None:
         return
     if "--flex-smoke" in sys.argv:
         _flex_smoke()
+        return
+    if "--audit-smoke" in sys.argv:
+        _audit_smoke()
         return
     if SMOKE:
         N = 1

@@ -53,6 +53,7 @@ documented price of a run that can outlive its backend.
 from __future__ import annotations
 
 import enum
+import hashlib
 import os
 import random
 import re
@@ -76,6 +77,7 @@ class FaultKind(enum.Enum):
 
     TRANSIENT = "transient"      # backend/tunnel hiccup: retry
     CAPACITY = "capacity"        # a bound is too small: raise it, rerun
+    CORRUPTION = "corruption"    # a chip returned wrong results: quarantine
     PROGRAMMING = "programming"  # a bug: surface immediately
 
 
@@ -127,6 +129,25 @@ class ChunkDeadlineError(RuntimeError):
     hang (the watchdog; classified TRANSIENT by construction)."""
 
 
+class CorruptionError(RuntimeError):
+    """A chunk audit caught a device lying: the fingerprints it reported
+    do not match a deterministic re-execution of the same frontier slice
+    (host oracle or a different chip). Silent data corruption never
+    *raises* on its own — this error is synthesized by the auditor
+    (:class:`AuditPolicy`, ``tpu_options(audit=...)``) so the fault can
+    route through the ordinary classification/attribution machinery.
+    ``device_index`` names the lying chip (mesh position) for
+    :func:`blamed_device`; the message deliberately matches no
+    TRANSIENT/CAPACITY marker so :func:`classify_error` reports
+    CORRUPTION by type, never by substring accident."""
+
+    def __init__(self, msg: str, device_index: int = 0,
+                 mismatches: int = 0):
+        super().__init__(msg)
+        self.device_index = int(device_index)
+        self.mismatches = int(mismatches)
+
+
 class CandidateOverflowError(RuntimeError):
     """A wedged ``kovf`` protocol: the candidate-buffer resize made no
     progress (the fused/sharded pre-mutation abort would rebuild the
@@ -149,6 +170,8 @@ def classify_error(exc: BaseException) -> FaultKind:
     e: Optional[BaseException] = exc
     while e is not None and id(e) not in seen:
         seen.add(id(e))
+        if isinstance(e, CorruptionError):
+            return FaultKind.CORRUPTION
         if isinstance(e, (ChunkDeadlineError, ConnectionError,
                           TimeoutError)):
             return FaultKind.TRANSIENT
@@ -481,6 +504,130 @@ class SpillPolicy:
 
 
 # ----------------------------------------------------------------------
+# silent-corruption audit (README § Silent corruption defense)
+# ----------------------------------------------------------------------
+class AuditPolicy:
+    """Sampled redundant re-execution of chunk results.
+
+    Every robustness layer above defends against faults that *raise*;
+    a chip that silently returns wrong fingerprints completes "green"
+    with states unexplored ("Cores that don't count", HotOS'21).
+    ``tpu_options(audit=N)`` re-executes every Nth chunk's frontier
+    slice — the fingerprints of the freshly appended queue rows — on a
+    *different* device (host oracle on single-chip) and compares them
+    word-for-word against what the chip claimed; ``audit=frac`` with a
+    float in (0, 1] samples that fraction of chunks deterministically;
+    ``audit=True`` means every chunk; ``audit=False`` (the default) is
+    the unaudited pre-existing engine, bit for bit.
+
+    A mismatch becomes a :class:`CorruptionError` blaming the lying
+    chip, the shadow rolls back to the last audited boundary
+    (:meth:`HostShadow.audit_mark` / :meth:`HostShadow.rollback_to_mark`
+    — corrupt folds since the boundary are undone, so the final digest
+    matches an uncorrupted oracle run), and the fault routes down the
+    existing ladder: quarantine + degrade on a mesh, re-seed + replay
+    on a single chip.
+
+    Sampling caveat (documented, inherent): a chip that lies ONCE
+    between two sampled audits goes uncaught — the audit verifies the
+    sampled chunk's own rows. A *persistently* lying chip is caught at
+    the next sampled chunk and every fold since the last clean audit is
+    rolled back. ``audit=1`` closes the gap entirely.
+    """
+
+    __slots__ = ("every",)
+
+    def __init__(self, every: int = 0):
+        every = int(every)
+        if every < 0:
+            raise ValueError("tpu_options(audit=...) must be >= 0")
+        self.every = every
+
+    @classmethod
+    def from_options(cls, opts: dict) -> "AuditPolicy":
+        raw = opts.get("audit", False)
+        if raw is False or raw is None:
+            return cls(0)
+        if raw is True:
+            return cls(1)
+        if isinstance(raw, float):
+            if not 0.0 < raw <= 1.0:
+                raise ValueError(
+                    "tpu_options(audit=...) as a float is a sampling "
+                    "fraction and must be in (0, 1]")
+            return cls(max(1, round(1.0 / raw)))
+        return cls(int(raw))
+
+    @property
+    def enabled(self) -> bool:
+        return self.every > 0
+
+    def should_audit(self, ordinal: int) -> bool:
+        """Whether chunk ``ordinal`` (0-based) is a sampled audit point
+        (deterministic — every run audits the same chunks)."""
+        return self.every > 0 and ordinal % self.every == 0
+
+
+_AUDIT_JIT = None
+
+
+def oracle_fps(rows: np.ndarray, device=None) -> np.ndarray:
+    """Independently re-execute the fingerprint of each packed state
+    row. With ``device`` the computation runs on THAT chip (the
+    cross-device redundant-execution path: a different device re-hashes
+    the rows the audited chip produced); without, the host oracle
+    (`fingerprint.fp64_rows`, the native C reference) answers. Both are
+    bit-identical to the device kernel by the differential-test
+    contract, so any disagreement with the claimed fingerprints is the
+    audited chip lying, not an oracle artifact."""
+    rows = np.ascontiguousarray(rows, np.uint32)
+    if device is None:
+        from ..fingerprint import fp64_rows
+        return np.asarray(fp64_rows(rows), np.uint64)
+    global _AUDIT_JIT
+    if _AUDIT_JIT is None:
+        import jax
+
+        from ..ops.hash_kernel import fp64_device
+        _AUDIT_JIT = jax.jit(fp64_device)
+    import jax
+    hi, lo = _AUDIT_JIT(jax.device_put(rows, device))
+    return _combine64(np.asarray(hi), np.asarray(lo))
+
+
+def audit_chunk_rows(q_new: np.ndarray, log_new: np.ndarray,
+                     width: int, *, sound: bool = False,
+                     device=None) -> int:
+    """Audit one chunk's fresh appends for shard ``s``: re-execute the
+    frontier slice's fingerprints (on ``device`` when given, else the
+    host oracle) and compare against the two places the chip claimed
+    them — the queue rows' fingerprint columns and the insert log's
+    child-key columns. Returns the number of mismatching rows (0 =
+    clean). Uses only host-resident arrays the shadow fold already
+    gathered — auditing adds no extra device pulls."""
+    n = len(q_new)
+    if n == 0:
+        return 0
+    q_new = np.asarray(q_new, np.uint32)
+    log_new = np.asarray(log_new, np.uint32)
+    claimed = _combine64(q_new[:, width + 1], q_new[:, width + 2])
+    expect = oracle_fps(q_new[:, :width], device=device)
+    bad = claimed != expect
+    logged = _combine64(log_new[:, 0], log_new[:, 1])
+    if sound:
+        # the insert log keys on (state, pending-ebits) NODE identity;
+        # re-derive it from the re-executed state fp + at-enqueue ebits
+        node_rows = np.stack(
+            [expect.astype(np.uint32),
+             (expect >> np.uint64(32)).astype(np.uint32),
+             q_new[:, width]], axis=1)
+        bad |= logged != oracle_fps(node_rows, device=device)
+    else:
+        bad |= logged != expect
+    return int(np.count_nonzero(bad))
+
+
+# ----------------------------------------------------------------------
 # watchdog
 # ----------------------------------------------------------------------
 def call_with_deadline(fn, deadline: float, what: str = "device sync"):
@@ -635,6 +782,17 @@ class HostShadow:
         self._clock = 0
         self._roots: List[int] = []   # first-epoch dedup keys (lasso)
         self._first_epoch = True
+        # --- silent-corruption defense (AuditPolicy) ------------------
+        #: running chunk-digest head: sha256 folded over each chunk's
+        #: reported child keys in fold order — the provenance anchor the
+        #: artifact integrity chain binds checkpoints/results to
+        self.chain_head = hashlib.sha256(b"stateright-tpu").hexdigest()
+        #: set by the engines when ``tpu_options(audit=...)`` is on;
+        #: gates the mark/rollback bookkeeping so the unaudited default
+        #: path stays zero-cost
+        self.audit_enabled = False
+        self._mark: Optional[tuple] = None
+        self._mark_keys: List[int] = []
         # cumulative across epochs (the lasso sweep's inputs)
         self._inserts: List[List[tuple]] = [[] for _ in range(shards)]
         self._edges: List[List[np.ndarray]] = [[] for _ in range(shards)]
@@ -667,6 +825,8 @@ class HostShadow:
                     self._roots.append(
                         fp64_node(fp, int(r[j, self.width]))
                         if self._sound else fp)
+        if self.audit_enabled:
+            self.audit_mark()
 
     def note_chunk(self, s: int, q_new: np.ndarray, log_new: np.ndarray,
                    elog_new: Optional[np.ndarray], q_head: int) -> int:
@@ -688,6 +848,8 @@ class HostShadow:
             self._inserts[s].append((log_new, q_new[:, self.width]))
             child = _combine64(log_new[:, 0], log_new[:, 1])
             parent = _combine64(log_new[:, 2], log_new[:, 3])
+            self.chain_head = hashlib.sha256(
+                self.chain_head.encode() + child.tobytes()).hexdigest()
             # per-prefix last-touch clock: newly inserted children mark
             # their ranges hot, and so do the parents being expanded —
             # the ranges dedup is currently hitting are the ones NOT to
@@ -707,8 +869,13 @@ class HostShadow:
                 self.host_probe_hits += hits
                 self.host_tier_keys = max(0, self.host_tier_keys - hits)
                 pairs = fresh
+                if self._mark is not None:
+                    self._mark_keys.extend(c for c, _p in fresh)
                 g.update(pairs)
             else:
+                if self._mark is not None:
+                    pairs = list(pairs)
+                    self._mark_keys.extend(c for c, _p in pairs)
                 self._generated.update(pairs)
             if self._translate:
                 orig = _combine64(log_new[:, 4], log_new[:, 5])
@@ -726,6 +893,50 @@ class HostShadow:
             self.e_n[s] += len(elog_new)
         self._heads[s] = int(q_head)
         return hits
+
+    # --- silent-corruption defense (AuditPolicy) ----------------------
+    def audit_mark(self) -> None:
+        """Pin the current fold position as the last audited boundary.
+        Called after every PASSED audit (and at each epoch seed), so
+        :meth:`rollback_to_mark` can undo everything a lying chip
+        folded in since the last point the oracle vouched for."""
+        self._mark = (list(self._heads), list(self._tails),
+                      list(self.log_n), list(self.e_n),
+                      [len(p) for p in self._inserts],
+                      [len(p) for p in self._edges],
+                      self.chain_head, self.host_probe_hits,
+                      self.host_tier_keys)
+        self._mark_keys = []
+
+    def rollback_to_mark(self) -> int:
+        """Undo every fold since :meth:`audit_mark`: mirror entries,
+        queue appends, insert/edge records, head positions and the
+        chain head all return to the audited boundary, so the replay
+        re-expands the same frontier on trustworthy silicon and the
+        final digest matches an uncorrupted run. Returns the number of
+        mirror keys undone (0 when no mark is pinned)."""
+        if self._mark is None:
+            return 0
+        (heads, tails, log_n, e_n, ins_len, edg_len,
+         chain, probe_hits, tier_keys) = self._mark
+        for k in self._mark_keys:
+            self._generated.pop(k, None)
+            self._orig_of.pop(k, None)
+        undone = len(self._mark_keys)
+        self._mark_keys = []
+        for s in range(self.shards):
+            rows = self._epoch_rows(s)
+            self._epoch_q[s] = [rows[:tails[s]]] if tails[s] else []
+            del self._inserts[s][ins_len[s]:]
+            del self._edges[s][edg_len[s]:]
+        self._heads = list(heads)
+        self._tails = list(tails)
+        self.log_n = list(log_n)
+        self.e_n = list(e_n)
+        self.chain_head = chain
+        self.host_probe_hits = probe_hits
+        self.host_tier_keys = tier_keys
+        return undone
 
     # --- memory tiering (SpillPolicy) ---------------------------------
     @property
@@ -865,6 +1076,39 @@ class HostShadow:
         if not parts:
             return np.zeros((0, 4), np.uint32)
         return np.concatenate(parts)
+
+
+# ----------------------------------------------------------------------
+# artifact integrity chain (checkpoints, autosaves, result.json)
+# ----------------------------------------------------------------------
+#: the previous autosave generation's suffix: `<path>` is always the
+#: NEWEST loadable checkpoint (g0 — what `resume_from(path)` reads and
+#: every pre-existing test pins), `<path>.g1` the one before it. A
+#: corrupt or truncated `<path>` rolls back one generation on resume.
+AUTOSAVE_PREV_SUFFIX = ".g1"
+
+
+def payload_digest(arrays: Dict[str, np.ndarray]) -> str:
+    """Deterministic sha256 over a checkpoint payload: sorted array
+    names with dtype, shape and raw bytes — what the integrity chain
+    signs, independent of npz compression details."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def chain_integrity(payload_sha: str, chain_head: str) -> str:
+    """The integrity field an artifact carries: its payload sha256
+    chained to the run's chunk-digest head at write time, so a
+    tampered/corrupt payload AND a payload transplanted from a
+    different run history both fail verification."""
+    return hashlib.sha256(
+        (payload_sha + ":" + chain_head).encode()).hexdigest()
 
 
 # ----------------------------------------------------------------------

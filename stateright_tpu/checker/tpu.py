@@ -387,9 +387,28 @@ class TpuChecker(HostChecker):
         self._host_fns = self._resolve_host_fns(
             getattr(model, "host_property_fns", None))
         # --- resilience knobs (checker/resilience.py) ------------------
-        from .resilience import DegradePolicy, RetryPolicy, SpillPolicy
+        from .resilience import (AuditPolicy, DegradePolicy, RetryPolicy,
+                                 SpillPolicy)
         self._retry_policy = RetryPolicy.from_options(opts)
         self._degrade_policy = DegradePolicy.from_options(opts)
+        # silent-corruption audit (README § Silent corruption defense):
+        # sampled chunks re-execute their frontier fingerprints on a
+        # different device (host oracle on single-chip) and a mismatch
+        # quarantines the lying chip. Off by default — the unaudited
+        # path is the pre-existing engine bit for bit.
+        self._audit_policy = AuditPolicy.from_options(opts)
+        # injected lying-chip hook (tests/bench): (ordinal, shards) ->
+        # the mesh position whose reported fingerprints get one bit
+        # flipped this chunk, or None — the corruption analog of
+        # fault_hook
+        self._corrupt_hook = opts.get("corrupt_hook")
+        #: mesh positions the auditor caught lying this run — the
+        #: scheduler maps them onto the lease's devices and withholds
+        #: them from future grants (service/scheduler.py)
+        self._quarantined: set = set()
+        #: the shadow's running chunk-digest head at the last fold —
+        #: what checkpoint/result artifacts chain their integrity to
+        self._shadow_chain_head = None
         # memory tiering (README § Memory tiering): growth past the HBM
         # budget — or a spill-eligible capacity fault in the retry
         # envelope — evicts cold fingerprint-prefix ranges to the host
@@ -502,6 +521,14 @@ class TpuChecker(HostChecker):
                     "symmetry reduction on the TPU engine requires the "
                     "model to implement packed_representative (the device "
                     "canonicalization); use spawn_dfs() otherwise")
+            if self._audit_policy.enabled:
+                raise NotImplementedError(
+                    "tpu_options(audit=...) is not supported with "
+                    "symmetry reduction: the queue rows are ORIGINAL "
+                    "states while their cached fingerprints are the "
+                    "canonical representatives', so the oracle cannot "
+                    "re-execute them independently. Audit unreduced "
+                    "runs, or rely on the artifact integrity chain.")
 
     # _timed/profile() come from HostChecker: ONE metrics registry per
     # run, keys documented once in stateright_tpu.obs.GLOSSARY (the
@@ -619,9 +646,12 @@ class TpuChecker(HostChecker):
         cumulative insert/edge records feed the sound-mode lasso sweep
         across every epoch and rung) instead of starting a fresh one.
         An HBM budget (``max_capacity``) also turns the shadow on — the
-        host tier IS the shadow, so tiering cannot run without it."""
+        host tier IS the shadow, so tiering cannot run without it; so
+        does the chunk auditor (``audit=``), whose rollback boundary
+        and replay frontier live in the shadow."""
         if not (self._retry_policy.enabled
                 or self._autosave_path is not None
+                or self._audit_policy.enabled
                 or (self._spill_policy.enabled
                     and self._spill_policy.max_capacity is not None)):
             return None
@@ -629,12 +659,15 @@ class TpuChecker(HostChecker):
         if adopted is not None:
             self._handoff_shadow = None
             adopted.reshard(shards)
+            adopted.audit_enabled = self._audit_policy.enabled
             return adopted
         from .resilience import HostShadow
-        return HostShadow(shards, self._model.packed_width,
-                          self._generated, self._orig_of,
-                          translate=self._symmetry or self._sound,
-                          sound=self._sound)
+        shadow = HostShadow(shards, self._model.packed_width,
+                            self._generated, self._orig_of,
+                            translate=self._symmetry or self._sound,
+                            sound=self._sound)
+        shadow.audit_enabled = self._audit_policy.enabled
+        return shadow
 
     def _materialize_stats(self, stats_d, ordinal: int,
                            t_disp: "Optional[float]" = None) -> np.ndarray:
@@ -701,10 +734,14 @@ class TpuChecker(HostChecker):
                          discoveries: Dict[str, object]) -> None:
         """Write a ``resume_from``-loadable checkpoint (the complete
         mirror + the given pending frontier) through the crash-safe
-        atomic write. Shared by ``save()`` and the autosave path."""
+        atomic write. Shared by ``save()`` and the autosave path. The
+        metadata carries the artifact integrity chain: a sha256 over
+        the payload arrays chained to the run's chunk-digest head,
+        which ``_load_checkpoint`` verifies before seeding anything."""
         import json
 
-        from .resilience import atomic_savez
+        from .resilience import (atomic_savez, chain_integrity,
+                                 payload_digest)
 
         child = np.fromiter(self._generated.keys(), np.uint64,
                             len(self._generated))
@@ -716,6 +753,13 @@ class TpuChecker(HostChecker):
                             len(self._orig_of))
         ovals = np.fromiter(self._orig_of.values(), np.uint64,
                             len(self._orig_of))
+        arrays = dict(child=child, parent=parent,
+                      rows=np.asarray(rows, np.uint32),
+                      ebits=np.asarray(ebits, np.uint32),
+                      ffps=np.asarray(ffps, np.uint64),
+                      okeys=okeys, ovals=ovals,
+                      state_count=np.int64(self._state_count))
+        chain_head = self._shadow_chain_head or ""
         meta = json.dumps({
             "model": self._model_tag(),
             "discoveries": {n: ([int(f) for f in fp]
@@ -724,26 +768,34 @@ class TpuChecker(HostChecker):
                             for n, fp in discoveries.items()},
             "symmetry": bool(self._symmetry),
             "sound": bool(self._sound),
+            "chain_head": chain_head,
+            "integrity": chain_integrity(payload_digest(arrays),
+                                         chain_head),
         })
-        atomic_savez(path, child=child, parent=parent,
-                     rows=np.asarray(rows, np.uint32),
-                     ebits=np.asarray(ebits, np.uint32),
-                     ffps=np.asarray(ffps, np.uint64),
-                     okeys=okeys, ovals=ovals,
-                     state_count=np.int64(self._state_count),
-                     meta=np.asarray(meta))
+        atomic_savez(path, meta=np.asarray(meta), **arrays)
 
     def _write_autosave(self, shadow,
                         discoveries: Dict[str, object]) -> None:
         """Checkpoint the shadow (periodic, and on exhausted retries):
-        purely host-side, so it works even with a dead backend."""
+        purely host-side, so it works even with a dead backend.
+
+        Generation rotation: the previous checkpoint survives as
+        ``<path>.g1`` before the new one lands at ``<path>`` (always
+        the newest loadable generation), so a corrupt or truncated
+        newest file rolls the resume back ONE generation instead of
+        losing the run (``_load_checkpoint``)."""
+        from .resilience import AUTOSAVE_PREV_SUFFIX
+        path = os.fspath(self._autosave_path)
+        if os.path.exists(path):
+            os.replace(path, path + AUTOSAVE_PREV_SUFFIX)
         rows, ebits, fps = shadow.pending()
+        self._shadow_chain_head = shadow.chain_head
         self._checkpoint_save(self._autosave_path, rows, ebits, fps,
                               discoveries)
         self._metrics.inc("autosaves")
         if self._trace:
             self._trace.emit("autosave",
-                             path=os.fspath(self._autosave_path),
+                             path=path,
                              unique=len(self._generated))
 
     def _resilience_degrade(self, exc: BaseException, shadow,
@@ -1105,12 +1157,15 @@ class TpuChecker(HostChecker):
         # BEFORE the seed: with memory tiering the shadow decides which
         # keys are device-resident at all (a degraded-mesh handoff may
         # arrive with ranges already evicted down the ladder)
-        from .resilience import (SPILL_PREFIX_BITS, FaultKind,
+        from .resilience import (SPILL_PREFIX_BITS, CorruptionError,
+                                 FaultKind, audit_chunk_rows,
                                  blamed_device, classify_error,
                                  find_candidate_overflow, gather_rows,
                                  pack_qrows, spill_eligible)
 
         policy = self._retry_policy
+        audit_pol = self._audit_policy
+        corrupt_hook = self._corrupt_hook
         spill_pol = self._spill_policy
         spill_on = spill_pol.enabled and not self._sound
         shadow = self._make_shadow(1)
@@ -1340,7 +1395,8 @@ class TpuChecker(HostChecker):
                     hcap_d: int, t_disp: float) -> set:
             """Consume one chunk's stats vector; returns the host
             actions it demands (handled once the pipeline is drained)."""
-            nonlocal seed_ovf, fault_attempt, spill_attempt
+            nonlocal seed_ovf, fault_attempt, spill_attempt, \
+                corruption_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # (scalars + the representative window when host props
@@ -1404,8 +1460,47 @@ class TpuChecker(HostChecker):
                     if ecap:
                         e_new = gather_rows(carry.elog, np.arange(
                             shadow.e_n[0], e_n, dtype=np.int32))
+                    if corrupt_hook is not None and len(q_new) \
+                            and corrupt_hook(ordinal, 1) == 0:
+                        # injected lying chip (tests/bench): flip one
+                        # bit in the fingerprints the device reported —
+                        # consistently in the queue's fp column and the
+                        # insert log's child key, like a chip whose
+                        # hash unit miscomputed
+                        q_new = q_new.copy()
+                        log_new = log_new.copy()
+                        q_new[:, model.packed_width + 1] ^= np.uint32(1)
+                        log_new[:, 0] ^= np.uint32(1)
+                    audited = audit_pol.should_audit(ordinal)
+                    if audited:
+                        self._metrics.inc("audits")
+                        bad = audit_chunk_rows(
+                            q_new, log_new, model.packed_width,
+                            sound=self._sound)
+                        if self._trace:
+                            self._trace.emit("audit", chunk=ordinal,
+                                             rows=int(len(q_new)),
+                                             mismatches=bad, device=0)
+                        if bad:
+                            self._metrics.inc("audit_mismatches")
+                            raise CorruptionError(
+                                f"chunk {ordinal} audit: {bad} of "
+                                f"{len(q_new)} frontier fingerprints "
+                                "disagree with the host oracle's "
+                                "re-execution — the chip is returning "
+                                "wrong results",
+                                device_index=0, mismatches=bad)
                     hits = shadow.note_chunk(0, q_new, log_new, e_new,
                                              q_head)
+                    if audited:
+                        # the oracle vouched for everything up to and
+                        # including this fold: pin the replay boundary
+                        # (and only a PASSED audit clears the
+                        # consecutive-corruption counter — a lying chip
+                        # syncs just fine)
+                        shadow.audit_mark()
+                        corruption_attempt = 0
+                    self._shadow_chain_head = shadow.chain_head
                     if hits:
                         # host-tier re-probe: device-"fresh" keys the
                         # mirror already held (rediscoveries of evicted
@@ -1832,6 +1927,7 @@ class TpuChecker(HostChecker):
 
         fault_attempt = 0
         spill_attempt = 0
+        corruption_attempt = 0
         recover_delay: "Optional[float]" = None
         while True:
             try:
@@ -1950,6 +2046,48 @@ class TpuChecker(HostChecker):
                                 hot=plan[1], reason="fault",
                                 host_tier_keys=shadow.host_tier_keys,
                                 error=f"{type(exc).__name__}: {exc}")
+                    recover_delay = 0.0
+                    continue
+                if kind is FaultKind.CORRUPTION:
+                    # the auditor caught the chip lying: every fold
+                    # since the last audited boundary is suspect — roll
+                    # the shadow back to it (corrupt mirror entries are
+                    # undone, so the final digest matches an
+                    # uncorrupted oracle run) and replay from there. On
+                    # a single chip there is nothing to quarantine
+                    # AROUND, so the replay re-executes under audit
+                    # with a bounded consecutive-corruption budget; the
+                    # sharded engine degrades around the liar instead
+                    # (parallel/engine.py).
+                    inflight.clear()
+                    blamed = blamed_device(exc)
+                    self._quarantined.add(blamed if blamed is not None
+                                          else 0)
+                    self._metrics.set("fault_device",
+                                      blamed if blamed is not None
+                                      else 0)
+                    self._metrics.set("quarantined",
+                                      len(self._quarantined))
+                    shadow.rollback_to_mark()
+                    self._unique_state_count = len(generated)
+                    if self._trace:
+                        self._trace.emit(
+                            "corruption", device=blamed,
+                            error=f"{type(exc).__name__}: {exc}")
+                        self._trace.emit(
+                            "quarantine",
+                            device=blamed if blamed is not None else 0,
+                            quarantined=len(self._quarantined))
+                    if corruption_attempt >= max(1, policy.retries):
+                        self._flight_dump("corruption")
+                        raise RuntimeError(
+                            "chunk audit failed "
+                            f"{corruption_attempt + 1} consecutive "
+                            "times on the only device — the chip is "
+                            "persistently returning wrong results and "
+                            "there is no healthy silicon to replay on "
+                            f"({exc})") from exc
+                    corruption_attempt += 1
                     recover_delay = 0.0
                     continue
                 if kind is not FaultKind.TRANSIENT:
@@ -2835,21 +2973,26 @@ class TpuChecker(HostChecker):
     def _model_tag(self) -> str:
         return model_tag(self._model)
 
-    def _load_checkpoint(self, discoveries: Dict[str, int]):
-        """Seed state from a ``save()`` file: the mirror (and its
-        canonical/node-key -> original-fp translation), the saved
-        discoveries, and the pending frontier (whose rows become the seed
-        'inits' — their parents are already in the mirror). Returns
-        ``(rows, ebits, cache_fps)`` with ``cache_fps`` the frontier's
-        queue-cached state fingerprints (canonical under symmetry)."""
+    def _read_checkpoint(self, path):
+        """Open and verify ONE checkpoint file. Structural load errors
+        (truncated archive, missing entries, bad JSON) and integrity-
+        chain mismatches both raise one actionable RuntimeError, so the
+        caller's generation-rollback logic has a single failure
+        surface."""
         import json
 
+        from .resilience import chain_integrity, payload_digest
+
         try:
-            data = np.load(self._resume_path)
+            data = np.load(path)
             meta = json.loads(str(data["meta"]))
+            arrays = {}
             for key in ("child", "parent", "rows", "ebits",
                         "state_count"):
-                data[key]
+                arrays[key] = data[key]
+            for key in data.files:
+                if key != "meta":
+                    arrays[key] = data[key]
         except Exception as e:
             # anything the load raises — zipfile.BadZipFile for a
             # truncated archive, KeyError for missing entries, OSError,
@@ -2857,11 +3000,52 @@ class TpuChecker(HostChecker):
             # checkpoint; surface ONE actionable error instead of a
             # numpy/zipfile traceback
             raise RuntimeError(
-                f"cannot resume from {self._resume_path!r}: the "
+                f"cannot resume from {path!r}: the "
                 "checkpoint file is corrupt, truncated, or not a "
                 f"Checker.save() file ({type(e).__name__}: {e}). "
                 "Re-create it with save() on a finished resumable "
                 "run.") from e
+        want = meta.get("integrity")
+        if want is not None and chain_integrity(
+                payload_digest(arrays),
+                meta.get("chain_head") or "") != want:
+            raise RuntimeError(
+                f"cannot resume from {path!r}: integrity chain "
+                "mismatch — the payload no longer matches the sha256 "
+                "it was written under (bit rot, tampering, or a "
+                "partial write)")
+        return data, meta
+
+    def _load_checkpoint(self, discoveries: Dict[str, int]):
+        """Seed state from a ``save()`` file: the mirror (and its
+        canonical/node-key -> original-fp translation), the saved
+        discoveries, and the pending frontier (whose rows become the seed
+        'inits' — their parents are already in the mirror). Returns
+        ``(rows, ebits, cache_fps)`` with ``cache_fps`` the frontier's
+        queue-cached state fingerprints (canonical under symmetry).
+
+        Every checkpoint is verified against its integrity chain
+        (payload sha256 chained to the writing run's chunk-digest head
+        — ``_checkpoint_save``) BEFORE anything is seeded; a corrupt,
+        truncated, or tampered newest file rolls back to the previous
+        autosave generation (``<path>.g1``) when one exists instead of
+        resuming from garbage."""
+        from .resilience import AUTOSAVE_PREV_SUFFIX
+
+        try:
+            data, meta = self._read_checkpoint(self._resume_path)
+        except RuntimeError as first:
+            prev = os.fspath(self._resume_path) + AUTOSAVE_PREV_SUFFIX
+            if not os.path.exists(prev):
+                raise
+            # generation rollback: the newest autosave is unusable but
+            # the one before it survived rotation — resume from that
+            # (strictly older progress; the run re-explores the gap)
+            data, meta = self._read_checkpoint(prev)
+            if self._trace:
+                self._trace.emit(
+                    "corruption", device=None,
+                    error=f"autosave rollback to {prev!r}: {first}")
         if meta["model"] != self._model_tag():
             raise RuntimeError(
                 "checkpoint was written by a different model config: "

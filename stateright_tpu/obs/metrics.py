@@ -107,6 +107,17 @@ GLOSSARY: Dict[str, str] = {
                        "as rediscoveries of evicted ranges and "
                        "filtered out of the mirror and unique counts "
                        "(their re-expansion is the price of tiering)",
+    "audits": "sampled chunk audits taken (tpu_options(audit=...), "
+              "README § Silent corruption defense): the chunk's "
+              "frontier slice re-executed on a different device (host "
+              "oracle on single-chip) and compared word-for-word "
+              "against the fingerprints the chip claimed",
+    "audit_mismatches": "chunk audits that caught a chip returning "
+                        "WRONG results (silent data corruption): each "
+                        "one rolled the shadow back to the last "
+                        "audited boundary, quarantined the liar, and "
+                        "replayed — the final digest stays identical "
+                        "to an uncorrupted oracle run",
     "fused_chunks": "chunks dispatched through the fused Pallas "
                     "expand→fingerprint→dedup kernel (ops/fused.py; "
                     "tpu_options(fused=...))",
@@ -201,6 +212,12 @@ GLOSSARY: Dict[str, str] = {
                       "most recent spill (decremented as evicted keys "
                       "are rediscovered and re-promoted); 0 until the "
                       "run hits its HBM budget",
+    "quarantined": "devices the chunk auditor caught returning wrong "
+                   "results this run (gauge — the cumulative "
+                   "quarantine-set size; the service scheduler "
+                   "persists the set and withholds these devices from "
+                   "all future grants until an audit probe re-admits "
+                   "them)",
     # --- host search timers -------------------------------------------
     "search": "host-engine search loop wall time",
     # --- device-time attribution (chunk loops) ------------------------
@@ -341,6 +358,7 @@ GAUGES = frozenset({
     "shard_balance", "host_tier_keys", "queue_depth", "lanes",
     "hosts", "procs", "fused_unsupported", "cc_dedup_capacity",
     "pool_busy_frac", "jobs_per_min", "burnin_frac", "flex_width",
+    "quarantined",
 })
 
 #: keys merged by maximum (observed buffer-sizing maxima).

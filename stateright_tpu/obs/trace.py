@@ -112,6 +112,19 @@ EVENT_SCHEMA: Dict[str, frozenset] = {
     # reseed) and `error` (the capacity fault that forced it)
     "evict": frozenset({"prefixes", "keys"}),
     "spill": frozenset({"capacity", "hot", "host_tier_keys"}),
+    # silent-corruption defense (checker/resilience.py AuditPolicy +
+    # README § Silent corruption defense): `audit` — one sampled
+    # redundant re-execution of a chunk's frontier slice (`mismatches`
+    # is 0 on a clean pass; optional `device` names the audited shard);
+    # `corruption` — the auditor caught wrong results, or an artifact
+    # failed its integrity chain (`device` rides along: the blamed chip
+    # index, or None for artifact-level corruption such as an autosave
+    # generation rollback); `quarantine` — a corruption-blamed device
+    # was withheld from the run (and, via service/scheduler.py, from
+    # all future grants; `quarantined` is the cumulative count)
+    "audit": frozenset({"chunk", "rows", "mismatches"}),
+    "corruption": frozenset({"error"}),
+    "quarantine": frozenset({"device", "quarantined"}),
     # tpu_options(fused='auto') attempted the Pallas build and fell
     # back to the staged path; `cause` is the resilience taxonomy's
     # classification of the build failure (transient / capacity /

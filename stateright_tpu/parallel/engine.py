@@ -151,7 +151,8 @@ class ShardedTpuChecker(TpuChecker):
         # first, and past the retry budget the DEGRADATION LADDER takes
         # over (degrade_step below) — a rung inherits the survivor
         # shards' spill state through HostShadow.reshard.
-        from ..checker.resilience import (FaultAttributor, FaultKind,
+        from ..checker.resilience import (CorruptionError, FaultAttributor,
+                                          FaultKind, audit_chunk_rows,
                                           blamed_device, classify_error,
                                           find_candidate_overflow,
                                           gather_rows, match_device,
@@ -162,6 +163,8 @@ class ShardedTpuChecker(TpuChecker):
         policy = self._retry_policy
         ladder = self._degrade_policy
         spill_pol = self._spill_policy
+        audit_pol = self._audit_policy
+        corrupt_hook = self._corrupt_hook
         spill_on = spill_pol.enabled and not self._sound
         attributor = FaultAttributor(ladder.blame_after)
         shadow = self._make_shadow(D)
@@ -439,7 +442,7 @@ class ShardedTpuChecker(TpuChecker):
 
         def process(ordinal: int, stats_d, grow_limit: int,
                     t_disp: float) -> set:
-            nonlocal fault_attempt, spill_attempt
+            nonlocal fault_attempt, spill_attempt, corruption_attempt
             with self._timed("sync_stall"):
                 # ONE transfer for everything the host reads per chunk
                 # — routed through the fault hook + watchdog deadline
@@ -530,6 +533,54 @@ class ShardedTpuChecker(TpuChecker):
                         carry.elog,
                         np.concatenate(e_idx) if e_idx else empty)
                         if eloc else None)
+                    # --- silent-corruption defense (AuditPolicy) ------
+                    # injection + audit run on the gathered host copies
+                    # BEFORE any shard folds into the shadow, so a
+                    # caught lie never enters the mirror; the audit of
+                    # shard s's slice re-executes on the NEXT device in
+                    # the mesh (cross-device redundant execution — a
+                    # lying chip cannot vouch for its own rows), with
+                    # the host oracle answering on a one-shard mesh
+                    lie_at = (corrupt_hook(ordinal, D)
+                              if corrupt_hook is not None else None)
+                    if lie_at is not None and lie_at is not False \
+                            and q_cnt[int(lie_at)]:
+                        s = int(lie_at)
+                        o0 = sum(q_cnt[:s])
+                        q_new = q_new.copy()
+                        l_new = l_new.copy()
+                        width = model.packed_width
+                        q_new[o0:o0 + q_cnt[s], width + 1] ^= np.uint32(1)
+                        l_new[o0:o0 + q_cnt[s], 0] ^= np.uint32(1)
+                    audited = audit_pol.should_audit(ordinal)
+                    if audited:
+                        self._metrics.inc("audits")
+                        mesh_devs = list(mesh.devices.flat)
+                        qo = 0
+                        for s in range(D):
+                            nn = q_cnt[s]
+                            bad = audit_chunk_rows(
+                                q_new[qo:qo + nn], l_new[qo:qo + nn],
+                                model.packed_width, sound=self._sound,
+                                device=(mesh_devs[(s + 1) % D]
+                                        if D > 1 else None))
+                            if self._trace:
+                                self._trace.emit(
+                                    "audit", chunk=ordinal, rows=nn,
+                                    mismatches=bad, device=s)
+                            if bad:
+                                self._metrics.inc("audit_mismatches")
+                                raise CorruptionError(
+                                    f"chunk {ordinal} audit: {bad} of "
+                                    f"{nn} frontier fingerprints from "
+                                    f"shard {s} disagree with their "
+                                    "re-execution on "
+                                    + ("the host oracle" if D == 1 else
+                                       f"device {(s + 1) % D}")
+                                    + " — the chip is returning wrong "
+                                    "results", device_index=s,
+                                    mismatches=bad)
+                            qo += nn
                     qo = eo = 0
                     hits = 0
                     for s in range(D):
@@ -540,6 +591,14 @@ class ShardedTpuChecker(TpuChecker):
                             int(q_head[s]))
                         qo += nn
                         eo += ne
+                    if audited:
+                        # a PASSED audit pins the rollback boundary and
+                        # (unlike a successful sync, which a lying chip
+                        # passes happily) resets the consecutive-
+                        # corruption budget
+                        shadow.audit_mark()
+                        corruption_attempt = 0
+                    self._shadow_chain_head = shadow.chain_head
                     if hits:
                         # host-tier re-probe hits: rediscoveries of
                         # evicted ranges, excluded from unique counts
@@ -1049,6 +1108,7 @@ class ShardedTpuChecker(TpuChecker):
 
         fault_attempt = 0
         spill_attempt = 0
+        corruption_attempt = 0
         recover_delay = None
         recover_reason = "retry"
         handoff_rung = False
@@ -1175,6 +1235,65 @@ class ShardedTpuChecker(TpuChecker):
                                 error=f"{type(exc).__name__}: {exc}")
                         recover_reason = "spill"
                     recover_delay = 0.0
+                    continue
+                if kind is FaultKind.CORRUPTION:
+                    # a sampled audit caught a chip returning wrong
+                    # fingerprints: undo every fold since the last
+                    # audited boundary (the corrupt appends never reach
+                    # the final digest), quarantine the liar for the
+                    # fleet (service/scheduler.py withholds it from all
+                    # future grants), and take the ladder DOWN a rung
+                    # immediately — retrying on silicon that computes
+                    # wrong answers is worse than useless
+                    inflight.clear()
+                    blamed = blamed_device(exc)
+                    devs = list(mesh.devices.flat)
+                    pos = match_device(devs, blamed)
+                    qid = (getattr(devs[pos], "id", pos)
+                           if pos is not None
+                           else (blamed if blamed is not None else 0))
+                    self._quarantined.add(qid)
+                    self._metrics.set(
+                        "fault_device",
+                        blamed if blamed is not None else 0)
+                    self._metrics.set("quarantined",
+                                      len(self._quarantined))
+                    shadow.rollback_to_mark()
+                    self._unique_state_count = len(generated)
+                    if self._trace:
+                        self._trace.emit(
+                            "corruption", device=blamed,
+                            error=f"{type(exc).__name__}: {exc}")
+                        self._trace.emit(
+                            "quarantine", device=qid,
+                            quarantined=len(self._quarantined))
+                    attributor.note(blamed)
+                    if ladder.enabled and D > ladder.min_mesh:
+                        if degrade_step(blamed, exc):
+                            handoff_rung = True
+                            break
+                        fault_attempt = 0
+                        recover_delay = 0.0
+                        recover_reason = "degrade"
+                        continue
+                    # no rung below this mesh: bounded replay from the
+                    # audited boundary on the same silicon (the counter
+                    # only resets on a PASSED audit, so a persistent
+                    # liar cannot loop forever)
+                    if corruption_attempt >= max(1, policy.retries):
+                        self._flight_dump("corruption")
+                        raise RuntimeError(
+                            "chunk audit failed "
+                            f"{corruption_attempt + 1} consecutive "
+                            "times with no healthy mesh subset to "
+                            "degrade onto — the chip is persistently "
+                            "returning wrong results; replace the "
+                            "device or widen the mesh so the "
+                            "degradation ladder can route around it"
+                        ) from exc
+                    corruption_attempt += 1
+                    recover_delay = 0.0
+                    recover_reason = "retry"
                     continue
                 if kind is not FaultKind.TRANSIENT:
                     raise

@@ -264,6 +264,60 @@ class DevicePool:
             k *= 2
         self._free_hosts.setdefault(k, set()).add(h)
 
+    # --- silent-corruption quarantine ----------------------------------
+    def carve_out(self, pos: int) -> bool:
+        """Withhold the single device at global offset ``pos`` from
+        every future grant (README § Silent corruption defense): its
+        width-1 block is split out of the free structures and simply
+        never re-freed — the buddy allocator's own alignment rules
+        then keep every later lease away from it. Returns False when
+        the block is currently LEASED (the caller retries after the
+        holding lease releases) or ``pos`` is out of range; a retired
+        host's devices are already out of the pool (True)."""
+        hw = self.host_width
+        if not 0 <= pos < len(self._devices):
+            return False
+        hi = pos // hw
+        if hi in self._retired:
+            return True
+        # the host may still be at the fleet level: break its block
+        # down to this single host first (buddy-style, keeping every
+        # other host of the block free)
+        for s, offs in list(self._free_hosts.items()):
+            for h in list(offs):
+                if h <= hi < h + s:
+                    offs.discard(h)
+                    for k in range(h, h + s):
+                        if k != hi:
+                            self._merge_hosts(k, 1)
+                    self._local_free[hi] = {hw: {hi * hw}}
+                    break
+        free = self._local_free[hi]
+        for size in sorted(free):
+            for off in sorted(free[size]):
+                if off <= pos < off + size:
+                    free[size].discard(off)
+                    while size > 1:  # split, freeing the clean halves
+                        size //= 2
+                        if pos >= off + size:
+                            free.setdefault(size, set()).add(off)
+                            off += size
+                        else:
+                            free.setdefault(size, set()).add(off + size)
+                    return True  # pos's width-1 block left the pool
+        return False
+
+    def readmit(self, pos: int) -> None:
+        """Return a quarantined device's width-1 block to the free
+        structures (probation passed — :meth:`Scheduler.audit_probe`);
+        it buddy-merges back like any releasing lease."""
+        hw = self.host_width
+        hi = pos // hw
+        if not 0 <= pos < len(self._devices) or hi in self._retired:
+            return
+        self.release(DeviceLease(pos, 1, (self._devices[pos],),
+                                 (self.host_labels[hi],)))
+
     def free_width(self) -> int:
         local = sum(s * len(offs)
                     for free in self._local_free
@@ -507,6 +561,22 @@ class Scheduler:
         #: extra device-width currently out on promote leases (the
         #: flex_width gauge; symmetric grant/release accounting)
         self._flex_extra = 0
+        # --- silent-corruption quarantine (persisted fleet state) ------
+        #: device key (stable ``.id``, else pool offset) -> blame
+        #: record. Loaded from ``<root>/quarantine.json`` at boot and
+        #: re-written on every change, so a chip the chunk auditor
+        #: caught lying stays withheld across service restarts until
+        #: :meth:`audit_probe` re-admits it
+        self._quarantine_path = os.path.join(self._store.root,
+                                             "quarantine.json")
+        self._quarantined: Dict[str, dict] = {}
+        try:
+            import json
+            with open(self._quarantine_path) as f:
+                self._quarantined = {str(k): dict(v)
+                                     for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            self._quarantined = {}
         if recover:
             self._recover()
             # boot placement pass: recovered RUNNING jobs (and any
@@ -730,6 +800,13 @@ class Scheduler:
                 self._devices = list(jax.devices())
             self._pool = DevicePool(self._devices, hosts=self._hosts)
             self._metrics.set("hosts", self._pool.host_count)
+            # persisted quarantine survives restarts: carve every
+            # still-blamed device back out before any grant lands
+            for key in self._quarantined:
+                pos = self._pool_pos(key)
+                if pos is not None:
+                    self._pool.carve_out(pos)
+            self._metrics.set("quarantined", len(self._quarantined))
             # the utilization sampler: one busy-fraction sample per
             # second while the service lives (plus a synchronous
             # sample after every placement pass, so tests and bursty
@@ -781,6 +858,7 @@ class Scheduler:
                 "hosts": (self._pool.host_count if self._pool
                           else 0),
                 **current,
+                "quarantined": sorted(self._quarantined),
                 "samples": self._util_ring.snapshot()}
 
     def prom_rows(self) -> list:
@@ -1219,7 +1297,123 @@ class Scheduler:
                     self._pool.release(extra)
                     self._flex_extra -= extra.width
                     self._metrics.set("flex_width", self._flex_extra)
+                # quarantine AFTER release: the blamed width-1 blocks
+                # just buddy-merged back, so carve_out can split them
+                # out of the free structures for good
+                fresh = self._harvest_quarantine(job, rt)
+            for key in fresh:
+                self._trace.emit("quarantine", device=key,
+                                 quarantined=len(self._quarantined),
+                                 job=job.id)
+            if fresh:
+                self._persist_quarantine()
             self._schedule()
+
+    # --- silent-corruption quarantine ----------------------------------
+    def _device_key(self, device, pos: int) -> str:
+        did = getattr(device, "id", None)
+        return str(did if did is not None else pos)
+
+    def _pool_pos(self, key) -> Optional[int]:
+        """Global pool offset of the device with stable key ``key``
+        (None when it is no longer in the pool). Caller holds the
+        lock; the pool exists."""
+        for i, d in enumerate(self._pool._devices):
+            if self._device_key(d, i) == str(key):
+                return i
+        return None
+
+    def _harvest_quarantine(self, job: Job,
+                            rt: _JobRuntime) -> List[str]:
+        """Map a finished job's auditor blame set (mesh-relative
+        device references on ``checker._quarantined``) through its
+        lease onto pool devices, carve each out of the free
+        structures, and record the blame. Caller holds the lock;
+        returns the NEWLY quarantined device keys."""
+        blamed = getattr(rt.checker, "_quarantined", None)
+        if not blamed:
+            return []
+        from ..checker.resilience import match_device
+        devs = list(rt.lease.devices)
+        fresh: List[str] = []
+        for ref in sorted(blamed, key=str):
+            idx = match_device(devs, ref)
+            if idx is None:
+                continue
+            device = devs[idx]
+            pos = None
+            for i, d in enumerate(self._pool._devices):
+                if d is device:
+                    pos = i
+                    break
+            if pos is None:
+                continue
+            key = self._device_key(device, pos)
+            self._pool.carve_out(pos)
+            if key not in self._quarantined:
+                self._quarantined[key] = {
+                    "device": key, "pos": pos, "job": job.id,
+                    "host": (str(rt.lease.hosts[0])
+                             if rt.lease.hosts else None),
+                    "at": time.time()}
+                fresh.append(key)
+        if fresh:
+            self._metrics.set("quarantined", len(self._quarantined))
+        return fresh
+
+    def _persist_quarantine(self) -> None:
+        with self._lock:
+            snapshot = dict(self._quarantined)
+        _atomic_write_json(self._quarantine_path, snapshot)
+
+    def quarantined(self) -> List[str]:
+        """The device keys currently withheld from every grant."""
+        with self._lock:
+            return sorted(self._quarantined)
+
+    def audit_probe(self, device_key, oracle=None) -> bool:
+        """Probation re-admission for a quarantined device: run the
+        dedicated audit-probe workload — a deterministic packed-row
+        matrix fingerprinted ON the device and compared word-for-word
+        against the host oracle (``checker/resilience.oracle_fps``,
+        the same comparison the chunk auditor makes). Pass: the
+        device's width-1 block buddy-merges back into the pool and the
+        persisted blame record is dropped. Fail: it stays quarantined.
+        ``oracle`` overrides the device-side computation (fault
+        injection for tests). Returns whether the probe passed."""
+        key = str(device_key)
+        with self._lock:
+            if key not in self._quarantined:
+                raise ValueError(
+                    f"device {key!r} is not quarantined "
+                    f"(quarantined: {sorted(self._quarantined)})")
+            self._ensure_pool()
+            pos = self._pool_pos(key)
+        import numpy as np
+
+        from ..checker.resilience import oracle_fps
+        rows = _audit_probe_rows()
+        want = oracle_fps(rows)
+        device = (self._pool._devices[pos] if pos is not None
+                  else None)
+        got = (oracle if oracle is not None else oracle_fps)(
+            rows, device)
+        ok = bool(np.array_equal(np.asarray(want, np.uint64),
+                                 np.asarray(got, np.uint64)))
+        with self._lock:
+            if ok:
+                self._quarantined.pop(key, None)
+                if pos is not None:
+                    self._pool.readmit(pos)
+                self._metrics.set("quarantined",
+                                  len(self._quarantined))
+            n = len(self._quarantined)
+        self._persist_quarantine()
+        self._trace.emit("quarantine", device=key, quarantined=n,
+                         probe="pass" if ok else "fail")
+        if ok:
+            self._schedule()
+        return ok
 
     def _drive_job(self, job: Job, lease: DeviceLease,
                    rt: _JobRuntime) -> None:
@@ -1564,6 +1758,17 @@ class Scheduler:
         self._metrics.set("jobs_per_min", len(self._done_times))
 
 
+def _audit_probe_rows(n: int = 4096, width: int = 8):
+    """The deterministic packed-row workload ``Scheduler.audit_probe``
+    fingerprints on a quarantined device: a Knuth-hash ramp wide
+    enough to exercise every fingerprint lane, identical on every
+    call so probe verdicts are reproducible."""
+    import numpy as np
+    ramp = (np.arange(n * width, dtype=np.uint64)
+            * np.uint64(2654435761)) % np.uint64(1 << 32)
+    return ramp.astype(np.uint32).reshape(n, width)
+
+
 def job_lifecycle(job: Job, done_wall: Optional[float] = None) -> dict:
     """The submit→grant→start→first-chunk→done stamps (absolute wall
     seconds) plus the derived SLO intervals, from the job's status
@@ -1627,6 +1832,13 @@ def write_result(job: Job, checker) -> dict:
         "fingerprint_count": len(fps),
         "fingerprints_sha256": digest,
     }
+    # artifact integrity chain (silent-corruption defense): bind the
+    # result digest to the run's audited chunk-digest head so a reader
+    # can tell a tampered/corrupted result.json from a genuine one
+    from ..checker.resilience import chain_integrity
+    chain_head = getattr(checker, "_shadow_chain_head", None) or ""
+    result["chain_head"] = chain_head
+    result["integrity"] = chain_integrity(digest, chain_head)
     _json.dumps(result)  # fail here, not mid-atomic-write
     _atomic_write_json(job.paths["result"], result)
     return result

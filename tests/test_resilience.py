@@ -627,6 +627,176 @@ class TestAutosave:
         with pytest.raises(RuntimeError, match="autosave"):
             ck.join()
 
+    def test_corrupt_newest_generation_resumes_from_previous(
+            self, tmp_path, clean_2pc3_single):
+        # ACCEPTANCE (silent-corruption defense, artifact leg): the
+        # newest autosave is TRUNCATED on disk — the integrity chain
+        # rejects it and ``resume_from`` rolls back to the previous
+        # generation (``<path>.g1`` kept by rotation), completing to
+        # full parity instead of resuming from garbage
+        path = tmp_path / "auto.npz"
+        ck = _run(lambda: TwoPhaseSys(3), capacity=1 << 12, fmax=64,
+                  chunk_steps=2, autosave=os.fspath(path),
+                  autosave_interval=1)
+        assert ck.profile()["autosaves"] >= 2
+        prev = str(path) + ".g1"
+        assert os.path.exists(prev)  # rotation kept the generation
+        with open(path, "r+b") as f:  # truncate mid-payload
+            f.truncate(max(os.path.getsize(path) // 2, 16))
+        trace = []
+        resumed = (TwoPhaseSys(3).checker()
+                   .tpu_options(capacity=1 << 12, trace=trace)
+                   .resume_from(path).spawn_tpu().join())
+        # reached-set parity (the resume-idiom pin: discoveries that
+        # fired AFTER the older generation's sync are not replayed)
+        assert resumed.unique_state_count() == \
+            clean_2pc3_single.unique_state_count()
+        assert (resumed.generated_fingerprints()
+                == clean_2pc3_single.generated_fingerprints())
+        rolls = [e for e in trace if e["ev"] == "corruption"]
+        assert rolls and ".g1" in rolls[0]["error"]
+        # with BOTH generations gone, the failure is actionable
+        with open(prev, "r+b") as f:
+            f.truncate(16)
+        with pytest.raises(RuntimeError, match="integrity|checkpoint"):
+            (TwoPhaseSys(3).checker().tpu_options(capacity=1 << 12)
+             .resume_from(path).spawn_tpu().join())
+
+
+class TestAudit:
+    """Acceptance (silent-corruption defense, compute leg): a chip
+    that RETURNS WRONG RESULTS — one fingerprint bit flipped by
+    ``corrupt_hook`` in a chunk the auditor samples — is caught by
+    re-executing the frontier slice (host oracle single-chip, a
+    different device sharded), blamed, quarantined, and the run
+    replayed from the last audited boundary finishes with counts,
+    fingerprint sets, and discoveries bit-identical to an
+    uncorrupted run; ``audit=False`` (the default) stays free."""
+
+    def test_lying_chip_caught_single_pipelined(self, clean_2pc3_single):
+        trace = []
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12,
+                      fmax=64, chunk_steps=2, audit=1, retries=2,
+                      backoff=0.0, trace=trace,
+                      corrupt_hook=lambda o, d: 0 if o == 2 else None)
+        _assert_parity(faulty, clean_2pc3_single)
+        prof = faulty.profile()
+        assert prof["audits"] >= 1
+        assert prof["audit_mismatches"] >= 1
+        assert prof["quarantined"] == 1
+        from stateright_tpu.obs.trace import validate_event
+        by_kind = {}
+        for e in trace:
+            validate_event(e)
+            by_kind.setdefault(e["ev"], []).append(e)
+        assert any(e["mismatches"] for e in by_kind["audit"])
+        assert "chip is returning wrong results" \
+            in by_kind["corruption"][0]["error"]
+        assert by_kind["quarantine"][0]["quarantined"] == 1
+
+    def test_lying_chip_caught_single_sync(self, clean_2pc3_single):
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12,
+                      fmax=64, chunk_steps=2, audit=1, retries=2,
+                      backoff=0.0, pipeline=False,
+                      corrupt_hook=lambda o, d: 0 if o == 2 else None)
+        _assert_parity(faulty, clean_2pc3_single)
+        assert faulty.profile()["audit_mismatches"] >= 1
+
+    def test_lying_shard_quarantined_and_degraded(self, clean_2pc3_d2):
+        # a PERSISTENT liar at mesh position 1 while D=4 (the hook is
+        # width-pinned: one physical chip): the cross-device audit
+        # catches it, the ladder excludes exactly that chip, and the
+        # survivors converge to D=2 oracle parity
+        trace = []
+        faulty = _run(lambda: TwoPhaseSys(3), capacity=1 << 12,
+                      fmax=64, chunk_steps=2, mesh=_mesh(4), audit=1,
+                      retries=2, backoff=0.0, trace=trace,
+                      corrupt_hook=lambda o, d: 1 if d == 4 else None)
+        _assert_parity(faulty, clean_2pc3_d2)
+        prof = faulty.profile()
+        assert prof["audit_mismatches"] >= 1
+        assert prof["quarantined"] >= 1
+        assert prof["degrades"] >= 1
+        assert faulty._quarantined  # never granted again this run
+        bad = [e for e in trace if e["ev"] == "audit"
+               and e.get("mismatches")]
+        assert bad and all(e["device"] == 1 for e in bad)
+
+    def test_clean_audited_run_reports_zero_mismatches(
+            self, clean_2pc3_single):
+        audited = _run(lambda: TwoPhaseSys(3), capacity=1 << 12,
+                       fmax=64, chunk_steps=2, audit=1)
+        _assert_parity(audited, clean_2pc3_single)
+        prof = audited.profile()
+        assert prof["audits"] >= 1
+        assert not prof.get("audit_mismatches")
+        assert not prof.get("quarantined")
+
+    def test_audit_off_default_adds_nothing(self, clean_2pc3_single):
+        # satellite pin: audit=False (the default) must not change the
+        # engine's behavior — no audit work, no new trace events, and
+        # the reached set bit-identical to a plain run
+        trace = []
+        plain = _run(lambda: TwoPhaseSys(3), capacity=1 << 12,
+                     fmax=64, chunk_steps=2, trace=trace)
+        _assert_parity(plain, clean_2pc3_single)
+        assert not plain.profile().get("audits")
+        assert not [e for e in trace if e["ev"] in
+                    ("audit", "corruption", "quarantine")]
+
+    def test_audit_policy_mapping(self):
+        from stateright_tpu.checker.resilience import AuditPolicy
+
+        def pol(raw):
+            return AuditPolicy.from_options({"audit": raw})
+
+        assert pol(False).every == 0 and not pol(False).enabled
+        assert pol(None).every == 0
+        assert pol(True).every == 1
+        assert pol(4).every == 4
+        assert pol(0.25).every == 4  # a fraction: every 4th chunk
+        assert not pol(False).should_audit(0)
+        assert [o for o in range(6)
+                if pol(2).should_audit(o)] == [0, 2, 4]
+        with pytest.raises(ValueError):
+            pol(-1)
+        with pytest.raises(ValueError):
+            pol(1.5)
+
+    def test_symmetry_with_audit_is_explicit(self):
+        def mk():
+            return TwoPhaseSys(3, complete_symmetry=True)
+
+        with pytest.raises(NotImplementedError, match="audit"):
+            (mk().checker().symmetry_fn(mk().representative)
+             .tpu_options(race=False, capacity=1 << 12, audit=1)
+             .spawn_tpu().join())
+
+
+class TestBenchAuditSmoke:
+    @pytest.mark.slow
+    def test_contract_line_lands_rc0(self):
+        # ACCEPTANCE: --audit-smoke runs the lying-chip storyline and
+        # ALWAYS lands a JSON contract line, rc=0; a full (non-partial)
+        # round pins the catch + quarantine + oracle-parity claims
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--audit-smoke"],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        contract = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert contract["audit"] is True
+        assert contract["unit"] == "uniq/s"
+        if "partial" not in contract:
+            assert contract["audited"] is True
+            assert contract["audits"] >= 1
+            assert contract["audit_mismatches"] >= 1
+            assert contract["quarantined"] >= 1
+
 
 class TestWatchdog:
     def test_stalled_sync_becomes_classified_fault(self):

@@ -608,6 +608,78 @@ class TestScheduler:
         sched.shutdown()
 
 
+# --- fleet quarantine (silent-corruption defense) ----------------------
+
+class TestQuarantine:
+    """A device the chunk auditor caught lying is withheld from every
+    future grant (persisted in the service root, so it survives
+    restarts, and surfaced in ``/utilization``); re-admission only
+    through :meth:`Scheduler.audit_probe`."""
+
+    def test_lying_device_quarantined_persisted_probed(self, tmp_path,
+                                                       solo_2pc3):
+        if len(jax.devices()) < 2:
+            pytest.skip("need 2 devices")
+        sched = Scheduler(JobStore(tmp_path), devices=jax.devices()[:2])
+        job = sched.submit(JobSpec(
+            "twopc", args=[3],
+            options={**OPTS, "audit": 1, "retries": 2, "backoff": 0.0,
+                     "corrupt_hook": lambda o, d: 0 if o == 2 else None}))
+        assert sched.wait(job.id, timeout=120.0) == "done", job.state
+        result = job.read_result()
+        # the lying chip did not poison the artifact: digest parity
+        # with a solo run, bound into the integrity chain
+        assert result["fingerprints_sha256"] == _digest(solo_2pc3)
+        assert result["chain_head"] and result["integrity"]
+        quarantined = sched.quarantined()
+        assert len(quarantined) == 1
+        assert sched.utilization()["quarantined"] == quarantined
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           "quarantine.json"))
+        # the pool never grants the blamed chip again: a clean job
+        # still completes on the surviving device
+        j2 = sched.submit(JobSpec("twopc", args=[3], options=OPTS))
+        assert sched.wait(j2.id, timeout=120.0) == "done"
+        assert sched.quarantined() == quarantined
+        sched.shutdown()
+
+        # restart survival: the blame record reloads from the service
+        # root and the chip is carved out of the fresh pool
+        sched2 = Scheduler(JobStore(tmp_path),
+                           devices=jax.devices()[:2])
+        j3 = sched2.submit(JobSpec("twopc", args=[3], options=OPTS))
+        assert sched2.wait(j3.id, timeout=120.0) == "done"
+        assert sched2.quarantined() == quarantined
+
+        # probation: a FAILING audit probe keeps it out, a passing one
+        # buddy-merges the width-1 block back and drops the record
+        assert sched2.audit_probe(
+            quarantined[0], oracle=lambda rows, dev: [1]) is False
+        assert sched2.quarantined() == quarantined
+        assert sched2.audit_probe(quarantined[0]) is True
+        assert sched2.quarantined() == []
+        with open(os.path.join(str(tmp_path), "quarantine.json")) as f:
+            assert json.load(f) == {}
+        # the freed device really is grantable: two jobs run
+        # concurrently on the 2-device pool again
+        a = sched2.submit(JobSpec("twopc", args=[3], options=OPTS,
+                                  step_delay=0.25))
+        b = sched2.submit(JobSpec("twopc", args=[3], options=OPTS,
+                                  step_delay=0.25))
+        assert sched2.wait(a.id, timeout=120.0) == "done"
+        assert sched2.wait(b.id, timeout=120.0) == "done"
+        assert a.status["running_at"] < b.status["done_at"]
+        assert b.status["running_at"] < a.status["done_at"]
+        sched2.shutdown()
+
+    def test_probe_unknown_device_raises(self, tmp_path):
+        sched = Scheduler(JobStore(tmp_path),
+                          devices=jax.devices()[:1])
+        with pytest.raises(ValueError, match="not quarantined"):
+            sched.audit_probe("999")
+        sched.shutdown()
+
+
 # --- HTTP API + CLI artifacts ------------------------------------------
 
 class TestServiceApi:

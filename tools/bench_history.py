@@ -183,6 +183,10 @@ def parse_round(path: str) -> Dict[str, Any]:
                 # host join/leave with the elastic flex controller on
                 # — promote/demote behavior, not an engine rate
                 ("flex", bool(contract.get("flex"))),
+                # an --audit-smoke round: a lying chip caught by the
+                # chunk auditor and replayed to oracle parity — a
+                # defense-behavior number, not an engine rate
+                ("audit", bool(contract.get("audit"))),
             ) if on)
         rnd["workloads"][CONTRACT] = {
             "name": contract.get("metric", "contract"),

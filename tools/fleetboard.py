@@ -100,6 +100,16 @@ class Board:
             if trend:
                 line += f"   trend {spark(trend)}"
             lines.append(line)
+        # quarantined chips (silent-corruption defense): devices the
+        # auditor caught lying, withheld from every grant until an
+        # audit probe passes — a shrunken pool must say why
+        quarantined = util.get("quarantined") or []
+        if quarantined or prof.get("quarantined"):
+            lines.append(
+                "quarantine: {} device(s) withheld{}".format(
+                    len(quarantined) or int(prof.get("quarantined", 0)),
+                    ("  [" + ", ".join(map(str, quarantined)) + "]")
+                    if quarantined else ""))
         # per-job rows with throughput deltas
         active = [j for j in jobs
                   if j.get("state") in ("running", "queued", "paused")]
@@ -196,6 +206,7 @@ class Board:
         inter = {k: int(prof[k]) for k in
                  ("preemptions", "retries", "degrades", "promotes",
                   "demotes", "spills",
+                  "audits", "audit_mismatches", "quarantined",
                   "jobs_failed", "sse_dropped", "recorder_dumps")
                  if prof.get(k)}
         lines.append("interventions: " + (" ".join(
@@ -242,10 +253,10 @@ def load_offline(root: str) -> Dict[str, Any]:
             if kind == "span":
                 span_events.append(ev)
             if kind == "pool_util":
-                util = {"busy_frac": ev.get("busy_frac"),
-                        "per_host": ev.get("per_host") or {},
-                        "queue_depth": ev.get("queue_depth", 0),
-                        "burnin_frac": ev.get("burnin_frac")}
+                util.update({"busy_frac": ev.get("busy_frac"),
+                             "per_host": ev.get("per_host") or {},
+                             "queue_depth": ev.get("queue_depth", 0),
+                             "burnin_frac": ev.get("burnin_frac")})
                 samples.append({"busy_frac": ev.get("busy_frac", 0.0)})
             elif kind == "job_pause" \
                     and ev.get("reason") == "preempt":
@@ -257,6 +268,18 @@ def load_offline(root: str) -> Dict[str, Any]:
             elif kind == "job_demote":
                 profile["demotes"] = \
                     profile.get("demotes", 0) + 1
+            elif kind == "quarantine":
+                # the LAST quarantine event carries the current count
+                # (re-admission probes emit one too, with the count
+                # after the release), and its device key when present
+                profile["quarantined"] = ev.get("quarantined", 0)
+                dev = ev.get("device")
+                qset = set(util.get("quarantined") or [])
+                if ev.get("probe") == "pass":
+                    qset.discard(str(dev))
+                elif dev is not None:
+                    qset.add(str(dev))
+                util["quarantined"] = sorted(qset)
         profile["jobs_submitted"] = counts.get("job_submit", 0)
         profile["jobs_done"] = sum(
             1 for j in jobs if j.get("state") == "done")

@@ -48,9 +48,12 @@ found in the trace:
   * a soak summary line (ops, op timeouts, fault-injection counts,
     the history cross-check verdict) when the trace came from
     ``tools/soak.py``;
-  * a resilience summary line (retries/watchdogs/failovers/degrades,
-    the blamed device indices, and the mesh width a degraded run
-    finished on);
+  * a resilience summary line (retries/watchdogs/failovers/degrades/
+    corruptions/quarantines, the blamed device indices, and the mesh
+    width a degraded run finished on);
+  * an audit summary line (chunks sampled by the silent-corruption
+    auditor, frontier rows re-executed, mismatches, and which devices
+    lied) when the run enabled ``tpu_options(audit=...)``;
   * discoveries and the final counts.
 
 ``--validate`` additionally schema-checks every event and exits
@@ -176,6 +179,7 @@ def report(events, out=None):
                    "degrade", "promote", "host_promote",
                    "fused_fallback", "fused_unsupported",
                    "recorder_dump",
+                   "corruption", "quarantine",
                    "spill", "evict", "pause",
                    "crash", "restart", "partition",
                    "soak_start", "violation", "burnin_preempt",
@@ -196,17 +200,21 @@ def report(events, out=None):
         # degrades, with every chip the faults were blamed on
         resil = [e for e in evs
                  if e["ev"] in ("retry", "failover", "degrade",
-                                "promote", "watchdog")]
+                                "promote", "watchdog",
+                                "corruption", "quarantine")]
         if resil:
             counts = {}
             for ev in resil:
                 counts[ev["ev"]] = counts.get(ev["ev"], 0) + 1
             plural = {"retry": "retries", "watchdog": "watchdogs",
                       "failover": "failovers", "degrade": "degrades",
-                      "promote": "promotes"}
+                      "promote": "promotes",
+                      "corruption": "corruptions",
+                      "quarantine": "quarantines"}
             parts = [f"{plural[kind]}={counts[kind]}"
                      for kind in ("retry", "watchdog", "failover",
-                                  "degrade", "promote")
+                                  "degrade", "promote",
+                                  "corruption", "quarantine")
                      if kind in counts]
             blamed = sorted({ev["device"] for ev in resil
                              if ev.get("device") is not None})
@@ -220,6 +228,22 @@ def report(events, out=None):
                 parts.append(
                     f"final_mesh={rungs[-1]['to_shards']}")
             out.write("\nresilience: " + " ".join(parts) + "\n")
+
+        # audit summary: the silent-corruption defense's verdict —
+        # chunks sampled, frontier rows re-executed on a second
+        # device (or the host oracle), and how many disagreed
+        audits = [e for e in evs if e["ev"] == "audit"]
+        if audits:
+            bad = sum(e.get("mismatches", 0) or 0 for e in audits)
+            parts = [f"audits={len(audits)}",
+                     f"rows={sum(e.get('rows', 0) or 0 for e in audits)}",
+                     f"mismatches={bad}"]
+            liars = sorted({e["device"] for e in audits
+                            if e.get("mismatches")
+                            and e.get("device") is not None})
+            if liars:
+                parts.append(f"lying_devices={liars}")
+            out.write("\naudit: " + " ".join(parts) + "\n")
 
         # fleet summary (stateright_tpu/cluster + multi-host meshes):
         # the mesh's host/process decomposition, the DCN round-trip
